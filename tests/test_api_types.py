@@ -1,0 +1,123 @@
+"""TPUJob type serialization round-trips (reference: pkg/apis/pytorch/v1)."""
+import copy
+
+from tpujob.api import constants as c
+from tpujob.api.types import TPUJob, TPUJobSpec
+from tpujob.kube.objects import Container, Pod
+
+JOB_DICT = {
+    "apiVersion": "tpujob.dev/v1",
+    "kind": "TPUJob",
+    "metadata": {"name": "mnist", "namespace": "default", "labels": {"app": "mnist"}},
+    "spec": {
+        "cleanPodPolicy": "All",
+        "backoffLimit": 3,
+        "tpuReplicaSpecs": {
+            "Master": {
+                "replicas": 1,
+                "restartPolicy": "OnFailure",
+                "tpu": {"accelerator": "v4-32", "topology": "4x2x2"},
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "tpu",
+                                "image": "tpujob/mnist:latest",
+                                "args": ["--epochs", "10"],
+                                "resources": {"limits": {"google.com/tpu": 4}},
+                            }
+                        ]
+                    }
+                },
+            },
+            "Worker": {
+                "replicas": 3,
+                "template": {
+                    "spec": {
+                        "containers": [{"name": "tpu", "image": "tpujob/mnist:latest"}]
+                    }
+                },
+            },
+        },
+    },
+}
+
+
+def test_from_dict_roundtrip():
+    job = TPUJob.from_dict(copy.deepcopy(JOB_DICT))
+    assert job.metadata.name == "mnist"
+    assert job.spec.run_policy.clean_pod_policy == "All"
+    assert job.spec.run_policy.backoff_limit == 3
+    master = job.spec.tpu_replica_specs["Master"]
+    assert master.replicas == 1
+    assert master.tpu.accelerator == "v4-32"
+    assert master.template.spec.containers[0].image == "tpujob/mnist:latest"
+    assert master.template.spec.containers[0].resources.limits == {"google.com/tpu": 4}
+
+    out = job.to_dict()
+    # inline run-policy fields get normalized under runPolicy
+    assert out["spec"]["runPolicy"]["cleanPodPolicy"] == "All"
+    assert out["spec"]["runPolicy"]["backoffLimit"] == 3
+    assert (
+        out["spec"]["tpuReplicaSpecs"]["Master"]["template"]["spec"]["containers"][0]["args"]
+        == ["--epochs", "10"]
+    )
+    # round-trip is stable
+    job2 = TPUJob.from_dict(out)
+    assert job2.to_dict() == out
+
+
+def test_unknown_fields_preserved():
+    d = copy.deepcopy(JOB_DICT)
+    d["spec"]["tpuReplicaSpecs"]["Master"]["template"]["spec"]["containers"][0][
+        "securityContext"
+    ] = {"privileged": True}
+    d["metadata"]["weirdField"] = "kept"
+    job = TPUJob.from_dict(d)
+    out = job.to_dict()
+    assert out["metadata"]["weirdField"] == "kept"
+    assert (
+        out["spec"]["tpuReplicaSpecs"]["Master"]["template"]["spec"]["containers"][0][
+            "securityContext"
+        ]
+        == {"privileged": True}
+    )
+
+
+def test_job_key():
+    job = TPUJob.from_dict(copy.deepcopy(JOB_DICT))
+    assert job.key == "default/mnist"
+    job.metadata.namespace = ""
+    assert job.key == "default/mnist"
+
+
+def test_deepcopy_independent():
+    job = TPUJob.from_dict(copy.deepcopy(JOB_DICT))
+    clone = job.deepcopy()
+    clone.spec.tpu_replica_specs["Worker"].replicas = 99
+    assert job.spec.tpu_replica_specs["Worker"].replicas == 3
+
+
+def test_pod_roundtrip():
+    pod = Pod.from_dict(
+        {
+            "metadata": {"name": "p", "ownerReferences": [{"uid": "u1", "controller": True}]},
+            "spec": {"containers": [{"name": "tpu", "image": "x", "env": [{"name": "A", "value": "1"}]}]},
+            "status": {
+                "phase": "Failed",
+                "containerStatuses": [
+                    {"name": "tpu", "restartCount": 2, "state": {"terminated": {"exitCode": 137}}}
+                ],
+            },
+        }
+    )
+    assert pod.status.container_statuses[0].state.terminated.exit_code == 137
+    assert pod.metadata.owner_references[0].controller is True
+    assert pod.to_dict()["status"]["containerStatuses"][0]["restartCount"] == 2
+
+
+def test_empty_spec_parses():
+    job = TPUJob.from_dict({"metadata": {"name": "x"}})
+    assert isinstance(job.spec, TPUJobSpec)
+    assert job.spec.tpu_replica_specs == {}
+    assert job.api_version == c.API_VERSION

@@ -1,0 +1,90 @@
+"""Defaulting behavior (reference: pkg/apis/pytorch/v1/defaults.go)."""
+import copy
+
+from tpujob.api import constants as c
+from tpujob.api.defaults import set_defaults_tpujob
+from tpujob.api.types import TPUJob
+
+
+def make_job(spec):
+    return TPUJob.from_dict({"metadata": {"name": "j", "namespace": "ns"}, "spec": spec})
+
+
+MINIMAL = {
+    "tpuReplicaSpecs": {
+        "master": {  # lowercase on purpose: must normalize
+            "template": {"spec": {"containers": [{"name": "tpu", "image": "img"}]}}
+        }
+    }
+}
+
+
+def test_defaults_minimal():
+    job = make_job(copy.deepcopy(MINIMAL))
+    set_defaults_tpujob(job)
+    assert job.spec.run_policy.clean_pod_policy == c.CLEAN_POD_POLICY_NONE
+    assert "Master" in job.spec.tpu_replica_specs  # normalized CamelCase
+    master = job.spec.tpu_replica_specs["Master"]
+    assert master.replicas == 1
+    assert master.restart_policy == c.RESTART_POLICY_ON_FAILURE
+    ports = master.template.spec.containers[0].ports
+    assert ports[-1].name == c.DEFAULT_PORT_NAME
+    assert ports[-1].container_port == c.DEFAULT_PORT
+
+
+def test_default_port_not_duplicated():
+    job = make_job(copy.deepcopy(MINIMAL))
+    set_defaults_tpujob(job)
+    set_defaults_tpujob(job)
+    ports = job.spec.tpu_replica_specs["Master"].template.spec.containers[0].ports
+    assert len([p for p in ports if p.name == c.DEFAULT_PORT_NAME]) == 1
+
+
+def test_existing_port_kept():
+    spec = copy.deepcopy(MINIMAL)
+    spec["tpuReplicaSpecs"]["master"]["template"]["spec"]["containers"][0]["ports"] = [
+        {"name": c.DEFAULT_PORT_NAME, "containerPort": 9999}
+    ]
+    job = make_job(spec)
+    set_defaults_tpujob(job)
+    ports = job.spec.tpu_replica_specs["Master"].template.spec.containers[0].ports
+    assert len(ports) == 1
+    assert ports[0].container_port == 9999
+
+
+def test_worker_replicas_default_from_topology():
+    spec = {
+        "tpuReplicaSpecs": {
+            "Master": {
+                "tpu": {"accelerator": "v4-32"},
+                "template": {"spec": {"containers": [{"name": "tpu", "image": "img"}]}},
+            },
+            "Worker": {
+                "template": {"spec": {"containers": [{"name": "tpu", "image": "img"}]}}
+            },
+        }
+    }
+    job = make_job(spec)
+    set_defaults_tpujob(job)
+    # v4-32 = 16 chips = 4 hosts => Master 1 + Worker 3
+    assert job.spec.tpu_replica_specs["Worker"].replicas == 3
+    master_tpu = job.spec.tpu_replica_specs["Master"].tpu
+    assert master_tpu.topology is not None
+    assert master_tpu.chips_per_host == 4
+
+
+def test_worker_replicas_explicit_not_overridden():
+    spec = {
+        "tpuReplicaSpecs": {
+            "Worker": {
+                "replicas": 5,
+                "template": {"spec": {"containers": [{"name": "tpu", "image": "img"}]}},
+            }
+        }
+    }
+    job = make_job(spec)
+    set_defaults_tpujob(job)
+    assert job.spec.tpu_replica_specs["Worker"].replicas == 5
+    # master-less: coordinator port defaults onto the worker container
+    ports = job.spec.tpu_replica_specs["Worker"].template.spec.containers[0].ports
+    assert ports and ports[-1].name == c.DEFAULT_PORT_NAME

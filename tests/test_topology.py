@@ -1,0 +1,93 @@
+"""TPU slice topology math (tpujob/api/topology.py)."""
+import pytest
+
+from tpujob.api.topology import (
+    SliceTopology,
+    TopologyError,
+    default_topology,
+    parse_accelerator,
+    parse_topology,
+)
+
+
+@pytest.mark.parametrize(
+    "acc,chips,hosts,devices",
+    [
+        ("v4-8", 4, 1, 4),  # single host, megacore
+        ("v4-32", 16, 4, 16),
+        ("v4-4096", 2048, 512, 2048),
+        ("v2-8", 4, 1, 8),  # 2 devices per chip
+        ("v3-32", 16, 4, 32),
+        ("v5litepod-16", 16, 2, 16),
+        ("v5litepod-8", 8, 1, 8),
+        ("v5p-128", 64, 16, 64),
+        ("v6e-64", 64, 8, 64),
+    ],
+)
+def test_resolve_known_accelerators(acc, chips, hosts, devices):
+    topo = SliceTopology.resolve(acc)
+    assert topo.chips == chips
+    assert topo.hosts == hosts
+    assert topo.devices_per_slice == devices
+    assert topo.num_processes == hosts
+    # default topology covers exactly the chips
+    dims = parse_topology(topo.topology)
+    prod = 1
+    for d in dims:
+        prod *= d
+    assert prod == chips
+
+
+def test_explicit_topology_validated():
+    topo = SliceTopology.resolve("v4-32", topology="4x2x2")
+    assert topo.topology == "4x2x2"
+    with pytest.raises(TopologyError):
+        SliceTopology.resolve("v4-32", topology="2x2x2")  # 8 != 16 chips
+
+
+@pytest.mark.parametrize(
+    "bad", ["", "v4", "v99-8", "v4-abc", "v4-0", "v4-7"]
+)
+def test_bad_accelerators(bad):
+    with pytest.raises(TopologyError):
+        parse_accelerator(bad)
+
+
+@pytest.mark.parametrize("bad", ["", "0x2", "-1x2", "2xx2", "axb"])
+def test_bad_topologies(bad):
+    with pytest.raises(TopologyError):
+        parse_topology(bad)
+
+
+def test_default_topology_balanced():
+    assert default_topology(16, 3) == "2x2x4"
+    assert default_topology(8, 3) == "2x2x2"
+    assert default_topology(4, 2) == "2x2"
+    assert default_topology(1, 3) == "1x1x1"
+    dims = parse_topology(default_topology(2048, 3))
+    prod = 1
+    for d in dims:
+        prod *= d
+    assert prod == 2048
+
+
+def test_multislice_process_ids():
+    topo = SliceTopology.resolve("v4-32", num_slices=2)
+    assert topo.num_processes == 8
+    assert topo.global_devices == 32
+    assert topo.process_id(0, 0) == 0
+    assert topo.process_id(1, 0) == 4
+    assert topo.process_id(1, 3) == 7
+    assert topo.host_of_process(7) == (1, 3)
+    with pytest.raises(TopologyError):
+        topo.process_id(2, 0)
+    with pytest.raises(TopologyError):
+        topo.process_id(0, 4)
+
+
+def test_chips_per_host_override():
+    topo = SliceTopology.resolve("v5litepod-16", chips_per_host=4)
+    assert topo.hosts == 4
+    assert topo.devices_per_host == 4
+    with pytest.raises(TopologyError):
+        SliceTopology.resolve("v4-32", chips_per_host=5)

@@ -1,0 +1,121 @@
+"""Spec validation (reference: pkg/apis/pytorch/validation/validation_test.go)."""
+import copy
+
+import pytest
+
+from tpujob.api.types import TPUJobSpec
+from tpujob.api.validation import ValidationError, validate_or_raise, validate_tpujob_spec
+
+VALID = {
+    "tpuReplicaSpecs": {
+        "Master": {
+            "replicas": 1,
+            "template": {"spec": {"containers": [{"name": "tpu", "image": "img"}]}},
+        },
+        "Worker": {
+            "replicas": 3,
+            "template": {"spec": {"containers": [{"name": "tpu", "image": "img"}]}},
+        },
+    }
+}
+
+
+def spec_of(d):
+    return TPUJobSpec.from_dict(copy.deepcopy(d))
+
+
+def test_valid_spec():
+    assert validate_tpujob_spec(spec_of(VALID)) == []
+    validate_or_raise(spec_of(VALID))
+
+
+def test_nil_spec():
+    assert validate_tpujob_spec(None) != []
+
+
+def test_empty_replica_specs():
+    assert validate_tpujob_spec(spec_of({})) != []
+
+
+def test_unknown_replica_type():
+    d = copy.deepcopy(VALID)
+    d["tpuReplicaSpecs"]["Chief"] = d["tpuReplicaSpecs"].pop("Master")
+    errs = validate_tpujob_spec(spec_of(d))
+    assert any("no replica type" in e for e in errs)
+
+
+def test_two_masters_invalid():
+    d = copy.deepcopy(VALID)
+    d["tpuReplicaSpecs"]["Master"]["replicas"] = 2
+    errs = validate_tpujob_spec(spec_of(d))
+    assert any("only 1 master" in e for e in errs)
+
+
+def test_no_containers():
+    d = copy.deepcopy(VALID)
+    d["tpuReplicaSpecs"]["Master"]["template"]["spec"]["containers"] = []
+    errs = validate_tpujob_spec(spec_of(d))
+    assert any("must have containers" in e for e in errs)
+
+
+def test_no_image():
+    d = copy.deepcopy(VALID)
+    d["tpuReplicaSpecs"]["Master"]["template"]["spec"]["containers"][0].pop("image")
+    errs = validate_tpujob_spec(spec_of(d))
+    assert any("image is undefined" in e for e in errs)
+
+
+def test_missing_managed_container():
+    d = copy.deepcopy(VALID)
+    d["tpuReplicaSpecs"]["Master"]["template"]["spec"]["containers"][0]["name"] = "other"
+    errs = validate_tpujob_spec(spec_of(d))
+    assert any("container named 'tpu'" in e for e in errs)
+
+
+def test_bad_restart_policy():
+    d = copy.deepcopy(VALID)
+    d["tpuReplicaSpecs"]["Worker"]["restartPolicy"] = "Sometimes"
+    errs = validate_tpujob_spec(spec_of(d))
+    assert any("restartPolicy" in e for e in errs)
+
+
+def test_bad_clean_pod_policy():
+    d = copy.deepcopy(VALID)
+    d["cleanPodPolicy"] = "Most"
+    errs = validate_tpujob_spec(spec_of(d))
+    assert any("cleanPodPolicy" in e for e in errs)
+
+
+def test_bad_topology_reported():
+    d = copy.deepcopy(VALID)
+    d["tpuReplicaSpecs"]["Master"]["tpu"] = {"accelerator": "v4-32", "topology": "2x2x2"}
+    errs = validate_tpujob_spec(spec_of(d))
+    assert any("topology" in e for e in errs)
+
+
+def test_strict_topology_host_count():
+    d = copy.deepcopy(VALID)
+    # v4-32 => 4 hosts; Master 1 + Worker 3 is coherent
+    d["tpuReplicaSpecs"]["Worker"]["tpu"] = {"accelerator": "v4-32"}
+    assert validate_tpujob_spec(spec_of(d), strict_topology=True) == []
+    d["tpuReplicaSpecs"]["Worker"]["replicas"] = 7
+    errs = validate_tpujob_spec(spec_of(d), strict_topology=True)
+    assert any("host pods" in e for e in errs)
+
+
+def test_negative_run_policy_values():
+    d = copy.deepcopy(VALID)
+    d["backoffLimit"] = -1
+    d["activeDeadlineSeconds"] = -5
+    errs = validate_tpujob_spec(spec_of(d))
+    assert any("backoffLimit" in e for e in errs)
+    assert any("activeDeadlineSeconds" in e for e in errs)
+
+
+def test_validation_error_lists_all():
+    d = copy.deepcopy(VALID)
+    d["tpuReplicaSpecs"]["Master"]["replicas"] = 2
+    d["tpuReplicaSpecs"]["Worker"]["template"]["spec"]["containers"] = []
+    with pytest.raises(ValidationError) as ei:
+        validate_or_raise(spec_of(d))
+    assert len(ei.value.errors) >= 2

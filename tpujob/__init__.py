@@ -1,0 +1,28 @@
+"""tpujob — a TPU-native job orchestration framework.
+
+A brand-new implementation of the capabilities of the Kubeflow PyTorch
+Operator (reference: /root/reference, see SURVEY.md), redesigned TPU-first:
+
+- ``tpujob.api``        — the TPUJob custom-resource contract (types,
+  defaults, validation, TPU slice topology math).  Mirrors the capability of
+  reference ``pkg/apis/pytorch/v1`` + ``pkg/apis/pytorch/validation``.
+- ``tpujob.kube``       — object model, API-server transport (in-memory
+  simulator + pluggable real transport), typed clients, shared informers,
+  listers, pod/service control.  Mirrors ``pkg/client`` + the vendored
+  kubeflow/common control plumbing.
+- ``tpujob.runtime``    — native (C++) controller kernel: rate-limited
+  delaying workqueue, expectations TTL-cache, backoff — with a pure-Python
+  fallback.  Mirrors the role of the vendored jobcontroller internals.
+- ``tpujob.controller`` — the reconciler: pod/service reconcile, PJRT/XLA
+  environment injection, condition state machine, restart/backoff/TTL/
+  clean-pod policies, gang scheduling.  Mirrors ``pkg/controller.v1/pytorch``.
+- ``tpujob.server``     — operator entrypoint: flags, leader election,
+  metrics.  Mirrors ``cmd/pytorch-operator.v1``.
+- ``tpujob.sdk``        — user-facing Python client.  Mirrors
+  ``sdk/python/kubeflow/pytorchjob``.
+- ``tpujob.models`` / ``tpujob.ops`` / ``tpujob.parallel`` — the TPU-native
+  workload library (JAX/Flax/Pallas): the equivalent of the reference's
+  example training containers, built for MXU/ICI from the start.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,15 @@
+"""The TPUJob custom-resource contract: types, defaults, validation, topology."""
+
+from tpujob.api import constants  # noqa: F401
+from tpujob.api.types import (  # noqa: F401
+    TPUJob,
+    TPUJobSpec,
+    TPUJobList,
+    ReplicaSpec,
+    ReplicaStatus,
+    TPUSpec,
+    JobStatus,
+    JobCondition,
+    RunPolicy,
+    SchedulingPolicy,
+)

@@ -1,0 +1,64 @@
+"""API group constants and well-known names for the TPUJob CRD.
+
+Mirrors the capability of reference ``pkg/apis/pytorch/v1/constants.go:26-33``
+and ``register.go:31-44``, re-targeted at TPU workloads.
+"""
+
+# --- group/version/kind (register.go equivalents) --------------------------
+GROUP_NAME = "tpujob.dev"
+VERSION = "v1"
+KIND = "TPUJob"
+PLURAL = "tpujobs"
+SINGULAR = "tpujob"
+API_VERSION = f"{GROUP_NAME}/{VERSION}"
+
+# --- defaults (constants.go equivalents) ------------------------------------
+# Name of the port exposed by the coordinator (master) container.  The
+# reference used "pytorchjob-port"/23456 for torch TCP rendezvous
+# (constants.go:26-33); on TPU the rendezvous is the JAX/PJRT distributed
+# coordinator service, conventionally port 8476.
+DEFAULT_PORT_NAME = "tpujob-port"
+DEFAULT_PORT = 8476
+# The container the operator manages (reference: "pytorch").
+DEFAULT_CONTAINER_NAME = "tpu"
+DEFAULT_RESTART_POLICY = "OnFailure"
+DEFAULT_CLEAN_POD_POLICY = "None"
+
+# --- replica types ----------------------------------------------------------
+REPLICA_TYPE_MASTER = "Master"
+REPLICA_TYPE_WORKER = "Worker"
+
+# --- labels stamped on pods/services (controller.go:55-59 equivalents) ------
+LABEL_GROUP_NAME = "group-name"
+LABEL_JOB_NAME = "tpu-job-name"
+LABEL_REPLICA_TYPE = "tpu-replica-type"
+LABEL_REPLICA_INDEX = "tpu-replica-index"
+# legacy-style selector label also set by the reference ("job-name")
+LABEL_JOB_NAME_SHORT = "job-name"
+
+# --- TPU resource names -----------------------------------------------------
+TPU_RESOURCE = "google.com/tpu"
+TPU_ACCELERATOR_NODE_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+TPU_TOPOLOGY_NODE_SELECTOR = "cloud.google.com/gke-tpu-topology"
+
+# --- condition types (kubeflow/common types.go:101-127 equivalents) ---------
+JOB_CREATED = "Created"
+JOB_RUNNING = "Running"
+JOB_RESTARTING = "Restarting"
+JOB_SUCCEEDED = "Succeeded"
+JOB_FAILED = "Failed"
+
+# --- restart policies (types.go:145-156) ------------------------------------
+RESTART_POLICY_ALWAYS = "Always"
+RESTART_POLICY_ON_FAILURE = "OnFailure"
+RESTART_POLICY_NEVER = "Never"
+RESTART_POLICY_EXIT_CODE = "ExitCode"
+
+# --- clean pod policies (types.go:130-137) ----------------------------------
+CLEAN_POD_POLICY_NONE = "None"
+CLEAN_POD_POLICY_RUNNING = "Running"
+CLEAN_POD_POLICY_ALL = "All"
+
+# --- gang scheduling ---------------------------------------------------------
+DEFAULT_GANG_SCHEDULER_NAME = "volcano"
+POD_GROUP_ANNOTATION = "scheduling.k8s.io/group-name"

@@ -1,0 +1,88 @@
+"""Defaulting for TPUJob resources.
+
+Mirrors reference ``pkg/apis/pytorch/v1/defaults.go:36-106``:
+- cleanPodPolicy -> None
+- replica-type names normalized to CamelCase (master -> Master)
+- replicas -> 1, restartPolicy -> OnFailure
+- the default coordinator port appended to the Master's managed container
+TPU-first addition: default the chip topology / chipsPerHost from the
+accelerator generation, and default Worker replicas to the slice host count
+minus the Master host.
+"""
+from __future__ import annotations
+
+from tpujob.api import constants as c
+from tpujob.api.topology import TopologyError
+from tpujob.api.types import ReplicaSpec, TPUJob
+from tpujob.kube.objects import ContainerPort
+
+
+def _normalize_replica_type(rtype: str) -> str:
+    low = rtype.lower()
+    if low == c.REPLICA_TYPE_MASTER.lower():
+        return c.REPLICA_TYPE_MASTER
+    if low == c.REPLICA_TYPE_WORKER.lower():
+        return c.REPLICA_TYPE_WORKER
+    return rtype
+
+
+def set_default_port(spec: ReplicaSpec) -> None:
+    """Append the default coordinator port to the managed container if absent
+    (defaults.go:36-58)."""
+    for container in spec.template.spec.containers:
+        if container.name != c.DEFAULT_CONTAINER_NAME:
+            continue
+        for port in container.ports:
+            if port.name == c.DEFAULT_PORT_NAME:
+                return
+        container.ports.append(
+            ContainerPort(name=c.DEFAULT_PORT_NAME, container_port=c.DEFAULT_PORT)
+        )
+
+
+def set_defaults_tpujob(job: TPUJob) -> None:
+    """Apply all defaults in place (defaults.go:88-106 equivalent)."""
+    spec = job.spec
+    if spec.run_policy.clean_pod_policy is None:
+        spec.run_policy.clean_pod_policy = c.DEFAULT_CLEAN_POD_POLICY
+
+    # normalize replica-type keys
+    for rtype in list(spec.tpu_replica_specs):
+        norm = _normalize_replica_type(rtype)
+        if norm != rtype:
+            spec.tpu_replica_specs[norm] = spec.tpu_replica_specs.pop(rtype)
+
+    master = spec.tpu_replica_specs.get(c.REPLICA_TYPE_MASTER)
+    worker = spec.tpu_replica_specs.get(c.REPLICA_TYPE_WORKER)
+
+    # resolve topology defaults before defaulting replica counts
+    slice_topo = None
+    for rspec in spec.tpu_replica_specs.values():
+        if rspec.tpu and rspec.tpu.accelerator:
+            try:
+                topo = rspec.tpu.resolve()
+            except TopologyError:
+                continue  # validation reports it with a proper error
+            rspec.tpu.topology = topo.topology
+            rspec.tpu.chips_per_host = topo.chips_per_host
+            slice_topo = slice_topo or topo
+
+    for rtype, rspec in spec.tpu_replica_specs.items():
+        if rspec.replicas is None:
+            if (
+                rtype == c.REPLICA_TYPE_WORKER
+                and slice_topo is not None
+                and master is not None
+            ):
+                # default Worker count to the remaining hosts of the slice
+                rspec.replicas = max(0, slice_topo.num_processes - 1)
+            else:
+                rspec.replicas = 1
+        if rspec.restart_policy is None:
+            rspec.restart_policy = c.DEFAULT_RESTART_POLICY
+
+    if master is not None:
+        set_default_port(master)
+    elif worker is not None:
+        # master-less single-replica-set jobs still need the coordinator port
+        set_default_port(worker)

@@ -1,0 +1,182 @@
+"""TPU slice topology math.
+
+The reference operator assumes 1 process = 1 pod = 1 rank and computes
+``WORLD_SIZE = Σ replicas`` (``pkg/controller.v1/pytorch/pod.go:252,267-274``).
+On TPU that arithmetic changes: a job runs on a *slice*; each host pod runs
+one XLA process that owns ``devices_per_host`` chips, so
+
+    num_processes      = hosts × num_slices          (JAX process world)
+    global_devices     = devices × num_slices        (XLA device world)
+
+This module owns that mapping: accelerator-type parsing ("v4-32"),
+chip-grid topology strings ("2x2x4"), host counts, device counts, and the
+(slice, host) → process-id function used by the controller's environment
+injection (the TPU-native replacement for ``setClusterSpec``).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+class TopologyError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Generation:
+    name: str
+    cores_per_chip: int  # TensorCores counted by the accelerator-type suffix
+    chips_per_host: int  # chips attached to one host VM
+    devices_per_chip: int  # PJRT devices exposed per chip (megacore => 1)
+    topology_dims: int  # 2 for v5e-style 2D ICI mesh, 3 for v4/v5p torus
+
+
+# Known TPU generations.  The accelerator-type suffix counts TensorCores for
+# v2-v4/v5p (so v4-8 is 4 chips / 1 host) and chips for the "lite" parts.
+GENERATIONS: Dict[str, Generation] = {
+    "v2": Generation("v2", cores_per_chip=2, chips_per_host=4, devices_per_chip=2, topology_dims=2),
+    "v3": Generation("v3", cores_per_chip=2, chips_per_host=4, devices_per_chip=2, topology_dims=2),
+    "v4": Generation("v4", cores_per_chip=2, chips_per_host=4, devices_per_chip=1, topology_dims=3),
+    "v5p": Generation("v5p", cores_per_chip=2, chips_per_host=4, devices_per_chip=1, topology_dims=3),
+    "v5litepod": Generation(
+        "v5litepod", cores_per_chip=1, chips_per_host=8, devices_per_chip=1, topology_dims=2
+    ),
+    "v5e": Generation("v5e", cores_per_chip=1, chips_per_host=8, devices_per_chip=1, topology_dims=2),
+    "v6e": Generation("v6e", cores_per_chip=1, chips_per_host=8, devices_per_chip=1, topology_dims=2),
+}
+
+
+def parse_accelerator(accelerator: str) -> Tuple[Generation, int]:
+    """Parse an accelerator type like ``v4-32`` into (generation, suffix)."""
+    if not accelerator or "-" not in accelerator:
+        raise TopologyError(f"invalid accelerator type {accelerator!r}; want e.g. 'v4-32'")
+    name, _, suffix_s = accelerator.rpartition("-")
+    gen = GENERATIONS.get(name)
+    if gen is None:
+        raise TopologyError(
+            f"unknown TPU generation {name!r} in {accelerator!r}; known: {sorted(GENERATIONS)}"
+        )
+    try:
+        suffix = int(suffix_s)
+    except ValueError:
+        raise TopologyError(f"invalid accelerator size {suffix_s!r} in {accelerator!r}")
+    if suffix <= 0 or suffix % gen.cores_per_chip != 0:
+        raise TopologyError(
+            f"accelerator size {suffix} not a positive multiple of "
+            f"{gen.cores_per_chip} for generation {gen.name}"
+        )
+    return gen, suffix
+
+
+def parse_topology(topology: str) -> Tuple[int, ...]:
+    """Parse a chip-grid string like ``2x2x4`` into dims."""
+    try:
+        dims = tuple(int(d) for d in topology.lower().split("x"))
+    except ValueError:
+        raise TopologyError(f"invalid topology {topology!r}; want e.g. '2x2x4'")
+    if not dims or any(d <= 0 for d in dims):
+        raise TopologyError(f"invalid topology {topology!r}; dims must be positive")
+    return dims
+
+
+def default_topology(chips: int, ndims: int) -> str:
+    """A near-balanced ndims-factorization of `chips`, e.g. 16,3 -> '2x2x4'."""
+    dims = [1] * ndims
+    remaining = chips
+    # peel off prime factors largest-first onto the currently-smallest dim
+    factors: List[int] = []
+    n = remaining
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            factors.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        factors.append(n)
+    for f in sorted(factors, reverse=True):
+        dims[dims.index(min(dims))] *= f
+    return "x".join(str(d) for d in sorted(dims))
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    """Resolved topology for one job: the source of all rank arithmetic."""
+
+    accelerator: str  # e.g. "v4-32"
+    topology: str  # chip grid, e.g. "2x2x4"
+    chips: int  # chips per slice
+    hosts: int  # host VMs (= worker pods) per slice
+    chips_per_host: int
+    devices_per_chip: int
+    num_slices: int = 1  # >1 => multislice over DCN
+
+    # -- derived ------------------------------------------------------------
+    @property
+    def devices_per_host(self) -> int:
+        return self.chips_per_host * self.devices_per_chip
+
+    @property
+    def devices_per_slice(self) -> int:
+        return self.chips * self.devices_per_chip
+
+    @property
+    def global_devices(self) -> int:
+        return self.devices_per_slice * self.num_slices
+
+    @property
+    def num_processes(self) -> int:
+        """JAX/PJRT process world size (one process per host per slice)."""
+        return self.hosts * self.num_slices
+
+    def process_id(self, slice_id: int, host_index: int) -> int:
+        """Global process id for host `host_index` of slice `slice_id`."""
+        if not (0 <= slice_id < self.num_slices):
+            raise TopologyError(f"slice_id {slice_id} out of range [0,{self.num_slices})")
+        if not (0 <= host_index < self.hosts):
+            raise TopologyError(f"host_index {host_index} out of range [0,{self.hosts})")
+        return slice_id * self.hosts + host_index
+
+    def host_of_process(self, process_id: int) -> Tuple[int, int]:
+        if not (0 <= process_id < self.num_processes):
+            raise TopologyError(f"process_id {process_id} out of range [0,{self.num_processes})")
+        return divmod(process_id, self.hosts)
+
+    @classmethod
+    def resolve(
+        cls,
+        accelerator: str,
+        topology: Optional[str] = None,
+        chips_per_host: Optional[int] = None,
+        num_slices: int = 1,
+    ) -> "SliceTopology":
+        """Resolve a full SliceTopology from (partially-specified) spec fields."""
+        gen, suffix = parse_accelerator(accelerator)
+        chips = suffix // gen.cores_per_chip
+        cph = chips_per_host or min(gen.chips_per_host, chips)
+        if chips % cph != 0:
+            raise TopologyError(
+                f"{accelerator}: {chips} chips not divisible by chipsPerHost={cph}"
+            )
+        if topology:
+            dims = parse_topology(topology)
+            if math.prod(dims) != chips:
+                raise TopologyError(
+                    f"topology {topology} has {math.prod(dims)} chips but "
+                    f"{accelerator} is a {chips}-chip slice"
+                )
+        else:
+            topology = default_topology(chips, gen.topology_dims)
+        if num_slices < 1:
+            raise TopologyError(f"numSlices must be >= 1, got {num_slices}")
+        return cls(
+            accelerator=accelerator,
+            topology=topology,
+            chips=chips,
+            hosts=max(1, chips // cph),
+            chips_per_host=cph,
+            devices_per_chip=gen.devices_per_chip,
+            num_slices=num_slices,
+        )

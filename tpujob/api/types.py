@@ -1,0 +1,184 @@
+"""TPUJob custom-resource types.
+
+The TPU-native equivalent of the reference CRD contract:
+``pkg/apis/pytorch/v1/types.go:27-98`` (PyTorchJob{Spec,Status}) plus the
+shared kubeflow/common types it embeds
+(``vendor/github.com/kubeflow/common/job_controller/api/v1/types.go:23-191``:
+ReplicaSpec, JobStatus, JobCondition, RunPolicy, SchedulingPolicy).
+
+TPU-first deltas:
+- ``ReplicaSpec.tpu`` (:class:`TPUSpec`) declares the slice the replica set
+  runs on (accelerator type, chip topology, multislice count); the controller
+  derives host counts, process ids and PJRT env from it (see
+  ``tpujob.api.topology``).
+- Replica types are still Master/Worker, but a Worker is one *host VM* of a
+  slice, not one GPU process.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tpujob.api import constants as c
+from tpujob.api.topology import SliceTopology
+from tpujob.kube.objects import K8sObject, ObjectMeta, PodTemplateSpec
+
+
+@dataclass
+class TPUSpec(K8sObject):
+    """The TPU slice a replica set schedules onto.
+
+    This is the "TPU topology field on the replica spec" called for by the
+    north star (BASELINE.json): e.g. ``{accelerator: v4-32, topology: 4x4x2}``.
+    """
+
+    accelerator: str = ""  # e.g. "v4-32", "v5litepod-16"
+    topology: Optional[str] = None  # chip grid, e.g. "2x2x4"; defaulted if absent
+    chips_per_host: Optional[int] = None  # override; defaulted per generation
+    num_slices: int = 1  # >1 => multislice (DCN between slices)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def resolve(self) -> SliceTopology:
+        return SliceTopology.resolve(
+            self.accelerator, self.topology, self.chips_per_host, self.num_slices
+        )
+
+
+@dataclass
+class ReplicaSpec(K8sObject):
+    """One replica set (Master or Worker) of a TPUJob.
+
+    Mirrors kubeflow/common ``ReplicaSpec{Replicas,Template,RestartPolicy}``
+    (types.go:65-79) + the TPU slice field.
+    """
+
+    replicas: Optional[int] = None
+    template: PodTemplateSpec = field(
+        default_factory=PodTemplateSpec, metadata={"cls": PodTemplateSpec}
+    )
+    restart_policy: Optional[str] = None  # Always|OnFailure|Never|ExitCode
+    tpu: Optional[TPUSpec] = field(default=None, metadata={"cls": TPUSpec})
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class SchedulingPolicy(K8sObject):
+    """Gang-scheduling knobs (kubeflow/common types.go:185-191)."""
+
+    min_available: Optional[int] = None
+    queue: Optional[str] = None
+    priority_class: Optional[str] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class RunPolicy(K8sObject):
+    """Job-level run policy (kubeflow/common types.go:162-183).
+
+    The reference spells these fields inline on PyTorchJobSpec
+    (types.go:43-72); we accept both spellings (see TPUJobSpec.from_dict).
+    """
+
+    clean_pod_policy: Optional[str] = None  # None|Running|All
+    ttl_seconds_after_finished: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: Optional[int] = None
+    scheduling_policy: Optional[SchedulingPolicy] = field(
+        default=None, metadata={"cls": SchedulingPolicy}
+    )
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TPUJobSpec(K8sObject):
+    """Mirrors PyTorchJobSpec (types.go:43-72): run policy + replica specs."""
+
+    run_policy: RunPolicy = field(default_factory=RunPolicy, metadata={"cls": RunPolicy})
+    tpu_replica_specs: Dict[str, ReplicaSpec] = field(
+        default_factory=dict, metadata={"elem": ReplicaSpec}
+    )
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d):
+        if d is None:
+            return None
+        d = dict(d)
+        # accept reference-style inline run-policy fields
+        rp = dict(d.get("runPolicy") or {})
+        for k in (
+            "cleanPodPolicy",
+            "ttlSecondsAfterFinished",
+            "activeDeadlineSeconds",
+            "backoffLimit",
+            "schedulingPolicy",
+        ):
+            if k in d and k not in rp:
+                rp[k] = d.pop(k)
+        if rp:
+            d["runPolicy"] = rp
+        return super().from_dict(d)
+
+
+@dataclass
+class JobCondition(K8sObject):
+    """Mirrors kubeflow/common JobCondition (types.go:84-99)."""
+
+    type: str = ""  # Created|Running|Restarting|Succeeded|Failed
+    status: str = ""  # "True"|"False"|"Unknown"
+    reason: str = ""
+    message: str = ""
+    last_update_time: Optional[str] = None
+    last_transition_time: Optional[str] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ReplicaStatus(K8sObject):
+    """Mirrors kubeflow/common ReplicaStatus (types.go:47-58)."""
+
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class JobStatus(K8sObject):
+    """Mirrors kubeflow/common JobStatus (types.go:23-45)."""
+
+    conditions: List[JobCondition] = field(default_factory=list, metadata={"elem": JobCondition})
+    replica_statuses: Dict[str, ReplicaStatus] = field(
+        default_factory=dict, metadata={"elem": ReplicaStatus}
+    )
+    start_time: Optional[str] = None
+    completion_time: Optional[str] = None
+    last_reconcile_time: Optional[str] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TPUJob(K8sObject):
+    """The TPUJob custom resource (mirrors PyTorchJob, types.go:27-41)."""
+
+    api_version: str = c.API_VERSION
+    kind: str = c.KIND
+    metadata: ObjectMeta = field(default_factory=ObjectMeta, metadata={"cls": ObjectMeta})
+    spec: TPUJobSpec = field(default_factory=TPUJobSpec, metadata={"cls": TPUJobSpec})
+    status: JobStatus = field(default_factory=JobStatus, metadata={"cls": JobStatus})
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        """The workqueue key: namespace/name."""
+        ns = self.metadata.namespace or "default"
+        return f"{ns}/{self.metadata.name}"
+
+
+@dataclass
+class TPUJobList(K8sObject):
+    api_version: str = c.API_VERSION
+    kind: str = "TPUJobList"
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    items: List[TPUJob] = field(default_factory=list, metadata={"elem": TPUJob})
+    extra: Dict[str, Any] = field(default_factory=dict)

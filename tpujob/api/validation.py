@@ -1,0 +1,127 @@
+"""Validation for TPUJob specs.
+
+Mirrors reference ``pkg/apis/pytorch/validation/validation.go:23-77``:
+spec non-nil, only Master/Worker replica types, containers present, image
+defined, a managed container present, at most one Master replica.
+TPU-first additions: topology consistency (accelerator parses, chip grid
+matches chip count, replicas-vs-host-count coherence).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from tpujob.api import constants as c
+from tpujob.api.topology import TopologyError
+from tpujob.api.types import TPUJobSpec
+
+
+class ValidationError(ValueError):
+    """Raised when a TPUJobSpec is invalid; message lists every problem."""
+
+    def __init__(self, errors: List[str]):
+        self.errors = errors
+        super().__init__("; ".join(errors))
+
+
+VALID_REPLICA_TYPES = (c.REPLICA_TYPE_MASTER, c.REPLICA_TYPE_WORKER)
+VALID_RESTART_POLICIES = (
+    c.RESTART_POLICY_ALWAYS,
+    c.RESTART_POLICY_ON_FAILURE,
+    c.RESTART_POLICY_NEVER,
+    c.RESTART_POLICY_EXIT_CODE,
+)
+VALID_CLEAN_POD_POLICIES = (
+    c.CLEAN_POD_POLICY_NONE,
+    c.CLEAN_POD_POLICY_RUNNING,
+    c.CLEAN_POD_POLICY_ALL,
+)
+
+
+def validate_tpujob_spec(spec: TPUJobSpec, strict_topology: bool = False) -> List[str]:
+    """Return the list of validation errors (empty if valid)."""
+    errs: List[str] = []
+    if spec is None:
+        return ["TPUJobSpec is not valid: spec is nil"]
+    if not spec.tpu_replica_specs:
+        errs.append("TPUJobSpec is not valid: tpuReplicaSpecs is empty")
+        return errs
+
+    # total host pods in the job (the slice is shared by Master + Workers)
+    total_replicas = sum(
+        (r.replicas if r.replicas is not None else 1)
+        for t, r in spec.tpu_replica_specs.items()
+        if t in VALID_REPLICA_TYPES
+    )
+    for rtype, rspec in spec.tpu_replica_specs.items():
+        if rtype not in VALID_REPLICA_TYPES:
+            errs.append(
+                f"TPUJobSpec is not valid: there is no replica type {rtype!r}"
+                f" (valid: {list(VALID_REPLICA_TYPES)})"
+            )
+            continue
+        if rspec.replicas is not None and rspec.replicas < 0:
+            errs.append(f"TPUJobSpec is not valid: {rtype} replicas must be >= 0")
+        if rtype == c.REPLICA_TYPE_MASTER:
+            master_replicas = rspec.replicas if rspec.replicas is not None else 1
+            if master_replicas > 1:
+                errs.append("TPUJobSpec is not valid: there must be only 1 master replica")
+        if rspec.restart_policy is not None and rspec.restart_policy not in VALID_RESTART_POLICIES:
+            errs.append(
+                f"TPUJobSpec is not valid: invalid restartPolicy {rspec.restart_policy!r}"
+            )
+
+        containers = rspec.template.spec.containers
+        if not containers:
+            errs.append(f"TPUJobSpec is not valid: {rtype} pod template must have containers")
+            continue
+        found_managed = False
+        for i, container in enumerate(containers):
+            if not container.image:
+                errs.append(
+                    f"TPUJobSpec is not valid: {rtype} containers[{i}] image is undefined"
+                )
+            if container.name == c.DEFAULT_CONTAINER_NAME:
+                found_managed = True
+        if not found_managed:
+            errs.append(
+                "TPUJobSpec is not valid: there must be a container named "
+                f"{c.DEFAULT_CONTAINER_NAME!r} in {rtype} (the managed container)"
+            )
+
+        if rspec.tpu is not None and rspec.tpu.accelerator:
+            try:
+                topo = rspec.tpu.resolve()
+            except TopologyError as e:
+                errs.append(f"TPUJobSpec is not valid: {rtype} tpu: {e}")
+            else:
+                if strict_topology and total_replicas != topo.num_processes:
+                    # the slice is shared by the whole job: every host runs
+                    # exactly one pod (Master on host 0, Workers on the rest)
+                    errs.append(
+                        f"TPUJobSpec is not valid: slice {topo.accelerator} "
+                        f"needs {topo.num_processes} host pods but spec "
+                        f"provides {total_replicas}"
+                    )
+
+    if spec.run_policy.clean_pod_policy not in (None,) + VALID_CLEAN_POD_POLICIES:
+        errs.append(
+            f"TPUJobSpec is not valid: invalid cleanPodPolicy "
+            f"{spec.run_policy.clean_pod_policy!r}"
+        )
+    if (
+        spec.run_policy.backoff_limit is not None
+        and spec.run_policy.backoff_limit < 0
+    ):
+        errs.append("TPUJobSpec is not valid: backoffLimit must be >= 0")
+    if (
+        spec.run_policy.active_deadline_seconds is not None
+        and spec.run_policy.active_deadline_seconds < 0
+    ):
+        errs.append("TPUJobSpec is not valid: activeDeadlineSeconds must be >= 0")
+    return errs
+
+
+def validate_or_raise(spec: TPUJobSpec, strict_topology: bool = False) -> None:
+    errs = validate_tpujob_spec(spec, strict_topology=strict_topology)
+    if errs:
+        raise ValidationError(errs)

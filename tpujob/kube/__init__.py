@@ -1,0 +1,1 @@
+"""Kubernetes-shaped object model and cluster transport for tpujob."""

@@ -1,0 +1,346 @@
+"""Kubernetes-shaped object model.
+
+A minimal, dependency-free dataclass model of the core-v1 objects the
+operator manipulates (Pod, Service, ObjectMeta, containers, env, ...), with
+lossless ``to_dict``/``from_dict`` so manifests round-trip through YAML/JSON.
+
+This plays the role the ``k8s.io/api/core/v1`` structs play for the
+reference operator (e.g. pod templates consumed by
+``pkg/controller.v1/pytorch/pod.go``, services by ``service.go``).  Unknown
+keys encountered in ``from_dict`` are preserved in ``extra`` so user
+manifests survive a round-trip even when they use fields this model does not
+interpret.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# snake_case <-> camelCase plumbing
+# ---------------------------------------------------------------------------
+
+
+def _camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _is_empty(v: Any) -> bool:
+    # Go omitempty semantics: nil, "", 0, false, empty list/map are omitted.
+    return v is None or v == [] or v == {} or v == "" or v is False or (
+        isinstance(v, int) and not isinstance(v, bool) and v == 0
+    )
+
+
+class K8sObject:
+    """Base for dataclasses that serialize to camelCase dicts."""
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if f.name == "extra":
+                continue
+            if _is_empty(v):
+                continue
+            out[_camel(f.name)] = _serialize(v)
+        extra = getattr(self, "extra", None)
+        if extra:
+            for k, v in extra.items():
+                out.setdefault(k, copy.deepcopy(v))
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]):
+        if d is None:
+            return None
+        kwargs: Dict[str, Any] = {}
+        extra: Dict[str, Any] = {}
+        fields_by_camel = {_camel(f.name): f for f in dataclasses.fields(cls)}
+        for k, v in d.items():
+            f = fields_by_camel.get(k)
+            if f is None or f.name == "extra":
+                extra[k] = copy.deepcopy(v)
+                continue
+            kwargs[f.name] = _deserialize(f, v)
+        if "extra" in {f.name for f in dataclasses.fields(cls)}:
+            kwargs["extra"] = extra
+        return cls(**kwargs)
+
+    def deepcopy(self):
+        return copy.deepcopy(self)
+
+
+def _serialize(v: Any) -> Any:
+    if isinstance(v, K8sObject):
+        return v.to_dict()
+    if isinstance(v, list):
+        return [_serialize(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _serialize(x) for k, x in v.items()}
+    return v
+
+
+def _deserialize(f: dataclasses.Field, v: Any) -> Any:
+    elem = f.metadata.get("elem")
+    if elem is not None and isinstance(v, list):
+        return [elem.from_dict(x) if isinstance(x, dict) else x for x in v]
+    if elem is not None and isinstance(v, dict):
+        return {k: elem.from_dict(x) if isinstance(x, dict) else x for k, x in v.items()}
+    cls = f.metadata.get("cls")
+    if cls is not None:
+        if isinstance(v, dict) or v is None:
+            return cls.from_dict(v)
+        raise TypeError(
+            f"field {f.name!r} expects a {cls.__name__} object, got {type(v).__name__}"
+        )
+    if elem is not None and v is not None:
+        raise TypeError(
+            f"field {f.name!r} expects a list/map of {elem.__name__}, got {type(v).__name__}"
+        )
+    return copy.deepcopy(v)
+
+
+def obj(cls=None):  # decorator: dataclass with K8sObject serialization
+    def wrap(c):
+        return dataclass(c)
+
+    return wrap(cls) if cls else wrap
+
+
+# ---------------------------------------------------------------------------
+# meta
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OwnerReference(K8sObject):
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: Optional[bool] = None
+    block_owner_deletion: Optional[bool] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ObjectMeta(K8sObject):
+    name: str = ""
+    namespace: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    uid: str = ""
+    resource_version: str = ""
+    generation: int = 0
+    creation_timestamp: Optional[str] = None
+    deletion_timestamp: Optional[str] = None
+    owner_references: List[OwnerReference] = field(
+        default_factory=list, metadata={"elem": OwnerReference}
+    )
+    finalizers: List[str] = field(default_factory=list)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# pod spec
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EnvVar(K8sObject):
+    name: str = ""
+    value: Optional[str] = None
+    value_from: Optional[Dict[str, Any]] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ContainerPort(K8sObject):
+    name: str = ""
+    container_port: int = 0
+    protocol: Optional[str] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceRequirements(K8sObject):
+    limits: Dict[str, Any] = field(default_factory=dict)
+    requests: Dict[str, Any] = field(default_factory=dict)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Container(K8sObject):
+    name: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list, metadata={"elem": EnvVar})
+    ports: List[ContainerPort] = field(default_factory=list, metadata={"elem": ContainerPort})
+    resources: Optional[ResourceRequirements] = field(
+        default=None, metadata={"cls": ResourceRequirements}
+    )
+    volume_mounts: List[Dict[str, Any]] = field(default_factory=list)
+    working_dir: Optional[str] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class PodSpec(K8sObject):
+    containers: List[Container] = field(default_factory=list, metadata={"elem": Container})
+    init_containers: List[Container] = field(default_factory=list, metadata={"elem": Container})
+    restart_policy: Optional[str] = None  # Always | OnFailure | Never
+    scheduler_name: Optional[str] = None
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Dict[str, Any]] = field(default_factory=list)
+    volumes: List[Dict[str, Any]] = field(default_factory=list)
+    host_network: Optional[bool] = None
+    subdomain: Optional[str] = None
+    hostname: Optional[str] = None
+    affinity: Optional[Dict[str, Any]] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ContainerStateTerminated(K8sObject):
+    exit_code: int = 0
+    reason: Optional[str] = None
+    message: Optional[str] = None
+    finished_at: Optional[str] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ContainerState(K8sObject):
+    waiting: Optional[Dict[str, Any]] = None
+    running: Optional[Dict[str, Any]] = None
+    terminated: Optional[ContainerStateTerminated] = field(
+        default=None, metadata={"cls": ContainerStateTerminated}
+    )
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ContainerStatus(K8sObject):
+    name: str = ""
+    restart_count: int = 0
+    ready: bool = False
+    state: Optional[ContainerState] = field(default=None, metadata={"cls": ContainerState})
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class PodStatus(K8sObject):
+    phase: str = "Pending"  # Pending | Running | Succeeded | Failed | Unknown
+    reason: Optional[str] = None
+    message: Optional[str] = None
+    container_statuses: List[ContainerStatus] = field(
+        default_factory=list, metadata={"elem": ContainerStatus}
+    )
+    pod_ip: Optional[str] = None
+    host_ip: Optional[str] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Pod(K8sObject):
+    api_version: str = "v1"
+    kind: str = "Pod"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta, metadata={"cls": ObjectMeta})
+    spec: PodSpec = field(default_factory=PodSpec, metadata={"cls": PodSpec})
+    status: PodStatus = field(default_factory=PodStatus, metadata={"cls": PodStatus})
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class PodTemplateSpec(K8sObject):
+    metadata: ObjectMeta = field(default_factory=ObjectMeta, metadata={"cls": ObjectMeta})
+    spec: PodSpec = field(default_factory=PodSpec, metadata={"cls": PodSpec})
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# service
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ServicePort(K8sObject):
+    name: str = ""
+    port: int = 0
+    target_port: Optional[Any] = None
+    protocol: Optional[str] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ServiceSpec(K8sObject):
+    cluster_ip: Optional[str] = None  # "None" => headless
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list, metadata={"elem": ServicePort})
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Service(K8sObject):
+    api_version: str = "v1"
+    kind: str = "Service"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta, metadata={"cls": ObjectMeta})
+    spec: ServiceSpec = field(default_factory=ServiceSpec, metadata={"cls": ServiceSpec})
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# events & pod groups (gang scheduling)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Event(K8sObject):
+    api_version: str = "v1"
+    kind: str = "Event"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta, metadata={"cls": ObjectMeta})
+    type: str = "Normal"  # Normal | Warning
+    reason: str = ""
+    message: str = ""
+    involved_object: Dict[str, Any] = field(default_factory=dict)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class PodGroupSpec(K8sObject):
+    min_member: int = 0
+    queue: Optional[str] = None
+    priority_class_name: Optional[str] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class PodGroup(K8sObject):
+    """Gang-scheduling unit (volcano/kube-batch style PodGroup)."""
+
+    api_version: str = "scheduling.volcano.sh/v1beta1"
+    kind: str = "PodGroup"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta, metadata={"cls": ObjectMeta})
+    spec: PodGroupSpec = field(default_factory=PodGroupSpec, metadata={"cls": PodGroupSpec})
+    status: Dict[str, Any] = field(default_factory=dict)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+def owner_ref_matches(meta: ObjectMeta, uid: str) -> bool:
+    """True if `meta` has a controller owner reference with the given uid."""
+    for ref in meta.owner_references:
+        if ref.controller and ref.uid == uid:
+            return True
+    return False
+
+
+def controller_ref(meta: ObjectMeta) -> Optional[OwnerReference]:
+    for ref in meta.owner_references:
+        if ref.controller:
+            return ref
+    return None
