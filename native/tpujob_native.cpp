@@ -1,0 +1,313 @@
+// tpujob native controller kernel.
+//
+// C++ implementation of the hot concurrent structures at the core of the
+// operator's reconcile loop — the role played in the reference by client-go's
+// workqueue + the kubeflow/common expectations cache
+// (vendor/.../jobcontroller/jobcontroller.go:108-131):
+//
+//  * RateLimitedWorkQueue: client-go semantics — de-dupe while queued,
+//    "dirty" re-queue of items re-added while being processed, delayed adds,
+//    per-item exponential-backoff rate limiting with Forget().
+//  * ExpectationsCache: per-(job, replica-type) expected create/delete
+//    counters with a TTL, gating reconcile on informer-cache freshness.
+//  * retryable_exit_code: the restart classification table
+//    (vendor/.../util/train/train_util.go:18-53 — note: the authoritative
+//    implementation; 130/137/138/143 retryable, everything else permanent).
+//
+// Exposed as a C ABI consumed from Python via ctypes
+// (tpujob/runtime/__init__.py), with a pure-Python fallback implementing
+// identical semantics when the shared library is not built.
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <queue>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+using Clock = std::chrono::steady_clock;
+using Ms = std::chrono::milliseconds;
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// RateLimitedWorkQueue
+// ---------------------------------------------------------------------------
+
+class WorkQueue {
+ public:
+  WorkQueue(int64_t base_delay_ms, int64_t max_delay_ms)
+      : base_delay_ms_(base_delay_ms), max_delay_ms_(max_delay_ms) {}
+
+  void Add(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (shutting_down_) return;
+    AddLocked(key);
+    cv_.notify_one();
+  }
+
+  void AddAfter(const std::string& key, int64_t delay_ms) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (shutting_down_) return;
+    if (delay_ms <= 0) {
+      AddLocked(key);
+    } else {
+      delayed_.push({Clock::now() + Ms(delay_ms), key});
+    }
+    cv_.notify_one();
+  }
+
+  void AddRateLimited(const std::string& key) {
+    int64_t delay;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      int n = ++failures_[key];
+      // base * 2^(n-1), capped (client-go ItemExponentialFailureRateLimiter)
+      double d = static_cast<double>(base_delay_ms_);
+      for (int i = 1; i < n && d < static_cast<double>(max_delay_ms_); ++i) d *= 2;
+      delay = static_cast<int64_t>(d);
+      if (delay > max_delay_ms_) delay = max_delay_ms_;
+    }
+    AddAfter(key, delay);
+  }
+
+  void Forget(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    failures_.erase(key);
+  }
+
+  int NumRequeues(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = failures_.find(key);
+    return it == failures_.end() ? 0 : it->second;
+  }
+
+  // Returns: 0 ok (key written), -1 timeout, -2 shutdown, -3 buffer too small.
+  int Get(int64_t timeout_ms, char* buf, int buflen) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto overall_deadline =
+        timeout_ms < 0 ? Clock::time_point::max() : Clock::now() + Ms(timeout_ms);
+    for (;;) {
+      PromoteDelayedLocked();
+      if (!queue_.empty()) break;
+      if (shutting_down_) return -2;
+      auto wait_until = overall_deadline;
+      if (!delayed_.empty() && delayed_.top().when < wait_until)
+        wait_until = delayed_.top().when;
+      if (wait_until == Clock::time_point::max()) {
+        cv_.wait(lk);
+      } else {
+        cv_.wait_until(lk, wait_until);
+      }
+      PromoteDelayedLocked();
+      if (!queue_.empty()) break;
+      if (shutting_down_) return -2;
+      if (timeout_ms >= 0 && Clock::now() >= overall_deadline) return -1;
+    }
+    std::string key = queue_.front();
+    queue_.pop_front();
+    queued_.erase(key);
+    processing_.insert(key);
+    if (static_cast<int>(key.size()) + 1 > buflen) return -3;
+    std::memcpy(buf, key.c_str(), key.size() + 1);
+    return 0;
+  }
+
+  void Done(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    processing_.erase(key);
+    if (dirty_.erase(key)) {
+      AddLocked(key);
+      cv_.notify_one();
+    }
+  }
+
+  int Len() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int>(queue_.size());
+  }
+
+  void ShutDown() {
+    std::lock_guard<std::mutex> lk(mu_);
+    shutting_down_ = true;
+    cv_.notify_all();
+  }
+
+  bool ShuttingDown() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return shutting_down_;
+  }
+
+ private:
+  struct Delayed {
+    Clock::time_point when;
+    std::string key;
+    bool operator>(const Delayed& o) const { return when > o.when; }
+  };
+
+  void AddLocked(const std::string& key) {
+    if (processing_.count(key)) {
+      dirty_.insert(key);  // re-queued after Done()
+      return;
+    }
+    if (queued_.count(key)) return;  // de-dupe
+    queued_.insert(key);
+    queue_.push_back(key);
+  }
+
+  void PromoteDelayedLocked() {
+    auto now = Clock::now();
+    while (!delayed_.empty() && delayed_.top().when <= now) {
+      AddLocked(delayed_.top().key);
+      delayed_.pop();
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::string> queue_;
+  std::set<std::string> queued_;
+  std::set<std::string> processing_;
+  std::set<std::string> dirty_;
+  std::priority_queue<Delayed, std::vector<Delayed>, std::greater<Delayed>> delayed_;
+  std::unordered_map<std::string, int> failures_;
+  int64_t base_delay_ms_;
+  int64_t max_delay_ms_;
+  bool shutting_down_ = false;
+};
+
+// ---------------------------------------------------------------------------
+// ExpectationsCache
+// ---------------------------------------------------------------------------
+
+class Expectations {
+ public:
+  explicit Expectations(int64_t ttl_ms) : ttl_ms_(ttl_ms) {}
+
+  // Accumulates onto any live entry (kubeflow/common RaiseExpectations):
+  // creating N pods in one sync raises the expectation N times; overwriting
+  // would let a single watch event satisfy the whole batch.
+  void Expect(const std::string& key, int adds, int dels) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = entries_.find(key);
+    if (it != entries_.end() && (it->second.adds > 0 || it->second.dels > 0) &&
+        Clock::now() - it->second.created <= Ms(ttl_ms_)) {
+      it->second.adds += adds;
+      it->second.dels += dels;
+    } else {
+      entries_[key] = {adds, dels, Clock::now()};
+    }
+  }
+
+  void ObserveAdd(const std::string& key) { Observe(key, true); }
+  void ObserveDel(const std::string& key) { Observe(key, false); }
+
+  // 1 if satisfied (counters drained, entry expired, or no entry).
+  int Satisfied(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = entries_.find(key);
+    if (it == entries_.end()) return 1;
+    const Entry& e = it->second;
+    if (e.adds <= 0 && e.dels <= 0) return 1;
+    if (Clock::now() - e.created > Ms(ttl_ms_)) return 1;  // expired => resync
+    return 0;
+  }
+
+  void Delete(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    entries_.erase(key);
+  }
+
+ private:
+  struct Entry {
+    int adds = 0;
+    int dels = 0;
+    Clock::time_point created;
+  };
+
+  void Observe(const std::string& key, bool add) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = entries_.find(key);
+    if (it == entries_.end()) return;
+    int& c = add ? it->second.adds : it->second.dels;
+    if (c > 0) --c;
+  }
+
+  std::mutex mu_;
+  std::unordered_map<std::string, Entry> entries_;
+  int64_t ttl_ms_;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* tq_new(int64_t base_delay_ms, int64_t max_delay_ms) {
+  return new WorkQueue(base_delay_ms, max_delay_ms);
+}
+void tq_free(void* h) { delete static_cast<WorkQueue*>(h); }
+void tq_add(void* h, const char* key) { static_cast<WorkQueue*>(h)->Add(key); }
+void tq_add_after(void* h, const char* key, int64_t delay_ms) {
+  static_cast<WorkQueue*>(h)->AddAfter(key, delay_ms);
+}
+void tq_add_rate_limited(void* h, const char* key) {
+  static_cast<WorkQueue*>(h)->AddRateLimited(key);
+}
+void tq_forget(void* h, const char* key) { static_cast<WorkQueue*>(h)->Forget(key); }
+int tq_num_requeues(void* h, const char* key) {
+  return static_cast<WorkQueue*>(h)->NumRequeues(key);
+}
+int tq_get(void* h, int64_t timeout_ms, char* buf, int buflen) {
+  return static_cast<WorkQueue*>(h)->Get(timeout_ms, buf, buflen);
+}
+void tq_done(void* h, const char* key) { static_cast<WorkQueue*>(h)->Done(key); }
+int tq_len(void* h) { return static_cast<WorkQueue*>(h)->Len(); }
+void tq_shutdown(void* h) { static_cast<WorkQueue*>(h)->ShutDown(); }
+int tq_shutting_down(void* h) {
+  return static_cast<WorkQueue*>(h)->ShuttingDown() ? 1 : 0;
+}
+
+void* te_new(int64_t ttl_ms) { return new Expectations(ttl_ms); }
+void te_free(void* h) { delete static_cast<Expectations*>(h); }
+void te_expect(void* h, const char* key, int adds, int dels) {
+  static_cast<Expectations*>(h)->Expect(key, adds, dels);
+}
+void te_observe_add(void* h, const char* key) {
+  static_cast<Expectations*>(h)->ObserveAdd(key);
+}
+void te_observe_del(void* h, const char* key) {
+  static_cast<Expectations*>(h)->ObserveDel(key);
+}
+int te_satisfied(void* h, const char* key) {
+  return static_cast<Expectations*>(h)->Satisfied(key);
+}
+void te_delete(void* h, const char* key) { static_cast<Expectations*>(h)->Delete(key); }
+
+// Restart classification (train_util.go:18-53, authoritative table):
+// 130 (SIGINT), 137 (SIGKILL), 143 (SIGTERM) — infra churn, retryable;
+// 138 (SIGUSR1) — user-defined retryable; everything else permanent.
+int tn_retryable_exit_code(int code) {
+  switch (code) {
+    case 130:
+    case 137:
+    case 138:
+    case 143:
+      return 1;
+    default:
+      return 0;
+  }
+}
+
+const char* tn_version() { return "tpujob-native-0.1.0"; }
+
+}  // extern "C"

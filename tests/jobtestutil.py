@@ -1,0 +1,138 @@
+"""Test fixtures mirroring the reference's pkg/common/util/v1/testutil:
+job builders for every policy knob, synthetic pods with chosen phases and
+restart counts pushed into the cluster, condition assertions.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from tpujob.api import constants as c
+from tpujob.api.types import TPUJob
+from tpujob.controller.job_base import ControllerConfig
+from tpujob.controller.reconciler import TPUJobController
+from tpujob.kube.client import ClientSet
+from tpujob.kube.control import gen_general_name, gen_labels
+from tpujob.kube.memserver import InMemoryAPIServer
+
+
+def new_tpujob(
+    name: str = "test-job",
+    ns: str = "default",
+    master: Optional[int] = 1,
+    workers: int = 3,
+    clean_pod_policy: Optional[str] = None,
+    backoff_limit: Optional[int] = None,
+    active_deadline: Optional[int] = None,
+    ttl: Optional[int] = None,
+    restart_policy: Optional[str] = None,
+    accelerator: Optional[str] = None,
+    num_slices: int = 1,
+) -> TPUJob:
+    """Job builder (testutil/job.go:28-120 equivalent)."""
+    tmpl = {"spec": {"containers": [{"name": "tpu", "image": "tpujob/test:latest"}]}}
+    specs = {}
+    if master is not None:
+        specs["Master"] = {"replicas": master, "template": tmpl}
+        if accelerator:
+            specs["Master"]["tpu"] = {"accelerator": accelerator, "numSlices": num_slices}
+    if workers:
+        specs["Worker"] = {"replicas": workers, "template": tmpl}
+        if accelerator and master is None:
+            specs["Worker"]["tpu"] = {"accelerator": accelerator, "numSlices": num_slices}
+    if restart_policy:
+        for s in specs.values():
+            s["restartPolicy"] = restart_policy
+    spec = {"tpuReplicaSpecs": specs}
+    if clean_pod_policy is not None:
+        spec["cleanPodPolicy"] = clean_pod_policy
+    if backoff_limit is not None:
+        spec["backoffLimit"] = backoff_limit
+    if active_deadline is not None:
+        spec["activeDeadlineSeconds"] = active_deadline
+    if ttl is not None:
+        spec["ttlSecondsAfterFinished"] = ttl
+    return TPUJob.from_dict({"metadata": {"name": name, "namespace": ns}, "spec": spec})
+
+
+class Harness:
+    """In-memory cluster + controller with deterministic sync stepping."""
+
+    def __init__(self, config: Optional[ControllerConfig] = None):
+        self.server = InMemoryAPIServer()
+        self.clients = ClientSet(self.server)
+        self.controller = TPUJobController(self.clients, config=config)
+
+    def submit(self, job: TPUJob) -> TPUJob:
+        return self.clients.tpujobs.create(job)
+
+    def sync(self, key: Optional[str] = None, rounds: int = 3) -> None:
+        """Drain informer events and run sync_handler until stable."""
+        for _ in range(rounds):
+            self.controller.factory.sync_all()
+            keys = (
+                [key]
+                if key
+                else [
+                    f"{(o.get('metadata') or {}).get('namespace') or 'default'}/"
+                    f"{(o.get('metadata') or {}).get('name')}"
+                    for o in self.controller.job_informer.store.list()
+                ]
+            )
+            for k in keys:
+                self.controller.sync_handler(k)
+        self.controller.factory.sync_all()
+
+    # -- simulated kubelet ---------------------------------------------------
+
+    def set_pod_phase(
+        self,
+        job_name: str,
+        rtype: str,
+        index: int,
+        phase: str,
+        exit_code: Optional[int] = None,
+        restart_count: int = 0,
+        ns: str = "default",
+    ) -> None:
+        name = gen_general_name(job_name, rtype, index)
+        pod = self.clients.pods.get(ns, name)
+        pod.status.phase = phase
+        cs = {
+            "name": c.DEFAULT_CONTAINER_NAME,
+            "restartCount": restart_count,
+            "ready": phase == "Running",
+        }
+        if exit_code is not None:
+            cs["state"] = {"terminated": {"exitCode": exit_code}}
+        pod.status.container_statuses = [
+            type(pod.status).from_dict({"containerStatuses": [cs]}).container_statuses[0]
+        ]
+        self.clients.pods.update_status(pod)
+
+    def set_all_phases(self, job_name: str, phase: str, master: int = 1, workers: int = 3) -> None:
+        for i in range(master):
+            self.set_pod_phase(job_name, c.REPLICA_TYPE_MASTER, i, phase)
+        for i in range(workers):
+            self.set_pod_phase(job_name, c.REPLICA_TYPE_WORKER, i, phase)
+
+    # -- assertions ----------------------------------------------------------
+
+    def get_job(self, name: str = "test-job", ns: str = "default") -> TPUJob:
+        return self.clients.tpujobs.get(ns, name)
+
+    def pod_names(self, ns: str = "default"):
+        return sorted(p.metadata.name for p in self.clients.pods.list(ns))
+
+    def check_condition(self, job: TPUJob, cond_type: str, reason_part: str = "") -> bool:
+        """testutil/util.go:91-98 equivalent."""
+        for cond in job.status.conditions:
+            if cond.type == cond_type and cond.status == "True":
+                if not reason_part or reason_part in cond.reason:
+                    return True
+        return False
+
+
+def expected_pod_names(job_name: str, master: int = 1, workers: int = 3):
+    names = [gen_general_name(job_name, c.REPLICA_TYPE_MASTER, i) for i in range(master)]
+    names += [gen_general_name(job_name, c.REPLICA_TYPE_WORKER, i) for i in range(workers)]
+    return sorted(names)

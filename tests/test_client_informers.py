@@ -1,0 +1,163 @@
+"""Typed clients, informers/listers, pod/service control."""
+import threading
+import time
+
+from tpujob.api.types import TPUJob
+from tpujob.kube.client import RESOURCE_PODS, RESOURCE_TPUJOBS, ClientSet
+from tpujob.kube.control import (
+    EventRecorder,
+    FakePodControl,
+    PodControl,
+    ServiceControl,
+    gen_general_name,
+    gen_labels,
+    gen_owner_reference,
+)
+from tpujob.kube.informers import InformerFactory
+from tpujob.kube.memserver import InMemoryAPIServer
+from tpujob.kube.objects import Container, ObjectMeta, Pod, PodSpec, Service, ServiceSpec
+
+
+def make_job(name="j", ns="default"):
+    return TPUJob.from_dict(
+        {
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "tpuReplicaSpecs": {
+                    "Master": {
+                        "replicas": 1,
+                        "template": {"spec": {"containers": [{"name": "tpu", "image": "img"}]}},
+                    }
+                }
+            },
+        }
+    )
+
+
+def test_typed_tpujob_crud_and_status():
+    clients = ClientSet(InMemoryAPIServer())
+    job = clients.tpujobs.create(make_job())
+    assert job.metadata.uid
+    job.status.start_time = "2026-01-01T00:00:00Z"
+    updated = clients.tpujobs.update_status(job)
+    assert updated.status.start_time == "2026-01-01T00:00:00Z"
+    got = clients.tpujobs.get("default", "j")
+    assert got.status.start_time == "2026-01-01T00:00:00Z"
+    assert got.spec.tpu_replica_specs["Master"].replicas == 1
+    clients.tpujobs.delete("default", "j")
+    assert clients.tpujobs.list() == []
+
+
+def test_informer_sync_once_deterministic():
+    server = InMemoryAPIServer()
+    clients = ClientSet(server)
+    clients.tpujobs.create(make_job("a"))
+    factory = InformerFactory(server)
+    inf = factory.informer(RESOURCE_TPUJOBS)
+    adds, updates, deletes = [], [], []
+    inf.on_add(lambda o: adds.append(o["metadata"]["name"]))
+    inf.on_update(lambda o, n: updates.append(n["metadata"]["name"]))
+    inf.on_delete(lambda o: deletes.append(o["metadata"]["name"]))
+
+    inf.sync_once()  # initial list
+    assert adds == ["a"] and inf.has_synced()
+    clients.tpujobs.create(make_job("b"))
+    job_a = clients.tpujobs.get("default", "a")
+    clients.tpujobs.update_status(job_a)
+    clients.tpujobs.delete("default", "b")
+    n = inf.sync_once()
+    assert n == 3
+    assert adds == ["a", "b"]
+    assert updates == ["a"]
+    assert deletes == ["b"]
+    # lister view matches server
+    assert {o["metadata"]["name"] for o in inf.store.list()} == {"a"}
+
+
+def test_informer_threaded_run():
+    server = InMemoryAPIServer()
+    clients = ClientSet(server)
+    factory = InformerFactory(server)
+    inf = factory.informer(RESOURCE_PODS)
+    seen = []
+    done = threading.Event()
+
+    def on_add(o):
+        seen.append(o["metadata"]["name"])
+        if len(seen) == 3:
+            done.set()
+
+    inf.on_add(on_add)
+    stop = threading.Event()
+    factory.start(stop)
+    assert factory.wait_for_cache_sync()
+    for i in range(3):
+        clients.pods.create(Pod(metadata=ObjectMeta(name=f"p{i}")))
+    assert done.wait(3)
+    stop.set()
+    factory.stop()
+    assert sorted(seen) == ["p0", "p1", "p2"]
+
+
+def test_pod_control_owner_refs_and_events():
+    server = InMemoryAPIServer()
+    clients = ClientSet(server)
+    job = clients.tpujobs.create(make_job())
+    recorder = EventRecorder(clients)
+    pc = PodControl(clients, recorder)
+    pod = Pod(
+        metadata=ObjectMeta(name=gen_general_name("j", "Master", 0), labels=gen_labels("j")),
+        spec=PodSpec(containers=[Container(name="tpu", image="img")]),
+    )
+    created = pc.create_pod("default", pod, job)
+    ref = created.metadata.owner_references[0]
+    assert ref.uid == job.metadata.uid and ref.controller and ref.block_owner_deletion
+    assert created.metadata.labels["tpu-job-name"] == "j"
+    evs = clients.events.list()
+    assert any(e.reason == "SuccessfulCreatePod" for e in evs)
+    pc.delete_pod("default", "j-master-0", job)
+    assert clients.pods.list() == []
+    assert any(e.reason == "SuccessfulDeletePod" for e in clients.events.list())
+
+
+def test_service_control_and_gc():
+    server = InMemoryAPIServer()
+    clients = ClientSet(server)
+    job = clients.tpujobs.create(make_job())
+    recorder = EventRecorder(clients)
+    sc = ServiceControl(clients, recorder)
+    svc = Service(
+        metadata=ObjectMeta(name="j-master-0"),
+        spec=ServiceSpec(cluster_ip="None", selector=gen_labels("j")),
+    )
+    sc.create_service("default", svc, job)
+    # deleting the job GCs the owned service
+    clients.tpujobs.delete("default", "j")
+    assert clients.services.list() == []
+
+
+def test_fake_pod_control_records():
+    fake = FakePodControl()
+    job = make_job()
+    job.metadata.uid = "u1"
+    fake.create_pod("default", Pod(metadata=ObjectMeta(name="p")), job)
+    fake.delete_pod("default", "p", job)
+    assert [p.metadata.name for p in fake.templates] == ["p"]
+    assert fake.deleted == [("default", "p")]
+    fake.create_limit = 1
+    try:
+        fake.create_pod("default", Pod(metadata=ObjectMeta(name="q")), job)
+        raised = False
+    except RuntimeError:
+        raised = True
+    assert raised
+
+
+def test_owner_reference_generation():
+    job = make_job()
+    job.metadata.uid = "u-123"
+    ref = gen_owner_reference(job)
+    assert ref.api_version == "tpujob.dev/v1"
+    assert ref.kind == "TPUJob"
+    assert ref.uid == "u-123"
+    assert ref.controller is True and ref.block_owner_deletion is True

@@ -1,0 +1,445 @@
+"""Reconciler behavior, table-driven against the in-memory cluster.
+
+Mirrors the reference's controller unit-test strategy (SURVEY.md §4 tier 2):
+the cluster is simulated state; reconcile is exercised as a state machine.
+"""
+import time
+
+from tpujob.api import constants as c
+from tpujob.controller.job_base import ControllerConfig
+from tpujob.controller.reconciler import get_port_from_job, get_total_replicas
+
+from jobtestutil import Harness, expected_pod_names, new_tpujob
+
+
+def test_create_pods_and_master_service():
+    h = Harness()
+    h.submit(new_tpujob())
+    h.sync()
+    assert h.pod_names() == expected_pod_names("test-job")
+    svcs = h.clients.services.list()
+    assert [s.metadata.name for s in svcs] == ["test-job-master-0"]
+    assert svcs[0].spec.cluster_ip == "None"
+    assert svcs[0].spec.selector[c.LABEL_REPLICA_TYPE] == "master"
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_CREATED)
+
+
+def test_pod_labels_owner_refs_and_restart_policy():
+    h = Harness()
+    h.submit(new_tpujob(restart_policy="ExitCode"))
+    h.sync()
+    job = h.get_job()
+    pod = h.clients.pods.get("default", "test-job-worker-1")
+    assert pod.metadata.labels[c.LABEL_REPLICA_TYPE] == "worker"
+    assert pod.metadata.labels[c.LABEL_REPLICA_INDEX] == "1"
+    assert pod.metadata.labels[c.LABEL_JOB_NAME] == "test-job"
+    ref = pod.metadata.owner_references[0]
+    assert ref.uid == job.metadata.uid and ref.controller
+    # ExitCode forces pod-level Never (pod.go:283-289)
+    assert pod.spec.restart_policy == "Never"
+
+
+def test_env_injection_flat_job():
+    """No TPU spec: reference-parity WORLD_SIZE/RANK accounting."""
+    h = Harness()
+    h.submit(new_tpujob())
+    h.sync()
+    menv = {e.name: e.value for e in
+            h.clients.pods.get("default", "test-job-master-0").spec.containers[0].env}
+    assert menv["MASTER_ADDR"] == "localhost"
+    assert menv["WORLD_SIZE"] == "4"
+    assert menv["RANK"] == "0"
+    wenv = {e.name: e.value for e in
+            h.clients.pods.get("default", "test-job-worker-2").spec.containers[0].env}
+    assert wenv["MASTER_ADDR"] == "test-job-master-0.default"
+    assert wenv["RANK"] == "3"
+    assert wenv["MASTER_PORT"] == str(get_port_from_job(h.get_job(), "Master"))
+
+
+def test_env_injection_tpu_slice():
+    """v4-32 slice: process world = hosts, libtpu + PJRT env present."""
+    h = Harness()
+    h.submit(new_tpujob(accelerator="v4-32", workers=3))
+    h.sync()
+    wenv = {e.name: e.value for e in
+            h.clients.pods.get("default", "test-job-worker-0").spec.containers[0].env}
+    assert wenv["PJRT_DEVICE"] == "TPU"
+    assert wenv["TPUJOB_NUM_PROCESSES"] == "4"  # 4 hosts on v4-32
+    assert wenv["TPUJOB_PROCESS_ID"] == "1"
+    assert wenv["TPU_WORKER_ID"] == "1"
+    assert wenv["TPU_ACCELERATOR_TYPE"] == "v4-32"
+    assert wenv["WORLD_SIZE"] == "4"
+    hostnames = wenv["TPU_WORKER_HOSTNAMES"].split(",")
+    assert hostnames[0] == "test-job-master-0"
+    assert hostnames[3] == "test-job-worker-2"
+    assert "MEGASCALE_COORDINATOR_ADDRESS" not in wenv
+    # TPU scheduling applied
+    pod = h.clients.pods.get("default", "test-job-worker-0")
+    assert pod.spec.node_selector[c.TPU_ACCELERATOR_NODE_SELECTOR] == "v4-32"
+    assert pod.spec.containers[0].resources.limits[c.TPU_RESOURCE] == 4
+
+
+def test_env_injection_multislice():
+    h = Harness()
+    h.submit(new_tpujob(accelerator="v4-32", workers=7, num_slices=2))
+    h.sync()
+    wenv = {e.name: e.value for e in
+            h.clients.pods.get("default", "test-job-worker-4").spec.containers[0].env}
+    # worker 4 = process 5 => slice 1, host 1
+    assert wenv["TPUJOB_NUM_PROCESSES"] == "8"
+    assert wenv["MEGASCALE_NUM_SLICES"] == "2"
+    assert wenv["MEGASCALE_SLICE_ID"] == "1"
+    assert wenv["TPU_WORKER_ID"] == "1"
+
+
+def test_worker_init_container_dns_gate():
+    h = Harness()
+    h.submit(new_tpujob())
+    h.sync()
+    worker = h.clients.pods.get("default", "test-job-worker-0")
+    assert worker.spec.init_containers, "worker must gate on coordinator DNS"
+    cmd = " ".join(worker.spec.init_containers[0].command)
+    assert "test-job-master-0.default" in cmd
+    master = h.clients.pods.get("default", "test-job-master-0")
+    assert not master.spec.init_containers
+
+
+def test_user_env_wins_over_injected():
+    h = Harness()
+    from tpujob.kube.objects import EnvVar
+
+    job = new_tpujob()
+    job.spec.tpu_replica_specs["Master"].template.spec.containers[0].env.append(
+        EnvVar(name="MASTER_ADDR", value="custom-host")
+    )
+    h.submit(job)
+    h.sync()
+    env = {e.name: e.value for e in
+           h.clients.pods.get("default", "test-job-master-0").spec.containers[0].env}
+    assert env["MASTER_ADDR"] == "custom-host"
+
+
+def test_running_then_succeeded_master_semantics():
+    h = Harness()
+    h.submit(new_tpujob())
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_RUNNING)
+    assert job.status.replica_statuses["Master"].active == 1
+    assert job.status.replica_statuses["Worker"].active == 3
+    assert job.status.start_time
+
+    # master completes => job Succeeded even if workers still run (status.go:99-112)
+    h.set_pod_phase("test-job", "Master", 0, "Succeeded")
+    h.sync()
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_SUCCEEDED)
+    assert job.status.completion_time
+    running = [x for x in job.status.conditions if x.type == c.JOB_RUNNING]
+    assert running and running[0].status == "False"  # flipped, not dropped
+
+
+def test_worker_failure_permanent_fails_job():
+    h = Harness()
+    h.submit(new_tpujob(restart_policy="ExitCode"))
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    h.set_pod_phase("test-job", "Worker", 1, "Failed", exit_code=1)
+    h.sync()
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_FAILED)
+    assert job.status.replica_statuses["Worker"].failed == 1
+
+
+def test_exit_code_retryable_restarts():
+    h = Harness()
+    h.submit(new_tpujob(restart_policy="ExitCode"))
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    # SIGKILL 137: TPU-VM preemption → pod deleted and recreated
+    h.set_pod_phase("test-job", "Worker", 1, "Failed", exit_code=137)
+    h.sync(rounds=1)  # the sync that observes the failure
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_RESTARTING)
+    assert not h.check_condition(job, c.JOB_FAILED)
+    assert not h.check_condition(job, c.JOB_RUNNING)  # Restarting excludes Running
+    # further syncs: pod recreated fresh, job converges back to Running
+    h.sync()
+    job = h.get_job()
+    pod = h.clients.pods.get("default", "test-job-worker-1")
+    assert pod.status.phase == "Pending"
+    assert h.check_condition(job, c.JOB_RUNNING)  # master still active
+    assert not h.check_condition(job, c.JOB_RESTARTING)
+    assert not h.check_condition(job, c.JOB_FAILED)
+
+
+def test_backoff_limit_exceeded():
+    h = Harness()
+    h.submit(new_tpujob(backoff_limit=2, restart_policy="OnFailure", clean_pod_policy="All"))
+    h.sync()
+    h.set_pod_phase("test-job", "Worker", 0, "Running", restart_count=2)
+    h.sync()
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_FAILED)
+    assert "backoff limit" in [x for x in job.status.conditions if x.type == c.JOB_FAILED][0].message
+    assert h.pod_names() == []  # CleanPodPolicy All
+
+
+def test_active_deadline_exceeded():
+    h = Harness()
+    h.submit(new_tpujob(active_deadline=0))
+    h.sync()
+    # force a start time in the past then resync
+    job = h.get_job()
+    job.status.start_time = "2020-01-01T00:00:00Z"
+    h.clients.tpujobs.update_status(job)
+    h.sync()
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_FAILED)
+    assert "deadline" in [x for x in job.status.conditions if x.type == c.JOB_FAILED][0].message
+
+
+def test_clean_pod_policy_running_keeps_finished():
+    h = Harness()
+    h.submit(new_tpujob(clean_pod_policy="Running"))
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.set_pod_phase("test-job", "Worker", 2, "Succeeded")
+    h.set_pod_phase("test-job", "Master", 0, "Succeeded")
+    h.sync()
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_SUCCEEDED)
+    # Running workers deleted; succeeded pods kept
+    names = h.pod_names()
+    assert "test-job-worker-2" in names
+    assert "test-job-worker-0" not in names and "test-job-worker-1" not in names
+
+
+def test_clean_pod_policy_none_keeps_all():
+    h = Harness()
+    h.submit(new_tpujob(clean_pod_policy="None"))
+    h.sync()
+    h.set_all_phases("test-job", "Succeeded")
+    h.sync()
+    assert len(h.pod_names()) == 4
+
+
+def test_ttl_deletes_job():
+    h = Harness()
+    h.submit(new_tpujob(ttl=0))
+    h.sync()
+    h.set_all_phases("test-job", "Succeeded")
+    h.sync()
+    # terminal + ttl=0 → job deleted; GC cascades to pods
+    assert h.clients.tpujobs.list() == []
+    assert h.pod_names() == []
+
+
+def test_gang_scheduling_pod_group():
+    h = Harness(config=ControllerConfig(enable_gang_scheduling=True))
+    h.submit(new_tpujob())
+    h.sync()
+    pg = h.clients.podgroups.get("default", "test-job")
+    assert pg.spec.min_member == 4  # all hosts of the slice gang together
+    pod = h.clients.pods.get("default", "test-job-worker-0")
+    assert pod.spec.scheduler_name == "volcano"
+    assert pod.metadata.annotations[c.POD_GROUP_ANNOTATION] == "test-job"
+    # terminal → podgroup removed
+    h.set_all_phases("test-job", "Succeeded")
+    h.sync()
+    assert h.clients.podgroups.list() == []
+
+
+def test_orphan_adoption():
+    h = Harness()
+    job = h.submit(new_tpujob(workers=1))
+    # an orphan pod matching the selector labels exists before sync
+    from tpujob.kube.objects import Container, ObjectMeta, Pod, PodSpec
+    from tpujob.kube.control import gen_labels
+
+    labels = gen_labels("test-job")
+    labels[c.LABEL_REPLICA_TYPE] = "worker"
+    labels[c.LABEL_REPLICA_INDEX] = "0"
+    orphan = Pod(metadata=ObjectMeta(name="test-job-worker-0", labels=labels),
+                 spec=PodSpec(containers=[Container(name="tpu", image="x")]))
+    h.clients.pods.create(orphan)
+    h.sync()
+    pod = h.clients.pods.get("default", "test-job-worker-0")
+    assert pod.metadata.owner_references
+    assert pod.metadata.owner_references[0].uid == job.metadata.uid
+    # not recreated: still exactly 1 worker + 1 master
+    assert len(h.pod_names()) == 2
+
+
+def test_expectations_block_double_create():
+    """Stale informer cache must not cause duplicate pod creation."""
+    h = Harness()
+    h.submit(new_tpujob(workers=1))
+    h.controller.factory.sync_all()
+    key = "default/test-job"
+    h.controller.sync_handler(key)  # creates pods; expectations pending
+    # informer NOT synced: cache still shows zero pods. second sync must be a no-op
+    h.controller.sync_handler(key)
+    assert len(h.clients.pods.list()) == 2  # master + worker, no dupes
+
+
+def test_invalid_job_gets_failed_condition():
+    h = Harness()
+    bad = new_tpujob()
+    bad.spec.tpu_replica_specs["Master"].template.spec.containers[0].name = "wrong"
+    h.submit(bad)
+    h.sync()
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_FAILED)
+    assert "container named 'tpu'" in job.status.conditions[-1].message
+    assert h.pod_names() == []  # nothing scheduled
+
+
+def test_malformed_cr_tolerated():
+    """A structurally-broken CR must not crash the controller (informer.go:83-104)."""
+    h = Harness()
+    h.server.create("tpujobs", {"metadata": {"name": "broken"}, "spec": "garbage"})
+    h.sync()  # no exception
+    job_dict = h.server.get("tpujobs", "default", "broken")
+    conds = (job_dict.get("status") or {}).get("conditions") or []
+    assert any(x["type"] == c.JOB_FAILED for x in conds)
+
+
+def test_total_replicas_and_port_helpers():
+    job = new_tpujob(master=1, workers=7)
+    assert get_total_replicas(job) == 8
+    assert get_port_from_job(job, "Master") == c.DEFAULT_PORT
+
+
+def test_status_update_skipped_when_unchanged():
+    h = Harness()
+    h.submit(new_tpujob())
+    h.sync()
+    rv1 = h.server.get("tpujobs", "default", "test-job")["metadata"]["resourceVersion"]
+    h.sync(rounds=2)  # nothing changed; no status write
+    rv2 = h.server.get("tpujobs", "default", "test-job")["metadata"]["resourceVersion"]
+    assert rv1 == rv2
+
+
+def test_threaded_run_loop_end_to_end():
+    """Full async mode: informer threads + worker threads + simulated kubelet."""
+    import threading
+    import time as _time
+
+    h = Harness()
+    stop = threading.Event()
+    h.controller.run(stop, threadiness=2)
+    try:
+        h.submit(new_tpujob(workers=2))
+        # wait for the controller to create all pods
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline:
+            if len(h.clients.pods.list()) == 3:
+                break
+            _time.sleep(0.02)
+        assert len(h.clients.pods.list()) == 3
+        # kubelet: everything runs, then master completes
+        for name in ["test-job-master-0", "test-job-worker-0", "test-job-worker-1"]:
+            pod = h.clients.pods.get("default", name)
+            pod.status.phase = "Running"
+            h.clients.pods.update_status(pod)
+        _time.sleep(0.1)
+        pod = h.clients.pods.get("default", "test-job-master-0")
+        pod.status.phase = "Succeeded"
+        h.clients.pods.update_status(pod)
+        deadline = _time.monotonic() + 5
+        ok = False
+        while _time.monotonic() < deadline:
+            job = h.get_job()
+            if h.check_condition(job, c.JOB_SUCCEEDED):
+                ok = True
+                break
+            _time.sleep(0.02)
+        assert ok, f"job never succeeded: {[x.type for x in h.get_job().status.conditions]}"
+    finally:
+        stop.set()
+        h.controller.queue.shutdown()
+        h.controller.factory.stop()
+
+
+def test_worker_only_job_gets_coordinator_service():
+    """Master-less jobs: worker-0 coordinates; a headless service fronts it."""
+    h = Harness()
+    h.submit(new_tpujob(master=None, workers=3))
+    h.sync()
+    svcs = h.clients.services.list()
+    assert [s.metadata.name for s in svcs] == ["test-job-worker-0"]
+    w0 = {e.name: e.value for e in
+          h.clients.pods.get("default", "test-job-worker-0").spec.containers[0].env}
+    assert w0["MASTER_ADDR"] == "localhost"  # coordinator resolves itself
+    assert w0["RANK"] == "0"
+    w2 = {e.name: e.value for e in
+          h.clients.pods.get("default", "test-job-worker-2").spec.containers[0].env}
+    assert w2["MASTER_ADDR"] == "test-job-worker-0.default"
+    assert w2["RANK"] == "2"
+    # worker-0 must not gate on itself; worker-2 gates on worker-0 DNS
+    assert not h.clients.pods.get("default", "test-job-worker-0").spec.init_containers
+    ics = h.clients.pods.get("default", "test-job-worker-2").spec.init_containers
+    assert ics and "test-job-worker-0.default" in " ".join(ics[0].command)
+    # completes via worker semantics
+    for i in range(3):
+        h.set_pod_phase("test-job", "Worker", i, "Succeeded")
+    h.sync()
+    assert h.check_condition(h.get_job(), c.JOB_SUCCEEDED)
+
+
+def test_multislice_hostnames_are_per_slice():
+    h = Harness()
+    h.submit(new_tpujob(accelerator="v4-32", workers=7, num_slices=2))
+    h.sync()
+    # slice 0 host 2 = worker-1; slice 1 host 2 = worker-5
+    w1 = {e.name: e.value for e in
+          h.clients.pods.get("default", "test-job-worker-1").spec.containers[0].env}
+    w5 = {e.name: e.value for e in
+          h.clients.pods.get("default", "test-job-worker-5").spec.containers[0].env}
+    assert w1["TPU_WORKER_ID"] == w5["TPU_WORKER_ID"] == "2"
+    assert w1["TPU_WORKER_HOSTNAMES"] == \
+        "test-job-master-0,test-job-worker-0,test-job-worker-1,test-job-worker-2"
+    assert w5["TPU_WORKER_HOSTNAMES"] == \
+        "test-job-worker-3,test-job-worker-4,test-job-worker-5,test-job-worker-6"
+    assert w1["MEGASCALE_SLICE_ID"] == "0" and w5["MEGASCALE_SLICE_ID"] == "1"
+
+
+def test_topology_replica_mismatch_fails_cleanly():
+    """Incoherent slice accounting must produce Failed, not a crash loop."""
+    h = Harness()
+    h.submit(new_tpujob(accelerator="v4-16", workers=4))  # v4-16: 2 hosts, needs 1+1
+    h.sync()
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_FAILED)
+    assert h.pod_names() == []
+
+
+def test_batch_create_expectations_accumulate():
+    """Creating N pods in one sync must raise expectations N times; a stale
+    cache with one observed event must still block re-creation."""
+    h = Harness()
+    h.submit(new_tpujob(workers=5))
+    h.controller.factory.sync_all()
+    key = "default/test-job"
+    h.controller.sync_handler(key)  # creates 6 pods, expectations 1+5
+    assert len(h.clients.pods.list()) == 6
+    # informer cache NOT refreshed: repeated syncs must not duplicate
+    h.controller.sync_handler(key)
+    h.controller.sync_handler(key)
+    assert len(h.clients.pods.list()) == 6
+
+
+def test_malformed_cr_does_not_busy_loop():
+    h = Harness()
+    h.server.create("tpujobs", {"metadata": {"name": "broken"}, "spec": "garbage"})
+    h.sync()
+    rv1 = h.server.get("tpujobs", "default", "broken")["metadata"]["resourceVersion"]
+    h.sync(rounds=5)  # further syncs must not rewrite status
+    rv2 = h.server.get("tpujobs", "default", "broken")["metadata"]["resourceVersion"]
+    assert rv1 == rv2

@@ -1,0 +1,147 @@
+"""In-memory API server semantics: CRUD, optimistic concurrency, watch, GC."""
+import threading
+
+import pytest
+
+from tpujob.kube.errors import AlreadyExistsError, ConflictError, NotFoundError
+from tpujob.kube.memserver import ADDED, DELETED, MODIFIED, InMemoryAPIServer
+
+
+def pod(name, ns="default", labels=None, owner_uid=None):
+    d = {"kind": "Pod", "metadata": {"name": name, "namespace": ns}}
+    if labels:
+        d["metadata"]["labels"] = labels
+    if owner_uid:
+        d["metadata"]["ownerReferences"] = [{"uid": owner_uid, "controller": True}]
+    return d
+
+
+def test_create_get_assigns_meta():
+    s = InMemoryAPIServer()
+    created = s.create("pods", pod("a"))
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"]
+    assert created["metadata"]["creationTimestamp"]
+    got = s.get("pods", "default", "a")
+    assert got["metadata"]["uid"] == created["metadata"]["uid"]
+    with pytest.raises(AlreadyExistsError):
+        s.create("pods", pod("a"))
+    with pytest.raises(NotFoundError):
+        s.get("pods", "default", "missing")
+
+
+def test_list_label_selector_and_namespace():
+    s = InMemoryAPIServer()
+    s.create("pods", pod("a", labels={"app": "x", "idx": "0"}))
+    s.create("pods", pod("b", labels={"app": "x", "idx": "1"}))
+    s.create("pods", pod("c", ns="other", labels={"app": "x"}))
+    s.create("pods", pod("d", labels={"app": "y"}))
+    assert len(s.list("pods")) == 4
+    assert len(s.list("pods", namespace="default")) == 3
+    assert len(s.list("pods", label_selector={"app": "x"})) == 3
+    assert len(s.list("pods", namespace="default", label_selector={"app": "x"})) == 2
+    assert len(s.list("pods", label_selector={"app": "x", "idx": "1"})) == 1
+
+
+def test_update_conflict_on_stale_rv():
+    s = InMemoryAPIServer()
+    created = s.create("pods", pod("a"))
+    fresh = dict(created)
+    fresh["spec"] = {"nodeName": "n1"}
+    updated = s.update("pods", fresh)
+    assert updated["metadata"]["resourceVersion"] != created["metadata"]["resourceVersion"]
+    # stale write loses
+    stale = dict(created)
+    stale["spec"] = {"nodeName": "n2"}
+    with pytest.raises(ConflictError):
+        s.update("pods", stale)
+    # rv-less write is allowed (server-side apply style)
+    stale.pop("metadata")
+    stale["metadata"] = {"name": "a", "namespace": "default"}
+    s.update("pods", stale)
+
+
+def test_update_status_subresource_only_touches_status():
+    s = InMemoryAPIServer()
+    s.create("tpujobs", {"metadata": {"name": "j"}, "spec": {"x": 1}})
+    out = s.update_status(
+        "tpujobs", {"metadata": {"name": "j"}, "spec": {"x": 999}, "status": {"phase": "Running"}}
+    )
+    assert out["status"] == {"phase": "Running"}
+    assert out["spec"] == {"x": 1}  # spec change via status subresource ignored
+
+
+def test_patch_merges_recursively():
+    s = InMemoryAPIServer()
+    s.create("tpujobs", {"metadata": {"name": "j", "labels": {"a": "1"}}, "spec": {"k": {"x": 1, "y": 2}}})
+    out = s.patch("tpujobs", "default", "j", {"spec": {"k": {"y": 3}}, "metadata": {"labels": {"b": "2"}}})
+    assert out["spec"]["k"] == {"x": 1, "y": 3}
+    assert out["metadata"]["labels"] == {"a": "1", "b": "2"}
+
+
+def test_watch_stream_and_types():
+    s = InMemoryAPIServer()
+    w = s.watch("pods")
+    s.create("pods", pod("a"))
+    obj = s.get("pods", "default", "a")
+    obj["spec"] = {"nodeName": "n"}
+    s.update("pods", obj)
+    s.delete("pods", "default", "a")
+    evs = [w.poll(timeout=1) for _ in range(3)]
+    assert [e.type for e in evs] == [ADDED, MODIFIED, DELETED]
+    assert all(e.resource == "pods" for e in evs)
+    w.stop()
+
+
+def test_watch_initial_state():
+    s = InMemoryAPIServer()
+    s.create("pods", pod("a"))
+    w = s.watch("pods", send_initial=True)
+    ev = w.poll(timeout=1)
+    assert ev.type == ADDED and ev.object["metadata"]["name"] == "a"
+    w.stop()
+
+
+def test_cascade_gc():
+    s = InMemoryAPIServer()
+    job = s.create("tpujobs", {"metadata": {"name": "j"}})
+    uid = job["metadata"]["uid"]
+    s.create("pods", pod("j-master-0", owner_uid=uid))
+    s.create("pods", pod("j-worker-0", owner_uid=uid))
+    s.create("pods", pod("unowned"))
+    s.create("services", pod("j-master-0", owner_uid=uid) | {"kind": "Service"})
+    s.delete("tpujobs", "default", "j")
+    assert [p["metadata"]["name"] for p in s.list("pods")] == ["unowned"]
+    assert s.list("services") == []
+
+
+def test_deepcopy_isolation():
+    s = InMemoryAPIServer()
+    d = pod("a")
+    s.create("pods", d)
+    d["metadata"]["name"] = "mutated"
+    got = s.get("pods", "default", "a")
+    got["metadata"]["labels"] = {"x": "y"}
+    assert s.get("pods", "default", "a")["metadata"].get("labels") is None
+
+
+def test_concurrent_writers():
+    s = InMemoryAPIServer()
+    errs = []
+
+    def writer(i):
+        try:
+            for k in range(50):
+                s.create("pods", pod(f"p-{i}-{k}"))
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(s.list("pods")) == 400
+    rvs = [int(p["metadata"]["resourceVersion"]) for p in s.list("pods")]
+    assert len(set(rvs)) == 400  # rv strictly monotonic/unique

@@ -1,0 +1,217 @@
+"""Controller kernel semantics — runs against BOTH backends (C++ and Python).
+
+Covers client-go workqueue semantics (de-dupe, dirty re-queue, delayed adds,
+per-item exponential backoff) and the expectations cache the reconciler
+gates on (reference: vendor/.../jobcontroller/jobcontroller.go:108-131).
+"""
+import threading
+import time
+
+import pytest
+
+import tpujob.runtime as rt
+from tpujob.runtime.pyfallback import PyExpectations, PyWorkQueue, py_retryable_exit_code
+
+BACKENDS = ["python"]
+if rt.NATIVE_AVAILABLE:
+    BACKENDS.append("native")
+
+
+def make_queue(backend, **kw):
+    if backend == "native":
+        return rt._NativeWorkQueue(**kw)
+    return PyWorkQueue(**kw)
+
+
+def make_exp(backend, **kw):
+    if backend == "native":
+        return rt._NativeExpectations(**kw)
+    return PyExpectations(**kw)
+
+
+@pytest.fixture(params=BACKENDS)
+def backend(request):
+    return request.param
+
+
+def test_native_lib_loaded():
+    # the build step ran; native must actually be in use in this checkout
+    assert rt.NATIVE_AVAILABLE, "libtpujob_native.so should be built (make -C native)"
+    assert rt.native_version.startswith("tpujob-native")
+
+
+def test_add_get_done(backend):
+    q = make_queue(backend)
+    q.add("a")
+    q.add("b")
+    q.add("a")  # de-duped while queued
+    assert len(q) == 2
+    assert q.get(timeout=1) == "a"
+    assert q.get(timeout=1) == "b"
+    assert q.get(timeout=0.05) is None
+    q.done("a")
+    q.done("b")
+
+
+def test_dirty_requeue_while_processing(backend):
+    q = make_queue(backend)
+    q.add("a")
+    assert q.get(timeout=1) == "a"
+    q.add("a")  # re-added while processing -> dirty, not queued
+    assert len(q) == 0
+    q.done("a")  # now requeued
+    assert q.get(timeout=1) == "a"
+    q.done("a")
+
+
+def test_add_after_delays(backend):
+    q = make_queue(backend)
+    t0 = time.monotonic()
+    q.add_after("later", 0.15)
+    q.add("now")
+    assert q.get(timeout=1) == "now"
+    q.done("now")
+    assert q.get(timeout=1) == "later"
+    assert time.monotonic() - t0 >= 0.14
+    q.done("later")
+
+
+def test_rate_limited_backoff_grows_and_forgets(backend):
+    q = make_queue(backend, base_delay=0.01, max_delay=0.04)
+    for _ in range(4):
+        q.add_rate_limited("k")
+        got = q.get(timeout=2)
+        assert got == "k"
+        q.done("k")
+    assert q.num_requeues("k") == 4
+    # 4th backoff would be 0.08 but capped at 0.04
+    t0 = time.monotonic()
+    q.add_rate_limited("k")
+    assert q.get(timeout=2) == "k"
+    elapsed = time.monotonic() - t0
+    assert 0.03 <= elapsed < 0.5
+    q.done("k")
+    q.forget("k")
+    assert q.num_requeues("k") == 0
+
+
+def test_shutdown_unblocks_getters(backend):
+    q = make_queue(backend)
+    results = []
+
+    def getter():
+        try:
+            q.get()
+        except rt.SHUTDOWN:
+            results.append("shutdown")
+
+    t = threading.Thread(target=getter)
+    t.start()
+    time.sleep(0.05)
+    q.shutdown()
+    t.join(timeout=2)
+    assert results == ["shutdown"]
+    assert q.shutting_down
+    q.add("ignored")  # adds after shutdown dropped
+    assert len(q) == 0
+
+
+def test_concurrent_producers_consumers(backend):
+    q = make_queue(backend)
+    seen = set()
+    lock = threading.Lock()
+
+    def consumer():
+        while True:
+            try:
+                k = q.get(timeout=2)
+            except rt.SHUTDOWN:
+                return
+            if k is None:
+                return
+            with lock:
+                seen.add(k)
+            q.done(k)
+
+    consumers = [threading.Thread(target=consumer) for _ in range(4)]
+    for t in consumers:
+        t.start()
+    for i in range(200):
+        q.add(f"k{i}")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with lock:
+            if len(seen) == 200:
+                break
+        time.sleep(0.01)
+    q.shutdown()
+    for t in consumers:
+        t.join(timeout=2)
+    assert len(seen) == 200
+
+
+def test_expectations_lifecycle(backend):
+    e = make_exp(backend)
+    assert e.satisfied("j")  # no entry => satisfied
+    e.expect("j", adds=2, dels=1)
+    assert not e.satisfied("j")
+    e.observe_add("j")
+    assert not e.satisfied("j")
+    e.observe_add("j")
+    assert not e.satisfied("j")  # dels still pending
+    e.observe_del("j")
+    assert e.satisfied("j")
+    e.observe_del("j")  # floor at 0, no underflow
+    assert e.satisfied("j")
+    e.delete("j")
+    assert e.satisfied("j")
+
+
+def test_expectations_ttl_expiry(backend):
+    e = make_exp(backend, ttl=0.05)
+    e.expect("j", adds=5)
+    assert not e.satisfied("j")
+    time.sleep(0.08)
+    assert e.satisfied("j")  # expired => forces resync
+
+
+@pytest.mark.parametrize(
+    "code,retryable",
+    [
+        (0, False),
+        (1, False),
+        (2, False),
+        (126, False),
+        (127, False),
+        (128, False),
+        (130, True),  # SIGINT
+        (137, True),  # SIGKILL (preemption)
+        (138, True),  # SIGUSR1 user-defined
+        (139, False),  # SIGSEGV is permanent (train_util.go is authoritative)
+        (143, True),  # SIGTERM (VM churn)
+        (255, False),
+    ],
+)
+def test_retryable_exit_codes(code, retryable):
+    assert py_retryable_exit_code(code) is retryable
+    if rt.NATIVE_AVAILABLE:
+        assert rt._native_retryable(code) is retryable
+
+
+def test_backends_agree_on_sequence():
+    """Same op sequence, same observable behavior on both backends."""
+    if not rt.NATIVE_AVAILABLE:
+        pytest.skip("native lib not built")
+    for mk in (lambda: PyWorkQueue(), lambda: rt._NativeWorkQueue()):
+        q = mk()
+        q.add("x")
+        q.add("y")
+        q.add("x")
+        got = [q.get(timeout=1), q.get(timeout=1)]
+        assert got == ["x", "y"]
+        q.add("x")  # dirty
+        q.done("x")
+        assert q.get(timeout=1) == "x"
+        q.done("x")
+        q.done("y")
+        assert q.get(timeout=0.02) is None

@@ -69,13 +69,12 @@ def set_defaults_tpujob(job: TPUJob) -> None:
 
     for rtype, rspec in spec.tpu_replica_specs.items():
         if rspec.replicas is None:
-            if (
-                rtype == c.REPLICA_TYPE_WORKER
-                and slice_topo is not None
-                and master is not None
-            ):
-                # default Worker count to the remaining hosts of the slice
-                rspec.replicas = max(0, slice_topo.num_processes - 1)
+            if rtype == c.REPLICA_TYPE_WORKER and slice_topo is not None:
+                # default Worker count to the slice's host pods (minus the
+                # Master's host when one exists)
+                rspec.replicas = max(
+                    0, slice_topo.num_processes - (1 if master is not None else 0)
+                )
             else:
                 rspec.replicas = 1
         if rspec.restart_policy is None:

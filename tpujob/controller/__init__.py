@@ -1,0 +1,1 @@
+"""The TPUJob reconciler and its supporting machinery."""

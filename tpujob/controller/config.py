@@ -1,0 +1,43 @@
+"""Worker init-container template.
+
+Mirrors reference ``pkg/common/config/config.go:9-30``: a busybox DNS-wait
+loop that gates worker startup until the coordinator service resolves, with
+a file-based override.
+"""
+from __future__ import annotations
+
+import os
+import string
+from typing import Dict, List
+
+DEFAULT_INIT_CONTAINER_TEMPLATE = """\
+- name: init-tpujob
+  image: ${init_image}
+  command: ['sh', '-c', 'err=1; for i in $$(seq 100); do if nslookup ${master_addr}; then err=0 && break; fi; echo waiting for ${master_addr}; sleep 2; done; exit $$err']
+  resources:
+    limits:
+      cpu: 100m
+      memory: 20Mi
+    requests:
+      cpu: 50m
+      memory: 10Mi
+"""
+
+CONFIG_OVERRIDE_PATH = "/etc/config/initContainer.yaml"
+DEFAULT_INIT_IMAGE = "alpine:3.10"
+
+
+def get_init_container_template(override_path: str = CONFIG_OVERRIDE_PATH) -> str:
+    if os.path.exists(override_path):
+        with open(override_path) as f:
+            return f.read()
+    return DEFAULT_INIT_CONTAINER_TEMPLATE
+
+
+def render_init_containers(master_addr: str, init_image: str, template: str | None = None) -> List[Dict]:
+    """Render the init-container template (util.go:61-87 equivalent)."""
+    import yaml
+
+    tpl = string.Template(template or get_init_container_template())
+    rendered = tpl.safe_substitute(master_addr=master_addr, init_image=init_image)
+    return yaml.safe_load(rendered)

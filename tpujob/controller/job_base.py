@@ -1,0 +1,330 @@
+"""Base job-controller kernel.
+
+The equivalent of the vendored kubeflow/common JobController
+(``vendor/github.com/kubeflow/tf-operator/pkg/common/jobcontroller/``):
+workqueue + expectations wiring, pod/service event plumbing with
+controller-ref resolution, claim/adopt/orphan of pods and services, and the
+name/label/expectation-key conventions shared by reconciler and tests.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tpujob.api import constants as c
+from tpujob.api.types import TPUJob
+from tpujob.kube.client import (
+    RESOURCE_PODS,
+    RESOURCE_SERVICES,
+    RESOURCE_TPUJOBS,
+    ClientSet,
+)
+from tpujob.kube.control import (
+    EventRecorder,
+    PodControl,
+    ServiceControl,
+    gen_labels,
+)
+from tpujob.kube.errors import NotFoundError
+from tpujob.kube.informers import InformerFactory
+from tpujob.kube.objects import Pod, Service
+from tpujob.runtime import ExpectationsCache, WorkQueue
+
+log = logging.getLogger("tpujob.controller")
+
+
+@dataclass
+class ControllerConfig:
+    """Operator knobs (reference ServerOption, options.go:27-84)."""
+
+    threadiness: int = 1
+    resync_period: float = 12 * 3600.0
+    enable_gang_scheduling: bool = False
+    gang_scheduler_name: str = c.DEFAULT_GANG_SCHEDULER_NAME
+    init_container_image: str = "alpine:3.10"
+    expectations_ttl: float = 300.0
+    backoff_base_delay: float = 0.005
+    backoff_max_delay: float = 1200.0
+    namespace: Optional[str] = None  # None = all namespaces
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+def expectation_key(job_key: str, rtype: str, kind: str) -> str:
+    """jobcontroller/util.go:46-51: job/replicatype/{pods,services}."""
+    return f"{job_key}/{rtype.lower()}/{kind}"
+
+
+class JobController:
+    """Shared controller state and pod/service event plumbing."""
+
+    def __init__(
+        self,
+        clients: ClientSet,
+        factory: Optional[InformerFactory] = None,
+        recorder: Optional[EventRecorder] = None,
+        config: Optional[ControllerConfig] = None,
+    ):
+        self.clients = clients
+        self.config = config or ControllerConfig()
+        self.factory = factory or InformerFactory(clients.server)
+        self.recorder = recorder or EventRecorder(clients)
+        self.pod_control = PodControl(clients, self.recorder)
+        self.service_control = ServiceControl(clients, self.recorder)
+        self.queue = WorkQueue(
+            base_delay=self.config.backoff_base_delay,
+            max_delay=self.config.backoff_max_delay,
+        )
+        self.expectations = ExpectationsCache(ttl=self.config.expectations_ttl)
+
+        self.job_informer = self.factory.informer(RESOURCE_TPUJOBS)
+        self.pod_informer = self.factory.informer(RESOURCE_PODS)
+        self.service_informer = self.factory.informer(RESOURCE_SERVICES)
+
+        self.pod_informer.on_add(self.add_pod)
+        self.pod_informer.on_update(self.update_pod)
+        self.pod_informer.on_delete(self.delete_pod)
+        self.service_informer.on_add(self.add_service)
+        self.service_informer.on_update(self.update_service)
+        self.service_informer.on_delete(self.delete_service)
+
+    # ------------------------------------------------------------------
+    # enqueueing
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def job_key_of(obj: Dict[str, Any]) -> str:
+        meta = obj.get("metadata") or {}
+        return f"{meta.get('namespace') or 'default'}/{meta.get('name')}"
+
+    def enqueue_job(self, key: str) -> None:
+        self.queue.add(key)
+
+    # ------------------------------------------------------------------
+    # pod/service event handlers (jobcontroller/pod.go:20-160)
+    # ------------------------------------------------------------------
+
+    def _owner_job_key(self, obj: Dict[str, Any]) -> Optional[str]:
+        meta = obj.get("metadata") or {}
+        ns = meta.get("namespace") or "default"
+        for ref in meta.get("ownerReferences") or []:
+            if not ref.get("controller"):
+                continue
+            if ref.get("kind") != c.KIND:
+                continue
+            # UID-checked resolution (jobcontroller.go:283-299)
+            cached = self.job_informer.store.get(ns, ref.get("name"))
+            if cached is None:
+                return None
+            if (cached.get("metadata") or {}).get("uid") != ref.get("uid"):
+                return None
+            return f"{ns}/{ref.get('name')}"
+        return None
+
+    def _replica_type_of(self, obj: Dict[str, Any]) -> Optional[str]:
+        labels = (obj.get("metadata") or {}).get("labels") or {}
+        return labels.get(c.LABEL_REPLICA_TYPE)
+
+    def add_pod(self, obj: Dict[str, Any]) -> None:
+        key = self._owner_job_key(obj)
+        if key is None:
+            return
+        rtype = self._replica_type_of(obj)
+        if rtype:
+            self.expectations.observe_add(expectation_key(key, rtype, "pods"))
+        self.enqueue_job(key)
+
+    def update_pod(self, old: Dict[str, Any], new: Dict[str, Any]) -> None:
+        if (old.get("metadata") or {}).get("resourceVersion") == (
+            (new.get("metadata") or {}).get("resourceVersion")
+        ):
+            return
+        key = self._owner_job_key(new) or self._owner_job_key(old)
+        if key is not None:
+            self.enqueue_job(key)
+
+    def delete_pod(self, obj: Dict[str, Any]) -> None:
+        key = self._owner_job_key(obj)
+        if key is None:
+            return
+        rtype = self._replica_type_of(obj)
+        if rtype:
+            self.expectations.observe_del(expectation_key(key, rtype, "pods"))
+        self.enqueue_job(key)
+
+    def add_service(self, obj: Dict[str, Any]) -> None:
+        key = self._owner_job_key(obj)
+        if key is None:
+            return
+        rtype = self._replica_type_of(obj)
+        if rtype:
+            self.expectations.observe_add(expectation_key(key, rtype, "services"))
+        self.enqueue_job(key)
+
+    def update_service(self, old: Dict[str, Any], new: Dict[str, Any]) -> None:
+        self.update_pod(old, new)
+
+    def delete_service(self, obj: Dict[str, Any]) -> None:
+        key = self._owner_job_key(obj)
+        if key is None:
+            return
+        rtype = self._replica_type_of(obj)
+        if rtype:
+            self.expectations.observe_del(expectation_key(key, rtype, "services"))
+        self.enqueue_job(key)
+
+    # ------------------------------------------------------------------
+    # claim / adopt / orphan (jobcontroller/pod.go:165-196)
+    # ------------------------------------------------------------------
+
+    def get_pods_for_job(self, job: TPUJob) -> List[Pod]:
+        ns = job.metadata.namespace or "default"
+        selector = gen_labels(job.metadata.name)
+        out: List[Pod] = []
+        for obj in self.pod_informer.store.list(ns):
+            meta = obj.get("metadata") or {}
+            labels = meta.get("labels") or {}
+            refs = meta.get("ownerReferences") or []
+            owned = any(r.get("controller") and r.get("uid") == job.metadata.uid for r in refs)
+            matches = all(labels.get(k) == v for k, v in selector.items())
+            if owned:
+                out.append(Pod.from_dict(obj))
+            elif matches and not any(r.get("controller") for r in refs):
+                adopted = self._adopt(RESOURCE_PODS, job, meta)
+                if adopted is not None:
+                    out.append(Pod.from_dict(adopted))
+        return out
+
+    def get_services_for_job(self, job: TPUJob) -> List[Service]:
+        ns = job.metadata.namespace or "default"
+        selector = gen_labels(job.metadata.name)
+        out: List[Service] = []
+        for obj in self.service_informer.store.list(ns):
+            meta = obj.get("metadata") or {}
+            labels = meta.get("labels") or {}
+            refs = meta.get("ownerReferences") or []
+            owned = any(r.get("controller") and r.get("uid") == job.metadata.uid for r in refs)
+            matches = all(labels.get(k) == v for k, v in selector.items())
+            if owned:
+                out.append(Service.from_dict(obj))
+            elif matches and not any(r.get("controller") for r in refs):
+                adopted = self._adopt(RESOURCE_SERVICES, job, meta)
+                if adopted is not None:
+                    out.append(Service.from_dict(adopted))
+        return out
+
+    def _adopt(self, resource: str, job: TPUJob, meta: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Adopt an orphan by patching a controller owner ref onto it, with an
+        uncached quorum recheck of the owner (pod.go:184-195): a deleted or
+        terminal job must not adopt."""
+        try:
+            fresh = self.clients.tpujobs.get(job.metadata.namespace or "default", job.metadata.name)
+        except NotFoundError:
+            return None
+        if fresh.metadata.uid != job.metadata.uid or fresh.metadata.deletion_timestamp:
+            return None
+        ref = {
+            "apiVersion": job.api_version,
+            "kind": job.kind,
+            "name": job.metadata.name,
+            "uid": job.metadata.uid,
+            "controller": True,
+            "blockOwnerDeletion": True,
+        }
+        try:
+            return self.clients.server.patch(
+                resource,
+                meta.get("namespace") or "default",
+                meta.get("name"),
+                {"metadata": {"ownerReferences": [ref]}},
+            )
+        except NotFoundError:
+            return None
+
+    # ------------------------------------------------------------------
+    # slicing helpers (jobcontroller/pod.go:199-219, service.go:104-148)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def filter_by_replica_type(objs, rtype: str):
+        return [o for o in objs if o.metadata.labels.get(c.LABEL_REPLICA_TYPE) == rtype.lower()]
+
+    @staticmethod
+    def get_slices(objs, replicas: int) -> List[List]:
+        """Index objects into per-replica-index slices; out-of-range indexes
+        are logged and ignored (pod.go:118-137)."""
+        slices: List[List] = [[] for _ in range(replicas)]
+        for o in objs:
+            idx_s = o.metadata.labels.get(c.LABEL_REPLICA_INDEX)
+            try:
+                idx = int(idx_s)
+            except (TypeError, ValueError):
+                log.warning("object %s has no/invalid replica index %r", o.metadata.name, idx_s)
+                continue
+            if 0 <= idx < replicas:
+                slices[idx].append(o)
+            else:
+                log.warning("object %s index %d out of range [0,%d)", o.metadata.name, idx, replicas)
+        return slices
+
+    # ------------------------------------------------------------------
+    # run loop (controller.go:185-274)
+    # ------------------------------------------------------------------
+
+    def satisfied_expectations(self, job: TPUJob) -> bool:
+        """controller.go:497-516: sync only when informer caches reflect our
+        own writes for every replica type."""
+        key = job.key
+        for rtype in job.spec.tpu_replica_specs:
+            if not self.expectations.satisfied(expectation_key(key, rtype, "pods")):
+                return False
+            if not self.expectations.satisfied(expectation_key(key, rtype, "services")):
+                return False
+        return True
+
+    def sync_handler(self, key: str) -> bool:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def process_next_item(self, timeout: Optional[float] = None) -> bool:
+        """One worker iteration: dequeue, sync, forget-or-backoff."""
+        from tpujob.runtime import SHUTDOWN
+
+        try:
+            key = self.queue.get(timeout=timeout)
+        except SHUTDOWN:
+            return False
+        if key is None:
+            return True
+        try:
+            forget = self.sync_handler(key)
+            if forget:
+                self.queue.forget(key)
+            else:
+                self.queue.add_rate_limited(key)
+        except Exception:
+            log.exception("error syncing job %s", key)
+            self.queue.add_rate_limited(key)
+        finally:
+            self.queue.done(key)
+        return True
+
+    def run(self, stop_event: threading.Event, threadiness: Optional[int] = None) -> List[threading.Thread]:
+        """Start informers + N workers (controller.go:185-213)."""
+        self.factory.start(stop_event)
+        if not self.factory.wait_for_cache_sync():
+            raise RuntimeError("informer caches failed to sync")
+
+        def worker():
+            while not stop_event.is_set():
+                if not self.process_next_item(timeout=0.1):
+                    return
+
+        n = threadiness or self.config.threadiness
+        threads = [
+            threading.Thread(target=worker, daemon=True, name=f"tpujob-worker-{i}")
+            for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        return threads

@@ -1,0 +1,570 @@
+"""The TPUJob reconciler.
+
+Mirrors the reference's reconcile heart, behavior-for-behavior, with TPU
+cluster-spec injection in place of the NCCL wiring:
+
+- ``syncTPUJob``/``reconcileTPUJobs`` — ``pkg/controller.v1/pytorch/controller.go:290-492``
+- pod reconcile + ExitCode restart — ``pod.go:49-232`` + ``pod.go:91-109``
+- master-only headless service — ``service.go:36-153``, ``controller.go:474-477``
+- status convergence — ``status.go:63-152``
+- terminal cleanup / CleanPodPolicy / TTL — ``job.go:153-209``
+- backoff limit / active deadline — ``controller.go:391-461,520-568``
+- gang scheduling PodGroup — ``jobcontroller.go:224-278``
+"""
+from __future__ import annotations
+
+import calendar
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tpujob.api import constants as c
+from tpujob.api.defaults import set_defaults_tpujob
+from tpujob.api.types import TPUJob
+from tpujob.api.validation import validate_tpujob_spec
+from tpujob.controller import status as st
+from tpujob.controller import tpu_env
+from tpujob.controller.config import render_init_containers
+from tpujob.controller.job_base import JobController, expectation_key
+from tpujob.kube.client import RESOURCE_TPUJOBS
+from tpujob.kube.control import gen_general_name, gen_labels, gen_pod_group_name
+from tpujob.kube.errors import NotFoundError
+from tpujob.kube.objects import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodGroupSpec,
+    ResourceRequirements,
+    Service,
+    ServicePort,
+    ServiceSpec,
+)
+from tpujob.runtime import is_retryable_exit_code
+from tpujob.server import metrics
+
+log = logging.getLogger("tpujob.reconciler")
+
+
+def _parse_time(ts: Optional[str]) -> Optional[float]:
+    if not ts:
+        return None
+    return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+
+
+def get_port_from_job(job: TPUJob, rtype: str) -> int:
+    """Coordinator port lookup (util.go:34-47)."""
+    rspec = job.spec.tpu_replica_specs.get(rtype)
+    if rspec:
+        for container in rspec.template.spec.containers:
+            if container.name == c.DEFAULT_CONTAINER_NAME:
+                for port in container.ports:
+                    if port.name == c.DEFAULT_PORT_NAME:
+                        return port.container_port
+    return c.DEFAULT_PORT
+
+
+def get_total_replicas(job: TPUJob) -> int:
+    return sum(
+        (r.replicas if r.replicas is not None else 1)
+        for r in job.spec.tpu_replica_specs.values()
+    )
+
+
+class TPUJobController(JobController):
+    """The operator's reconcile loop over TPUJob resources."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.job_informer.on_add(self._on_job_add)
+        self.job_informer.on_update(self._on_job_update)
+        self.job_informer.on_delete(self._on_job_delete)
+        # injectable handlers for tests (controller.go:81-89)
+        self.update_status_handler = self._update_job_status
+        self.delete_job_handler = self._delete_job
+
+    # ------------------------------------------------------------------
+    # job event handlers (job.go:35-149)
+    # ------------------------------------------------------------------
+
+    def _on_job_add(self, obj: Dict) -> None:
+        key = self.job_key_of(obj)
+        try:
+            job = TPUJob.from_dict(obj)
+            set_defaults_tpujob(job)
+            errs = validate_tpujob_spec(job.spec, strict_topology=True)
+        except (TypeError, ValueError) as e:
+            errs = [str(e)]
+            job = None
+        if errs:
+            # malformed CR: write a Failed condition back instead of crashing
+            # (job.go:60-111 / informer.go:83-104 tolerance semantics)
+            self._fail_malformed(obj, errs)
+            return
+        metrics.jobs_created.inc()
+        self.enqueue_job(key)
+        # ActiveDeadlineSeconds: re-enqueue at the deadline (job.go:133-149)
+        ads = job.spec.run_policy.active_deadline_seconds
+        if ads is not None and ads >= 0:
+            self.queue.add_after(key, float(ads))
+
+    def _on_job_update(self, old: Dict, new: Dict) -> None:
+        if (old.get("metadata") or {}).get("resourceVersion") == (
+            (new.get("metadata") or {}).get("resourceVersion")
+        ):
+            return  # periodic resync replay, nothing changed
+        self.enqueue_job(self.job_key_of(new))
+
+    def _on_job_delete(self, obj: Dict) -> None:
+        metrics.jobs_deleted.inc()
+        key = self.job_key_of(obj)
+        for rtype in (c.REPLICA_TYPE_MASTER, c.REPLICA_TYPE_WORKER):
+            self.expectations.delete(expectation_key(key, rtype, "pods"))
+            self.expectations.delete(expectation_key(key, rtype, "services"))
+
+    def _fail_malformed(self, obj: Dict, errs: List[str]) -> None:
+        meta = obj.get("metadata") or {}
+        ns, name = meta.get("namespace") or "default", meta.get("name")
+        log.warning("invalid TPUJob %s/%s: %s", ns, name, errs)
+        # write back through the raw transport: the typed client would choke
+        # on the very malformation we are reporting (job.go:60-111 uses the
+        # raw CRD REST client for the same reason)
+        from tpujob.api.types import JobStatus
+
+        status = JobStatus.from_dict(obj.get("status") if isinstance(obj.get("status"), dict) else {})
+        message = f"TPUJob {name} is invalid: " + "; ".join(errs)
+        existing = st.get_condition(status, c.JOB_FAILED)
+        if existing is not None and existing.status == "True" and existing.message == message:
+            return  # already reported: avoid a write->watch->sync busy loop
+        st.update_job_conditions(status, c.JOB_FAILED, st.REASON_JOB_FAILED, message)
+        try:
+            self.clients.server.update_status(
+                RESOURCE_TPUJOBS,
+                {"metadata": {"namespace": ns, "name": name}, "status": status.to_dict()},
+            )
+        except NotFoundError:
+            return
+        metrics.jobs_failed.inc()
+
+    # ------------------------------------------------------------------
+    # sync (controller.go:290-332)
+    # ------------------------------------------------------------------
+
+    def sync_handler(self, key: str) -> bool:
+        ns, _, name = key.partition("/")
+        cached = self.job_informer.store.get(ns, name)
+        if cached is None:
+            log.info("job %s no longer exists", key)
+            return True
+        try:
+            job = TPUJob.from_dict(cached)
+            set_defaults_tpujob(job)
+            # strict topology: a replicas-vs-slice mismatch cannot be env-
+            # injected coherently, so it must fail visibly instead of looping
+            errs = validate_tpujob_spec(job.spec, strict_topology=True)
+        except (TypeError, ValueError) as e:
+            job, errs = None, [str(e)]
+        if errs:
+            self._fail_malformed(cached, errs)
+            return True
+        if not self.satisfied_expectations(job):
+            return True  # informer cache stale; a watch event will re-enqueue
+        return self.reconcile_tpujobs(job)
+
+    # ------------------------------------------------------------------
+    # reconcile (controller.go:336-492)
+    # ------------------------------------------------------------------
+
+    def reconcile_tpujobs(self, job: TPUJob) -> bool:
+        key = job.key
+        old_status = job.status.deepcopy()
+        pods = self.get_pods_for_job(job)
+        services = self.get_services_for_job(job)
+
+        # terminal: clean up and freeze (controller.go:362-389)
+        if st.is_finished(job.status):
+            self._delete_pods_and_services(job, pods, services)
+            self._cleanup_ttl(job)
+            if self.config.enable_gang_scheduling:
+                self._delete_pod_group(job)
+            if job.status != old_status:
+                self.update_status_handler(job)
+            return True
+
+        # backoff limit (controller.go:391-453, 520-556)
+        exceeded, reason = self._past_backoff_limit(job, pods)
+        if exceeded:
+            return self._fail_job(job, old_status, pods, services,
+                                  f"TPUJob {job.metadata.name} has failed because it has "
+                                  f"reached the specified backoff limit ({reason})")
+        if self._past_active_deadline(job):
+            return self._fail_job(job, old_status, pods, services,
+                                  f"TPUJob {job.metadata.name} has failed because it was "
+                                  "active longer than specified deadline")
+
+        if self.config.enable_gang_scheduling:
+            self._sync_pod_group(job)
+
+        if not st.get_condition(job.status, c.JOB_CREATED):
+            st.update_job_conditions(
+                job.status, c.JOB_CREATED, st.REASON_JOB_CREATED,
+                f"TPUJob {job.metadata.name} is created.",
+            )
+
+        coord_rtype = tpu_env.coordinator_replica(job)
+        for rtype, rspec in job.spec.tpu_replica_specs.items():
+            typed_pods = self.filter_by_replica_type(pods, rtype)
+            restarting = self._reconcile_pods(job, typed_pods, rtype, rspec)
+            if rtype == coord_rtype:
+                # coordinator-only headless service (controller.go:474-477;
+                # worker-0 coordinates master-less jobs)
+                typed_svcs = self.filter_by_replica_type(services, rtype)
+                self._reconcile_services(job, typed_svcs, rtype, rspec)
+            self._update_status_single(job, rtype, rspec, restarting)
+
+        if job.status != old_status:
+            self.update_status_handler(job)
+        return True
+
+    # ------------------------------------------------------------------
+    # pods (pod.go:49-232)
+    # ------------------------------------------------------------------
+
+    def _reconcile_pods(self, job: TPUJob, pods: List[Pod], rtype: str, rspec) -> bool:
+        replicas = rspec.replicas if rspec.replicas is not None else 1
+        st.initialize_replica_statuses(job.status, rtype)
+        slices = self.get_slices(pods, replicas)
+        restarting = False
+        for index in range(replicas):
+            pod_slice = slices[index]
+            if len(pod_slice) > 1:
+                log.warning("job %s has %d %s pods with index %d",
+                            job.key, len(pod_slice), rtype, index)
+                continue
+            if not pod_slice:
+                self._create_new_pod(job, rtype, rspec, index)
+                continue
+            pod = pod_slice[0]
+            # ExitCode restart policy (pod.go:91-109)
+            if pod.status.phase == "Failed" and rspec.restart_policy == c.RESTART_POLICY_EXIT_CODE:
+                code = self._managed_exit_code(pod)
+                if code is not None and is_retryable_exit_code(code):
+                    log.info("pod %s exited with retryable code %d; restarting",
+                             pod.metadata.name, code)
+                    self.expectations.expect(
+                        expectation_key(job.key, rtype, "pods"), adds=0, dels=1
+                    )
+                    self.pod_control.delete_pod(
+                        pod.metadata.namespace, pod.metadata.name, job
+                    )
+                    restarting = True
+                    # fall through: the failure still counts this sync, so the
+                    # status machine emits Restarting (reference pod.go:91-109
+                    # deletes async and the pod is still counted)
+            st.update_replica_statuses(job.status, rtype, pod)
+        return restarting
+
+    @staticmethod
+    def _managed_exit_code(pod: Pod) -> Optional[int]:
+        for cs in pod.status.container_statuses:
+            if cs.name == c.DEFAULT_CONTAINER_NAME and cs.state and cs.state.terminated:
+                return cs.state.terminated.exit_code
+        return None
+
+    def _create_new_pod(self, job: TPUJob, rtype: str, rspec, index: int) -> None:
+        key = job.key
+        name = gen_general_name(job.metadata.name, rtype, index)
+        template = rspec.template.deepcopy()
+        labels = gen_labels(job.metadata.name)
+        labels[c.LABEL_REPLICA_TYPE] = rtype.lower()
+        labels[c.LABEL_REPLICA_INDEX] = str(index)
+        template.metadata.labels.update(labels)
+        pod = Pod(metadata=template.metadata, spec=template.spec)
+        pod.metadata.name = name
+        pod.metadata.namespace = job.metadata.namespace or "default"
+
+        port = get_port_from_job(job, c.REPLICA_TYPE_MASTER
+                                 if c.REPLICA_TYPE_MASTER in job.spec.tpu_replica_specs
+                                 else rtype)
+        tpu_env.set_cluster_spec(pod, job, rtype, index, port)
+        self._set_restart_policy(pod, rspec)
+        self._apply_tpu_scheduling(pod, rspec, job)
+
+        # non-coordinator pods wait for the coordinator DNS
+        # (pod.go:189-198, util.go:61-87); in master-less jobs worker-0 is
+        # the coordinator and must not gate on itself
+        is_coordinator = rtype == tpu_env.coordinator_replica(job) and index == 0
+        if rtype == c.REPLICA_TYPE_WORKER and not is_coordinator:
+            rendered = render_init_containers(
+                tpu_env.coordinator_dns(job), self.config.init_container_image
+            )
+            pod.spec.init_containers.extend(Container.from_dict(d) for d in rendered)
+
+        if self.config.enable_gang_scheduling:
+            # scheduler name + PodGroup annotation (pod.go:200-216)
+            if pod.spec.scheduler_name and pod.spec.scheduler_name != self.config.gang_scheduler_name:
+                log.warning("job %s pod %s scheduler %s overridden by gang scheduler %s",
+                            key, name, pod.spec.scheduler_name, self.config.gang_scheduler_name)
+            pod.spec.scheduler_name = self.config.gang_scheduler_name
+            pod.metadata.annotations[c.POD_GROUP_ANNOTATION] = gen_pod_group_name(job.metadata.name)
+
+        self.expectations.expect(expectation_key(key, rtype, "pods"), adds=1, dels=0)
+        try:
+            self.pod_control.create_pod(pod.metadata.namespace, pod, job)
+        except Exception:
+            # roll back the expectation so the next sync isn't blocked
+            self.expectations.observe_add(expectation_key(key, rtype, "pods"))
+            raise
+
+    @staticmethod
+    def _set_restart_policy(pod: Pod, rspec) -> None:
+        """ExitCode forces pod RestartPolicy Never so the controller, not the
+        kubelet, owns the restart decision (pod.go:283-289)."""
+        if rspec.restart_policy == c.RESTART_POLICY_EXIT_CODE:
+            pod.spec.restart_policy = "Never"
+        elif rspec.restart_policy:
+            pod.spec.restart_policy = rspec.restart_policy
+
+    @staticmethod
+    def _apply_tpu_scheduling(pod: Pod, rspec, job: TPUJob) -> None:
+        """TPU-first scheduling: google.com/tpu resource requests + GKE node
+        selectors derived from the slice spec (the reference's GPU resource
+        request analog, e.g. examples/.../pytorch_job_mnist_nccl.yaml:20-21)."""
+        tpu = rspec.tpu
+        if tpu is None or not tpu.accelerator:
+            for other in job.spec.tpu_replica_specs.values():
+                if other.tpu and other.tpu.accelerator:
+                    tpu = other.tpu
+                    break
+        if tpu is None or not tpu.accelerator:
+            return
+        topo = tpu.resolve()
+        pod.spec.node_selector.setdefault(c.TPU_ACCELERATOR_NODE_SELECTOR, topo.accelerator)
+        pod.spec.node_selector.setdefault(c.TPU_TOPOLOGY_NODE_SELECTOR, topo.topology)
+        for container in pod.spec.containers:
+            if container.name != c.DEFAULT_CONTAINER_NAME:
+                continue
+            if container.resources is None:
+                container.resources = ResourceRequirements()
+            container.resources.limits.setdefault(c.TPU_RESOURCE, topo.chips_per_host)
+
+    # ------------------------------------------------------------------
+    # services (service.go:36-153)
+    # ------------------------------------------------------------------
+
+    def _reconcile_services(self, job: TPUJob, services: List[Service], rtype: str, rspec) -> None:
+        replicas = 1  # master-only
+        slices = self.get_slices(services, replicas)
+        for index in range(replicas):
+            if not slices[index]:
+                self._create_new_service(job, rtype, index)
+
+    def _create_new_service(self, job: TPUJob, rtype: str, index: int) -> None:
+        key = job.key
+        port = get_port_from_job(job, rtype)
+        labels = gen_labels(job.metadata.name)
+        labels[c.LABEL_REPLICA_TYPE] = rtype.lower()
+        labels[c.LABEL_REPLICA_INDEX] = str(index)
+        service = Service(
+            metadata=ObjectMeta(
+                name=gen_general_name(job.metadata.name, rtype, index),
+                namespace=job.metadata.namespace or "default",
+                labels=dict(labels),
+            ),
+            spec=ServiceSpec(
+                cluster_ip="None",  # headless: DNS resolves to the pod IP
+                selector=dict(labels),
+                ports=[ServicePort(name=c.DEFAULT_PORT_NAME, port=port)],
+            ),
+        )
+        self.expectations.expect(expectation_key(key, rtype, "services"), adds=1, dels=0)
+        try:
+            self.service_control.create_service(service.metadata.namespace, service, job)
+        except Exception:
+            self.expectations.observe_add(expectation_key(key, rtype, "services"))
+            raise
+
+    # ------------------------------------------------------------------
+    # status convergence (status.go:63-152)
+    # ------------------------------------------------------------------
+
+    def _update_status_single(self, job: TPUJob, rtype: str, rspec, restarting: bool) -> None:
+        replicas = rspec.replicas if rspec.replicas is not None else 1
+        rs = job.status.replica_statuses.get(rtype)
+        if rs is None:
+            return
+        expected = replicas - rs.succeeded
+        if job.status.start_time is None:
+            job.status.start_time = st.now_iso()
+
+        has_master = c.REPLICA_TYPE_MASTER in job.spec.tpu_replica_specs
+        completion_bearing = (
+            rtype == c.REPLICA_TYPE_MASTER
+            or (not has_master and rtype == c.REPLICA_TYPE_WORKER)
+        )
+        if completion_bearing:
+            if rs.active > 0:
+                st.update_job_conditions(
+                    job.status, c.JOB_RUNNING, st.REASON_JOB_RUNNING,
+                    f"TPUJob {job.metadata.name} is running.",
+                )
+            if expected == 0:
+                # master-completion semantics (status.go:99-112)
+                self.recorder.event(job, "Normal", st.REASON_JOB_SUCCEEDED,
+                                    f"TPUJob {job.metadata.name} successfully completed.")
+                st.update_job_conditions(
+                    job.status, c.JOB_SUCCEEDED, st.REASON_JOB_SUCCEEDED,
+                    f"TPUJob {job.metadata.name} successfully completed.",
+                )
+                if job.status.completion_time is None:
+                    job.status.completion_time = st.now_iso()
+                metrics.jobs_successful.inc()
+                return
+        if rs.failed > 0:
+            if restarting:
+                self.recorder.event(job, "Warning", st.REASON_JOB_RESTARTING,
+                                    f"TPUJob {job.metadata.name} is restarting because "
+                                    f"{rs.failed} {rtype} replica(s) failed.")
+                st.update_job_conditions(
+                    job.status, c.JOB_RESTARTING, st.REASON_JOB_RESTARTING,
+                    f"TPUJob {job.metadata.name} is restarting because "
+                    f"{rs.failed} {rtype} replica(s) failed.",
+                )
+                metrics.jobs_restarted.inc()
+            else:
+                self.recorder.event(job, "Warning", st.REASON_JOB_FAILED,
+                                    f"TPUJob {job.metadata.name} has failed because "
+                                    f"{rs.failed} {rtype} replica(s) failed.")
+                st.update_job_conditions(
+                    job.status, c.JOB_FAILED, st.REASON_JOB_FAILED,
+                    f"TPUJob {job.metadata.name} has failed because "
+                    f"{rs.failed} {rtype} replica(s) failed.",
+                )
+                if job.status.completion_time is None:
+                    job.status.completion_time = st.now_iso()
+                metrics.jobs_failed.inc()
+
+    # ------------------------------------------------------------------
+    # failure paths (controller.go:391-453, 520-568)
+    # ------------------------------------------------------------------
+
+    def _past_backoff_limit(self, job: TPUJob, pods: List[Pod]) -> Tuple[bool, str]:
+        limit = job.spec.run_policy.backoff_limit
+        if limit is None:
+            return False, ""
+        restarts = 0
+        for rtype, rspec in job.spec.tpu_replica_specs.items():
+            if rspec.restart_policy not in (c.RESTART_POLICY_ON_FAILURE, c.RESTART_POLICY_ALWAYS):
+                continue  # only in-place-restart policies count (controller.go:527-533)
+            for pod in self.filter_by_replica_type(pods, rtype):
+                for cs in pod.status.container_statuses:
+                    restarts += cs.restart_count
+        if restarts >= limit:
+            return True, f"total restart count {restarts} >= backoffLimit {limit}"
+        return False, ""
+
+    def _past_active_deadline(self, job: TPUJob) -> bool:
+        ads = job.spec.run_policy.active_deadline_seconds
+        start = _parse_time(job.status.start_time)
+        if ads is None or start is None:
+            return False
+        return time.time() - start >= ads
+
+    def _fail_job(self, job: TPUJob, old_status, pods, services, message: str) -> bool:
+        self._delete_pods_and_services(job, pods, services)
+        self.recorder.event(job, "Warning", st.REASON_JOB_FAILED, message)
+        if job.status.completion_time is None:
+            job.status.completion_time = st.now_iso()
+        st.update_job_conditions(job.status, c.JOB_FAILED, st.REASON_JOB_FAILED, message)
+        metrics.jobs_failed.inc()
+        if self.config.enable_gang_scheduling:
+            self._delete_pod_group(job)
+        if job.status != old_status:
+            self.update_status_handler(job)
+        return True
+
+    # ------------------------------------------------------------------
+    # cleanup (job.go:153-209)
+    # ------------------------------------------------------------------
+
+    def _delete_pods_and_services(self, job: TPUJob, pods: List[Pod], services: List[Service]) -> None:
+        policy = job.spec.run_policy.clean_pod_policy or c.CLEAN_POD_POLICY_NONE
+        if policy == c.CLEAN_POD_POLICY_NONE:
+            return
+        for pod in pods:
+            if policy == c.CLEAN_POD_POLICY_RUNNING and pod.status.phase not in ("Running", "Pending"):
+                continue
+            try:
+                self.pod_control.delete_pod(pod.metadata.namespace, pod.metadata.name, job)
+            except NotFoundError:
+                pass
+        for svc in services:
+            try:
+                self.service_control.delete_service(svc.metadata.namespace, svc.metadata.name, job)
+            except NotFoundError:
+                pass
+
+    def _cleanup_ttl(self, job: TPUJob) -> None:
+        ttl = job.spec.run_policy.ttl_seconds_after_finished
+        if ttl is None:
+            return
+        finish = _parse_time(job.status.completion_time) or time.time()
+        remaining = finish + ttl - time.time()
+        if remaining <= 0:
+            try:
+                self.delete_job_handler(job)
+            except NotFoundError:
+                pass
+        else:
+            self.queue.add_after(job.key, remaining)
+
+    # ------------------------------------------------------------------
+    # gang scheduling (jobcontroller.go:224-278)
+    # ------------------------------------------------------------------
+
+    def _sync_pod_group(self, job: TPUJob) -> None:
+        name = gen_pod_group_name(job.metadata.name)
+        ns = job.metadata.namespace or "default"
+        min_member = get_total_replicas(job)
+        sp = job.spec.run_policy.scheduling_policy
+        if sp and sp.min_available is not None:
+            min_member = sp.min_available
+        try:
+            existing = self.clients.podgroups.get(ns, name)
+            if existing.spec.min_member != min_member:
+                existing.spec.min_member = min_member
+                self.clients.podgroups.update(existing)
+        except NotFoundError:
+            pg = PodGroup(
+                metadata=ObjectMeta(name=name, namespace=ns, labels=gen_labels(job.metadata.name)),
+                spec=PodGroupSpec(min_member=min_member,
+                                  queue=sp.queue if sp else None,
+                                  priority_class_name=sp.priority_class if sp else None),
+            )
+            from tpujob.kube.control import gen_owner_reference
+
+            pg.metadata.owner_references.append(gen_owner_reference(job))
+            self.clients.podgroups.create(pg)
+
+    def _delete_pod_group(self, job: TPUJob) -> None:
+        try:
+            self.clients.podgroups.delete(job.metadata.namespace or "default",
+                                          gen_pod_group_name(job.metadata.name))
+        except NotFoundError:
+            pass
+
+    # ------------------------------------------------------------------
+    # write-back handlers (injectable for tests)
+    # ------------------------------------------------------------------
+
+    def _update_job_status(self, job: TPUJob) -> None:
+        job.status.last_reconcile_time = st.now_iso()
+        try:
+            self.clients.tpujobs.update_status(job)
+        except NotFoundError:
+            pass
+
+    def _delete_job(self, job: TPUJob) -> None:
+        self.clients.tpujobs.delete(job.metadata.namespace or "default", job.metadata.name)
+        self.recorder.event(job, "Normal", "SuccessfulDeleteJob",
+                            f"Deleted job: {job.metadata.name}")

@@ -1,0 +1,119 @@
+"""Job condition state machine.
+
+Mirrors reference ``pkg/controller.v1/pytorch/status.go:226-272`` (condition
+set/filter logic with Running↔Restarting mutual exclusion and terminal-state
+handling) and the replica-status bookkeeping (``status.go:162-182``).
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from tpujob.api import constants as c
+from tpujob.api.types import JobCondition, JobStatus, ReplicaStatus
+from tpujob.kube.objects import Pod
+
+
+def now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+# reasons (status.go:34-45 equivalents)
+REASON_JOB_CREATED = "TPUJobCreated"
+REASON_JOB_RUNNING = "TPUJobRunning"
+REASON_JOB_RESTARTING = "TPUJobRestarting"
+REASON_JOB_SUCCEEDED = "TPUJobSucceeded"
+REASON_JOB_FAILED = "TPUJobFailed"
+
+
+def get_condition(status: JobStatus, cond_type: str) -> Optional[JobCondition]:
+    for cond in status.conditions:
+        if cond.type == cond_type:
+            return cond
+    return None
+
+
+def has_condition(status: JobStatus, cond_type: str) -> bool:
+    cond = get_condition(status, cond_type)
+    return cond is not None and cond.status == "True"
+
+def is_succeeded(status: JobStatus) -> bool:
+    return has_condition(status, c.JOB_SUCCEEDED)
+
+
+def is_failed(status: JobStatus) -> bool:
+    return has_condition(status, c.JOB_FAILED)
+
+
+def is_finished(status: JobStatus) -> bool:
+    return is_succeeded(status) or is_failed(status)
+
+
+def _new_condition(cond_type: str, reason: str, message: str) -> JobCondition:
+    now = now_iso()
+    return JobCondition(
+        type=cond_type,
+        status="True",
+        reason=reason,
+        message=message,
+        last_update_time=now,
+        last_transition_time=now,
+    )
+
+
+def _filter_out(conditions: List[JobCondition], cond_type: str) -> List[JobCondition]:
+    """Drop conditions of `cond_type` (status.go filterOutCondition)."""
+    return [cond for cond in conditions if cond.type != cond_type]
+
+
+def set_condition(status: JobStatus, condition: JobCondition) -> None:
+    """Set/refresh a condition with the reference's exclusion semantics
+    (status.go:226-272):
+
+    - Running=True removes Restarting; Restarting=True removes Running.
+    - Succeeded/Failed=True flips Running to False (job no longer running)
+      rather than dropping history.
+    - Re-setting an identical condition (same status+reason) is a no-op so
+      lastTransitionTime is preserved.
+    """
+    current = get_condition(status, condition.type)
+    if current is not None and current.status == condition.status and current.reason == condition.reason:
+        current.last_update_time = condition.last_update_time
+        current.message = condition.message
+        return
+
+    conditions = _filter_out(status.conditions, condition.type)
+    if condition.status == "True":
+        if condition.type == c.JOB_RUNNING:
+            conditions = _filter_out(conditions, c.JOB_RESTARTING)
+        elif condition.type == c.JOB_RESTARTING:
+            conditions = _filter_out(conditions, c.JOB_RUNNING)
+        elif condition.type in (c.JOB_SUCCEEDED, c.JOB_FAILED):
+            for cond in conditions:
+                if cond.type == c.JOB_RUNNING and cond.status == "True":
+                    cond.status = "False"
+                    cond.last_transition_time = condition.last_transition_time
+                    cond.last_update_time = condition.last_update_time
+    conditions.append(condition)
+    status.conditions = conditions
+
+
+def update_job_conditions(status: JobStatus, cond_type: str, reason: str, message: str) -> None:
+    set_condition(status, _new_condition(cond_type, reason, message))
+
+
+def initialize_replica_statuses(status: JobStatus, rtype: str) -> None:
+    """status.go:162-168: reset the replica status for a type each sync."""
+    status.replica_statuses[rtype] = ReplicaStatus()
+
+
+def update_replica_statuses(status: JobStatus, rtype: str, pod: Pod) -> None:
+    """status.go:172-182: bump counters from a pod phase."""
+    rs = status.replica_statuses.setdefault(rtype, ReplicaStatus())
+    phase = pod.status.phase
+    if phase == "Running":
+        rs.active += 1
+    elif phase == "Succeeded":
+        rs.succeeded += 1
+    elif phase == "Failed":
+        rs.failed += 1

@@ -1,0 +1,174 @@
+"""TPU cluster-spec environment injection.
+
+The TPU-native replacement for the reference's ``setClusterSpec``
+(``pkg/controller.v1/pytorch/pod.go:234-281``), which injects the
+``torch.distributed`` TCP rendezvous (MASTER_ADDR/MASTER_PORT/WORLD_SIZE/
+RANK).  Here the rendezvous is the JAX/PJRT distributed coordinator plus
+libtpu slice topology:
+
+- ``PJRT_DEVICE=TPU`` selects the PJRT TPU plugin.
+- ``TPUJOB_COORDINATOR_ADDRESS``/``TPUJOB_NUM_PROCESSES``/
+  ``TPUJOB_PROCESS_ID`` drive ``jax.distributed.initialize`` (and
+  ``torch_xla`` via ``PJRT_*`` aliases).
+- ``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES``/``TPU_ACCELERATOR_TYPE``/
+  ``TPU_TOPOLOGY`` are the libtpu multi-host contract.
+- ``MEGASCALE_*`` appear only for multislice (num_slices > 1), carrying the
+  DCN coordinator.
+- ``MASTER_ADDR``/``MASTER_PORT``/``WORLD_SIZE``/``RANK`` are kept for
+  torch.distributed-style compatibility, with the TPU rank arithmetic:
+  WORLD_SIZE is the *process* world (hosts × slices), not the pod count.
+
+The single biggest semantic delta vs the reference (SURVEY.md §7 step 4):
+each host pod runs one XLA process owning ``devices_per_host`` chips, so
+rank/world-size derive from the slice topology, not from replica counts.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from tpujob.api import constants as c
+from tpujob.api.topology import SliceTopology
+from tpujob.api.types import TPUJob
+from tpujob.kube.control import gen_general_name
+from tpujob.kube.objects import EnvVar, Pod
+
+
+def coordinator_replica(job: TPUJob) -> str:
+    """The replica type hosting process 0: Master, or Worker for
+    master-less jobs (whose worker 0 is then the coordinator)."""
+    if c.REPLICA_TYPE_MASTER in job.spec.tpu_replica_specs:
+        return c.REPLICA_TYPE_MASTER
+    return c.REPLICA_TYPE_WORKER
+
+
+def coordinator_service_name(job_name: str, coord_rtype: str = c.REPLICA_TYPE_MASTER) -> str:
+    """The headless rendezvous service, named after the coordinator pod
+    (reference: service.go:123-139 names it {job}-master-0)."""
+    return gen_general_name(job_name, coord_rtype, 0)
+
+
+def coordinator_dns(job: TPUJob) -> str:
+    ns = job.metadata.namespace or "default"
+    return f"{coordinator_service_name(job.metadata.name, coordinator_replica(job))}.{ns}"
+
+
+def pod_name_of_process(job_name: str, pid: int, has_master: bool) -> str:
+    if has_master and pid == 0:
+        return gen_general_name(job_name, c.REPLICA_TYPE_MASTER, 0)
+    widx = pid - 1 if has_master else pid
+    return gen_general_name(job_name, c.REPLICA_TYPE_WORKER, widx)
+
+
+def worker_hostnames(
+    job: TPUJob, topo: SliceTopology, has_master: bool, slice_id: int
+) -> List[str]:
+    """Pod hostnames for the hosts of ONE slice, TPU_WORKER_ID order.
+
+    libtpu interprets the list as this slice's hosts indexed by
+    TPU_WORKER_ID; cross-slice coordination rides MEGASCALE_*, so the list
+    must not span slices.
+    """
+    base = slice_id * topo.hosts
+    return [
+        pod_name_of_process(job.metadata.name, base + h, has_master)
+        for h in range(topo.hosts)
+    ]
+
+
+def process_id_for(rtype: str, index: int, has_master: bool) -> int:
+    """Pod (rtype, index) -> global process id.  Master is process 0; worker
+    i is process i+1 (reference rank arithmetic, pod.go:267-274)."""
+    if rtype == c.REPLICA_TYPE_MASTER:
+        return 0
+    return index + 1 if has_master else index
+
+
+def cluster_env(
+    job: TPUJob,
+    rtype: str,
+    index: int,
+    topo: Optional[SliceTopology],
+    port: int,
+) -> Dict[str, str]:
+    """Compute the full injected environment for one pod."""
+    has_master = c.REPLICA_TYPE_MASTER in job.spec.tpu_replica_specs
+    is_coordinator = (rtype == coordinator_replica(job)) and index == 0
+
+    # Coordinator: process 0 resolves itself as localhost (reference
+    # pod.go:250); everyone else dials the coordinator's headless service DNS.
+    coord_host = "localhost" if is_coordinator else coordinator_dns(job)
+    coord = f"{coord_host}:{port}"
+
+    if topo is None:
+        # No TPU spec: fall back to flat 1-pod-1-process accounting, exactly
+        # the reference's WORLD_SIZE = Σ replicas (pod.go:252).
+        world = sum(
+            (r.replicas if r.replicas is not None else 1)
+            for r in job.spec.tpu_replica_specs.values()
+        )
+        pid = process_id_for(rtype, index, has_master)
+        env = {
+            "TPUJOB_COORDINATOR_ADDRESS": coord,
+            "TPUJOB_NUM_PROCESSES": str(world),
+            "TPUJOB_PROCESS_ID": str(pid),
+            "MASTER_ADDR": coord_host,
+            "MASTER_PORT": str(port),
+            "WORLD_SIZE": str(world),
+            "RANK": str(pid),
+            "PYTHONUNBUFFERED": "1",
+        }
+        return env
+
+    pid = process_id_for(rtype, index, has_master)
+    slice_id, host_index = topo.host_of_process(pid)
+    env = {
+        "PJRT_DEVICE": "TPU",
+        "TPUJOB_COORDINATOR_ADDRESS": coord,
+        "TPUJOB_NUM_PROCESSES": str(topo.num_processes),
+        "TPUJOB_PROCESS_ID": str(pid),
+        "TPUJOB_NUM_SLICES": str(topo.num_slices),
+        "TPUJOB_SLICE_ID": str(slice_id),
+        "TPUJOB_HOST_INDEX": str(host_index),
+        "TPUJOB_DEVICES_PER_HOST": str(topo.devices_per_host),
+        "TPUJOB_GLOBAL_DEVICES": str(topo.global_devices),
+        # libtpu multi-host contract (per-slice: ids and hostnames must agree)
+        "TPU_WORKER_ID": str(host_index),
+        "TPU_WORKER_HOSTNAMES": ",".join(worker_hostnames(job, topo, has_master, slice_id)),
+        "TPU_ACCELERATOR_TYPE": topo.accelerator,
+        "TPU_TOPOLOGY": topo.topology,
+        # torch.distributed-style compatibility (process-level world)
+        "MASTER_ADDR": coord_host,
+        "MASTER_PORT": str(port),
+        "WORLD_SIZE": str(topo.num_processes),
+        "RANK": str(pid),
+        "PYTHONUNBUFFERED": "1",
+    }
+    if topo.num_slices > 1:
+        env["MEGASCALE_COORDINATOR_ADDRESS"] = coordinator_dns(job)
+        env["MEGASCALE_NUM_SLICES"] = str(topo.num_slices)
+        env["MEGASCALE_SLICE_ID"] = str(slice_id)
+    return env
+
+
+def set_cluster_spec(pod: Pod, job: TPUJob, rtype: str, index: int, port: int) -> None:
+    """Inject the cluster env into every container of the pod (in place).
+
+    User-specified env wins over injected env (same precedence as the
+    reference, which appends only missing vars).
+    """
+    rspec = job.spec.tpu_replica_specs.get(rtype)
+    tpu = rspec.tpu if rspec else None
+    # the slice spec may live on either replica spec (Master carries it for
+    # single-host jobs; sharing one slice is the common case)
+    if tpu is None or not tpu.accelerator:
+        for other in job.spec.tpu_replica_specs.values():
+            if other.tpu and other.tpu.accelerator:
+                tpu = other.tpu
+                break
+    topo = tpu.resolve() if tpu and tpu.accelerator else None
+    env = cluster_env(job, rtype, index, topo, port)
+    for container in pod.spec.containers:
+        existing = {e.name for e in container.env}
+        for name, value in env.items():
+            if name not in existing:
+                container.env.append(EnvVar(name=name, value=value))

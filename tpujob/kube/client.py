@@ -1,0 +1,103 @@
+"""Typed clients over an API-server transport.
+
+The equivalent of the reference's generated clientset
+(``pkg/client/clientset/versioned/typed/pytorch/v1/pytorchjob.go``: typed
+CRUD including the UpdateStatus subresource) plus core-v1 pod/service/event
+clients.  All clients speak dicts to the transport and typed objects to
+callers.
+"""
+from __future__ import annotations
+
+from typing import Dict, Generic, List, Optional, Type, TypeVar
+
+from tpujob.api import constants as c
+from tpujob.api.types import TPUJob
+from tpujob.kube.memserver import InMemoryAPIServer, Watch
+from tpujob.kube.objects import Event, K8sObject, Pod, PodGroup, Service
+
+T = TypeVar("T", bound=K8sObject)
+
+RESOURCE_TPUJOBS = c.PLURAL
+RESOURCE_PODS = "pods"
+RESOURCE_SERVICES = "services"
+RESOURCE_EVENTS = "events"
+RESOURCE_PODGROUPS = "podgroups"
+
+
+class TypedClient(Generic[T]):
+    def __init__(self, server: InMemoryAPIServer, resource: str, cls: Type[T]):
+        self.server = server
+        self.resource = resource
+        self.cls = cls
+
+    def create(self, obj: T) -> T:
+        return self.cls.from_dict(self.server.create(self.resource, obj.to_dict()))
+
+    def get(self, namespace: str, name: str) -> T:
+        return self.cls.from_dict(self.server.get(self.resource, namespace, name))
+
+    def list(
+        self, namespace: Optional[str] = None, label_selector: Optional[Dict[str, str]] = None
+    ) -> List[T]:
+        return [
+            self.cls.from_dict(d)
+            for d in self.server.list(self.resource, namespace, label_selector)
+        ]
+
+    def update(self, obj: T) -> T:
+        return self.cls.from_dict(self.server.update(self.resource, obj.to_dict()))
+
+    def patch(self, namespace: str, name: str, patch: Dict) -> T:
+        return self.cls.from_dict(self.server.patch(self.resource, namespace, name, patch))
+
+    def delete(self, namespace: str, name: str) -> None:
+        self.server.delete(self.resource, namespace, name)
+
+    def watch(self, send_initial: bool = False) -> Watch:
+        return self.server.watch(self.resource, send_initial=send_initial)
+
+
+class TPUJobInterface(TypedClient[TPUJob]):
+    """Typed TPUJob client with the UpdateStatus subresource."""
+
+    def __init__(self, server: InMemoryAPIServer):
+        super().__init__(server, RESOURCE_TPUJOBS, TPUJob)
+
+    def update_status(self, job: TPUJob) -> TPUJob:
+        return TPUJob.from_dict(self.server.update_status(self.resource, job.to_dict()))
+
+
+class PodInterface(TypedClient[Pod]):
+    def __init__(self, server: InMemoryAPIServer):
+        super().__init__(server, RESOURCE_PODS, Pod)
+
+    def update_status(self, pod: Pod) -> Pod:
+        return Pod.from_dict(self.server.update_status(self.resource, pod.to_dict()))
+
+
+class ServiceInterface(TypedClient[Service]):
+    def __init__(self, server: InMemoryAPIServer):
+        super().__init__(server, RESOURCE_SERVICES, Service)
+
+
+class PodGroupInterface(TypedClient[PodGroup]):
+    def __init__(self, server: InMemoryAPIServer):
+        super().__init__(server, RESOURCE_PODGROUPS, PodGroup)
+
+
+class EventInterface(TypedClient[Event]):
+    def __init__(self, server: InMemoryAPIServer):
+        super().__init__(server, RESOURCE_EVENTS, Event)
+
+
+class ClientSet:
+    """All typed clients over one transport (the reference builds 4 clientsets
+    in ``app/server.go:176-199``; here one transport serves them all)."""
+
+    def __init__(self, server: InMemoryAPIServer):
+        self.server = server
+        self.tpujobs = TPUJobInterface(server)
+        self.pods = PodInterface(server)
+        self.services = ServiceInterface(server)
+        self.podgroups = PodGroupInterface(server)
+        self.events = EventInterface(server)

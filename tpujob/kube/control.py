@@ -1,0 +1,183 @@
+"""Pod/Service control and event recording.
+
+The equivalent of the vendored control layer the reference's JobController
+composes: ``vendor/.../control/pod_control.go:84-176`` (create with owner
+refs + events, delete with events), ``service_control.go`` (incl. the
+recording FakeServiceControl used by unit tests, ``service_control.go:139-218``),
+and client-go's EventRecorder.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from tpujob.api import constants as c
+from tpujob.api.types import TPUJob
+from tpujob.kube.client import ClientSet
+from tpujob.kube.memserver import now_iso
+from tpujob.kube.objects import Event, ObjectMeta, OwnerReference, Pod, Service
+
+
+def gen_owner_reference(job: TPUJob) -> OwnerReference:
+    """Controller owner ref with blockOwnerDeletion (jobcontroller.go:196-208)."""
+    return OwnerReference(
+        api_version=job.api_version,
+        kind=job.kind,
+        name=job.metadata.name,
+        uid=job.metadata.uid,
+        controller=True,
+        block_owner_deletion=True,
+    )
+
+
+def gen_labels(job_name: str) -> dict:
+    """Base labels stamped on every managed pod/service (jobcontroller.go:210-222)."""
+    safe = job_name.replace("/", "-")
+    return {
+        c.LABEL_GROUP_NAME: c.GROUP_NAME,
+        c.LABEL_JOB_NAME: safe,
+        c.LABEL_JOB_NAME_SHORT: safe,
+    }
+
+
+def gen_general_name(job_name: str, rtype: str, index: int) -> str:
+    """Pod/service name ``{job}-{rtype}-{index}`` (vendored util.go:24)."""
+    return f"{job_name}-{rtype.lower()}-{index}"
+
+
+def gen_pod_group_name(job_name: str) -> str:
+    return job_name
+
+
+class EventRecorder:
+    """Records k8s Events against the API server (client-go recorder role)."""
+
+    def __init__(self, clients: Optional[ClientSet] = None, component: str = "tpujob-operator"):
+        self.clients = clients
+        self.component = component
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.events: List[Event] = []  # local tail for tests/inspection
+
+    def event(self, obj, etype: str, reason: str, message: str) -> None:
+        meta: ObjectMeta = obj.metadata
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        ev = Event(
+            metadata=ObjectMeta(
+                name=f"{meta.name}.{seq:07x}",
+                namespace=meta.namespace or "default",
+            ),
+            type=etype,
+            reason=reason,
+            message=message,
+            involved_object={
+                "kind": getattr(obj, "kind", ""),
+                "name": meta.name,
+                "namespace": meta.namespace or "default",
+                "uid": meta.uid,
+            },
+        )
+        ev.extra["firstTimestamp"] = now_iso()
+        with self._lock:
+            self.events.append(ev)
+            if len(self.events) > 1000:
+                self.events = self.events[-500:]
+        if self.clients is not None:
+            try:
+                self.clients.events.create(ev)
+            except Exception:
+                pass  # events are best-effort, never fail reconcile
+
+
+class PodControl:
+    """Create/delete pods with controller owner refs + events
+    (pod_control.go:84-176)."""
+
+    def __init__(self, clients: ClientSet, recorder: EventRecorder):
+        self.clients = clients
+        self.recorder = recorder
+
+    def create_pod(self, namespace: str, pod: Pod, controller_object: TPUJob) -> Pod:
+        pod.metadata.namespace = namespace
+        ref = gen_owner_reference(controller_object)
+        if not any(r.uid == ref.uid for r in pod.metadata.owner_references):
+            pod.metadata.owner_references.append(ref)
+        created = self.clients.pods.create(pod)
+        self.recorder.event(
+            controller_object, "Normal", "SuccessfulCreatePod",
+            f"Created pod: {created.metadata.name}",
+        )
+        return created
+
+    def delete_pod(self, namespace: str, name: str, controller_object: TPUJob) -> None:
+        self.clients.pods.delete(namespace, name)
+        self.recorder.event(
+            controller_object, "Normal", "SuccessfulDeletePod", f"Deleted pod: {name}"
+        )
+
+
+class ServiceControl:
+    """Create/delete services with controller owner refs + events."""
+
+    def __init__(self, clients: ClientSet, recorder: EventRecorder):
+        self.clients = clients
+        self.recorder = recorder
+
+    def create_service(self, namespace: str, service: Service, controller_object: TPUJob) -> Service:
+        service.metadata.namespace = namespace
+        ref = gen_owner_reference(controller_object)
+        if not any(r.uid == ref.uid for r in service.metadata.owner_references):
+            service.metadata.owner_references.append(ref)
+        created = self.clients.services.create(service)
+        self.recorder.event(
+            controller_object, "Normal", "SuccessfulCreateService",
+            f"Created service: {created.metadata.name}",
+        )
+        return created
+
+    def delete_service(self, namespace: str, name: str, controller_object: TPUJob) -> None:
+        self.clients.services.delete(namespace, name)
+        self.recorder.event(
+            controller_object, "Normal", "SuccessfulDeleteService",
+            f"Deleted service: {name}",
+        )
+
+
+class FakePodControl(PodControl):
+    """Records create/delete calls without hitting the server; optionally
+    raises after N creates (FakePodControl in controller_utils.go)."""
+
+    def __init__(self):
+        self.templates: List[Pod] = []
+        self.deleted: List[Tuple[str, str]] = []
+        self.create_limit: Optional[int] = None
+
+    def create_pod(self, namespace, pod, controller_object):
+        if self.create_limit is not None and len(self.templates) >= self.create_limit:
+            raise RuntimeError("fake pod control: create limit exceeded")
+        pod.metadata.namespace = namespace
+        pod.metadata.owner_references.append(gen_owner_reference(controller_object))
+        self.templates.append(pod)
+        return pod
+
+    def delete_pod(self, namespace, name, controller_object):
+        self.deleted.append((namespace, name))
+
+
+class FakeServiceControl(ServiceControl):
+    """Mirror of FakeServiceControl (service_control.go:139-218)."""
+
+    def __init__(self):
+        self.templates: List[Service] = []
+        self.deleted: List[Tuple[str, str]] = []
+
+    def create_service(self, namespace, service, controller_object):
+        service.metadata.namespace = namespace
+        service.metadata.owner_references.append(gen_owner_reference(controller_object))
+        self.templates.append(service)
+        return service
+
+    def delete_service(self, namespace, name, controller_object):
+        self.deleted.append((namespace, name))

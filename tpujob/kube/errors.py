@@ -1,0 +1,49 @@
+"""Kubernetes-style API errors.
+
+The controller's reconcile logic branches on these the way the reference
+branches on ``k8s.io/apimachinery`` status errors (e.g. IsNotFound in
+``pkg/controller.v1/pytorch/controller.go:309-313``).
+"""
+from __future__ import annotations
+
+
+class ApiError(Exception):
+    code = 500
+    reason = "InternalError"
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.reason)
+
+
+class NotFoundError(ApiError):
+    code = 404
+    reason = "NotFound"
+
+
+class AlreadyExistsError(ApiError):
+    code = 409
+    reason = "AlreadyExists"
+
+
+class ConflictError(ApiError):
+    """resourceVersion conflict on update (optimistic concurrency)."""
+
+    code = 409
+    reason = "Conflict"
+
+
+class InvalidError(ApiError):
+    code = 422
+    reason = "Invalid"
+
+
+def is_not_found(e: Exception) -> bool:
+    return isinstance(e, NotFoundError)
+
+
+def is_already_exists(e: Exception) -> bool:
+    return isinstance(e, AlreadyExistsError)
+
+
+def is_conflict(e: Exception) -> bool:
+    return isinstance(e, ConflictError)

@@ -1,0 +1,266 @@
+"""In-memory Kubernetes API server simulator.
+
+This is the cluster substrate the controller, SDK and tests run against when
+no real cluster is present — the same role the fake clientset + informer
+indexers play in the reference's test strategy (SURVEY.md §4: "the cluster is
+simulated as indexer contents"), but implemented as a real API server
+simulation: optimistic concurrency via resourceVersion, watch streams, label
+selectors, owner-reference cascade GC.
+
+A pluggable real transport (kubernetes python client) can implement the same
+``ApiServer`` surface later; everything above (clients, informers,
+controller, SDK) is transport-agnostic.
+"""
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from tpujob.kube.errors import (
+    AlreadyExistsError,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+)
+
+# Event types on watch streams
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+def now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    resource: str  # plural, e.g. "pods"
+    object: Dict[str, Any]  # serialized object
+
+
+def match_labels(selector: Optional[Dict[str, str]], labels: Dict[str, str]) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+@dataclass
+class _Store:
+    """Objects of one resource type, keyed namespace/name."""
+
+    objects: Dict[Tuple[str, str], Dict[str, Any]] = field(default_factory=dict)
+
+
+class Watch:
+    """One subscriber's watch stream (a bounded queue of WatchEvents)."""
+
+    def __init__(self, server: "InMemoryAPIServer", maxsize: int = 10000):
+        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue(maxsize=maxsize)
+        self._server = server
+        self._stopped = False
+
+    def _put(self, ev: WatchEvent) -> None:
+        if not self._stopped:
+            self._q.put(ev)
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._q.put(None)
+        self._server._remove_watch(self)
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                return
+            yield ev
+
+    def poll(self, timeout: float = 0.0) -> Optional[WatchEvent]:
+        try:
+            return self._q.get(timeout=timeout) if timeout else self._q.get_nowait()
+        except queue.Empty:
+            return None
+
+
+class InMemoryAPIServer:
+    """Thread-safe in-memory API server with watches and cascade GC."""
+
+    def __init__(self, enable_gc: bool = True):
+        self._lock = threading.RLock()
+        self._stores: Dict[str, _Store] = {}
+        self._watches: List[Tuple[Optional[str], Watch]] = []  # (resource | None=all, watch)
+        self._rv = 0
+        self._enable_gc = enable_gc
+        # hooks: callables invoked (event_type, resource, obj_dict) after commit
+        self.hooks: List[Callable[[str, str, Dict[str, Any]], None]] = []
+
+    # -- internals ----------------------------------------------------------
+
+    def _store(self, resource: str) -> _Store:
+        return self._stores.setdefault(resource, _Store())
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _key(self, obj: Dict[str, Any]) -> Tuple[str, str]:
+        meta = obj.get("metadata") or {}
+        name = meta.get("name") or ""
+        if not name:
+            raise InvalidError("metadata.name is required")
+        return (meta.get("namespace") or "default", name)
+
+    def _broadcast(self, ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
+        ev = WatchEvent(ev_type, resource, copy.deepcopy(obj))
+        for res, w in list(self._watches):
+            if res is None or res == resource:
+                w._put(ev)
+        for hook in list(self.hooks):
+            hook(ev_type, resource, copy.deepcopy(obj))
+
+    def _remove_watch(self, watch: Watch) -> None:
+        with self._lock:
+            self._watches = [(r, w) for (r, w) in self._watches if w is not watch]
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            key = self._key(obj)
+            store = self._store(resource)
+            if key in store.objects:
+                raise AlreadyExistsError(f"{resource} {key[0]}/{key[1]} already exists")
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("namespace", key[0])
+            meta["uid"] = meta.get("uid") or str(uuid.uuid4())
+            meta["resourceVersion"] = self._next_rv()
+            meta.setdefault("creationTimestamp", now_iso())
+            store.objects[key] = obj
+            self._broadcast(ADDED, resource, obj)
+            return copy.deepcopy(obj)
+
+    def get(self, resource: str, namespace: str, name: str) -> Dict[str, Any]:
+        with self._lock:
+            obj = self._store(resource).objects.get((namespace or "default", name))
+            if obj is None:
+                raise NotFoundError(f"{resource} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def list(
+        self,
+        resource: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = []
+            for (ns, _), obj in self._store(resource).objects.items():
+                if namespace and ns != namespace:
+                    continue
+                labels = (obj.get("metadata") or {}).get("labels") or {}
+                if match_labels(label_selector, labels):
+                    out.append(copy.deepcopy(obj))
+            return out
+
+    def update(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            key = self._key(obj)
+            store = self._store(resource)
+            current = store.objects.get(key)
+            if current is None:
+                raise NotFoundError(f"{resource} {key[0]}/{key[1]} not found")
+            rv = (obj.get("metadata") or {}).get("resourceVersion")
+            cur_rv = (current.get("metadata") or {}).get("resourceVersion")
+            if rv and rv != cur_rv:
+                raise ConflictError(
+                    f"{resource} {key[0]}/{key[1]}: resourceVersion {rv} != {cur_rv}"
+                )
+            meta = obj.setdefault("metadata", {})
+            meta["uid"] = (current.get("metadata") or {}).get("uid")
+            meta["creationTimestamp"] = (current.get("metadata") or {}).get("creationTimestamp")
+            meta["resourceVersion"] = self._next_rv()
+            store.objects[key] = obj
+            self._broadcast(MODIFIED, resource, obj)
+            return copy.deepcopy(obj)
+
+    def update_status(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """Status-subresource update: only .status is taken from `obj`."""
+        with self._lock:
+            key = self._key(obj)
+            current = self._store(resource).objects.get(key)
+            if current is None:
+                raise NotFoundError(f"{resource} {key[0]}/{key[1]} not found")
+            merged = copy.deepcopy(current)
+            merged["status"] = copy.deepcopy(obj.get("status") or {})
+            merged["metadata"]["resourceVersion"] = self._next_rv()
+            self._store(resource).objects[key] = merged
+            self._broadcast(MODIFIED, resource, merged)
+            return copy.deepcopy(merged)
+
+    def patch(self, resource: str, namespace: str, name: str, patch: Dict[str, Any]) -> Dict[str, Any]:
+        """Strategic-merge-ish patch (recursive dict merge; lists replaced)."""
+        with self._lock:
+            key = (namespace or "default", name)
+            current = self._store(resource).objects.get(key)
+            if current is None:
+                raise NotFoundError(f"{resource} {namespace}/{name} not found")
+            merged = copy.deepcopy(current)
+            _merge(merged, patch)
+            merged["metadata"]["resourceVersion"] = self._next_rv()
+            self._store(resource).objects[key] = merged
+            self._broadcast(MODIFIED, resource, merged)
+            return copy.deepcopy(merged)
+
+    def delete(self, resource: str, namespace: str, name: str) -> None:
+        with self._lock:
+            key = (namespace or "default", name)
+            obj = self._store(resource).objects.pop(key, None)
+            if obj is None:
+                raise NotFoundError(f"{resource} {namespace}/{name} not found")
+            self._broadcast(DELETED, resource, obj)
+            if self._enable_gc:
+                self._gc_dependents((obj.get("metadata") or {}).get("uid"))
+
+    def _gc_dependents(self, owner_uid: Optional[str]) -> None:
+        """Cascade-delete objects controller-owned by `owner_uid` (k8s GC)."""
+        if not owner_uid:
+            return
+        for resource, store in list(self._stores.items()):
+            for key, obj in list(store.objects.items()):
+                refs = ((obj.get("metadata") or {}).get("ownerReferences")) or []
+                if any(r.get("uid") == owner_uid and r.get("controller") for r in refs):
+                    store.objects.pop(key, None)
+                    self._broadcast(DELETED, resource, obj)
+                    self._gc_dependents((obj.get("metadata") or {}).get("uid"))
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, resource: Optional[str] = None, send_initial: bool = False) -> Watch:
+        with self._lock:
+            w = Watch(self)
+            if send_initial:
+                resources = [resource] if resource else list(self._stores)
+                for res in resources:
+                    for obj in self._store(res).objects.values():
+                        w._put(WatchEvent(ADDED, res, copy.deepcopy(obj)))
+            self._watches.append((resource, w))
+            return w
+
+
+def _merge(dst: Dict[str, Any], patch: Dict[str, Any]) -> None:
+    for k, v in patch.items():
+        if v is None:
+            dst.pop(k, None)
+        elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _merge(dst[k], v)
+        else:
+            dst[k] = copy.deepcopy(v)

@@ -28,9 +28,16 @@ def _camel(name: str) -> str:
     return parts[0] + "".join(p.title() for p in parts[1:])
 
 
-def _is_empty(v: Any) -> bool:
-    # Go omitempty semantics: nil, "", 0, false, empty list/map are omitted.
-    return v is None or v == [] or v == {} or v == "" or v is False or (
+def _is_empty(v: Any, f: dataclasses.Field) -> bool:
+    # Go omitempty semantics — with the pointer-field caveat: fields declared
+    # Optional with default None (the *int64-style fields: replicas,
+    # activeDeadlineSeconds, backoffLimit...) only omit None, so explicit
+    # zeros survive the round-trip.
+    if v is None or v == [] or v == {}:
+        return True
+    if f.default is None:
+        return False
+    return v == "" or v is False or (
         isinstance(v, int) and not isinstance(v, bool) and v == 0
     )
 
@@ -44,7 +51,7 @@ class K8sObject:
             v = getattr(self, f.name)
             if f.name == "extra":
                 continue
-            if _is_empty(v):
+            if _is_empty(v, f):
                 continue
             out[_camel(f.name)] = _serialize(v)
         extra = getattr(self, "extra", None)

@@ -1,0 +1,165 @@
+"""Native controller kernel bindings.
+
+Loads ``libtpujob_native.so`` (built by ``make -C native``) via ctypes and
+exposes :class:`WorkQueue` / :class:`ExpectationsCache` /
+:func:`is_retryable_exit_code`.  When the shared library is absent the
+pure-Python implementations in :mod:`tpujob.runtime.pyfallback` (identical
+semantics, same tests) are used, so the framework never hard-depends on the
+build step.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "libtpujob_native.so")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("TPUJOB_DISABLE_NATIVE"):
+        return None
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.tq_new.restype = ctypes.c_void_p
+    lib.tq_new.argtypes = [ctypes.c_int64, ctypes.c_int64]
+    lib.tq_free.argtypes = [ctypes.c_void_p]
+    lib.tq_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tq_add_after.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.tq_add_rate_limited.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tq_forget.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tq_num_requeues.restype = ctypes.c_int
+    lib.tq_num_requeues.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tq_get.restype = ctypes.c_int
+    lib.tq_get.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int]
+    lib.tq_done.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tq_len.restype = ctypes.c_int
+    lib.tq_len.argtypes = [ctypes.c_void_p]
+    lib.tq_shutdown.argtypes = [ctypes.c_void_p]
+    lib.tq_shutting_down.restype = ctypes.c_int
+    lib.tq_shutting_down.argtypes = [ctypes.c_void_p]
+    lib.te_new.restype = ctypes.c_void_p
+    lib.te_new.argtypes = [ctypes.c_int64]
+    lib.te_free.argtypes = [ctypes.c_void_p]
+    lib.te_expect.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.te_observe_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.te_observe_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.te_satisfied.restype = ctypes.c_int
+    lib.te_satisfied.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.te_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tn_retryable_exit_code.restype = ctypes.c_int
+    lib.tn_retryable_exit_code.argtypes = [ctypes.c_int]
+    lib.tn_version.restype = ctypes.c_char_p
+    return lib
+
+
+_lib = _load()
+NATIVE_AVAILABLE = _lib is not None
+
+
+class SHUTDOWN(Exception):
+    """Raised by WorkQueue.get() when the queue has been shut down."""
+
+
+class _NativeWorkQueue:
+    """Rate-limited delaying work queue (client-go semantics), C++ backend."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self._h = _lib.tq_new(int(base_delay * 1000), int(max_delay * 1000))
+
+    def add(self, key: str) -> None:
+        _lib.tq_add(self._h, key.encode())
+
+    def add_after(self, key: str, delay: float) -> None:
+        _lib.tq_add_after(self._h, key.encode(), int(delay * 1000))
+
+    def add_rate_limited(self, key: str) -> None:
+        _lib.tq_add_rate_limited(self._h, key.encode())
+
+    def forget(self, key: str) -> None:
+        _lib.tq_forget(self._h, key.encode())
+
+    def num_requeues(self, key: str) -> int:
+        return _lib.tq_num_requeues(self._h, key.encode())
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        """Blocking dequeue.  None on timeout; raises SHUTDOWN when drained."""
+        t = -1 if timeout is None else int(timeout * 1000)
+        # per-call buffer: concurrent getters must not share output storage
+        buf = ctypes.create_string_buffer(4096)
+        rc = _lib.tq_get(self._h, t, buf, len(buf))
+        if rc == 0:
+            return buf.value.decode()
+        if rc == -1:
+            return None
+        if rc == -2:
+            raise SHUTDOWN()
+        raise RuntimeError(f"workqueue get failed: rc={rc}")
+
+    def done(self, key: str) -> None:
+        _lib.tq_done(self._h, key.encode())
+
+    def __len__(self) -> int:
+        return _lib.tq_len(self._h)
+
+    def shutdown(self) -> None:
+        _lib.tq_shutdown(self._h)
+
+    @property
+    def shutting_down(self) -> bool:
+        return bool(_lib.tq_shutting_down(self._h))
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and _lib is not None:
+            _lib.tq_free(h)
+
+
+class _NativeExpectations:
+    """Per-key expected create/delete counters with TTL, C++ backend."""
+
+    def __init__(self, ttl: float = 300.0):
+        self._h = _lib.te_new(int(ttl * 1000))
+
+    def expect(self, key: str, adds: int = 0, dels: int = 0) -> None:
+        _lib.te_expect(self._h, key.encode(), adds, dels)
+
+    def observe_add(self, key: str) -> None:
+        _lib.te_observe_add(self._h, key.encode())
+
+    def observe_del(self, key: str) -> None:
+        _lib.te_observe_del(self._h, key.encode())
+
+    def satisfied(self, key: str) -> bool:
+        return bool(_lib.te_satisfied(self._h, key.encode()))
+
+    def delete(self, key: str) -> None:
+        _lib.te_delete(self._h, key.encode())
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and _lib is not None:
+            _lib.te_free(h)
+
+
+def _native_retryable(code: int) -> bool:
+    return bool(_lib.tn_retryable_exit_code(code))
+
+
+if NATIVE_AVAILABLE:
+    WorkQueue = _NativeWorkQueue
+    ExpectationsCache = _NativeExpectations
+    is_retryable_exit_code = _native_retryable
+    native_version = _lib.tn_version().decode()
+else:  # pure-Python fallback
+    from tpujob.runtime.pyfallback import (  # noqa: F401
+        PyExpectations as ExpectationsCache,
+        PyWorkQueue as WorkQueue,
+        py_retryable_exit_code as is_retryable_exit_code,
+    )
+
+    native_version = "python-fallback"

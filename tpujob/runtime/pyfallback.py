@@ -1,0 +1,177 @@
+"""Pure-Python fallback for the native controller kernel.
+
+Semantics are identical to native/tpujob_native.cpp (the shared test suite
+runs against both backends).
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpujob.runtime import SHUTDOWN  # type: ignore  # circular-safe: defined first
+
+
+class PyWorkQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
+        self._base = base_delay
+        self._max = max_delay
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: List[str] = []
+        self._queued: Set[str] = set()
+        self._processing: Set[str] = set()
+        self._dirty: Set[str] = set()
+        self._delayed: List[Tuple[float, int, str]] = []  # (when, seq, key)
+        self._seq = 0
+        self._failures: Dict[str, int] = {}
+        self._shutting_down = False
+
+    def _add_locked(self, key: str) -> None:
+        if key in self._processing:
+            self._dirty.add(key)
+            return
+        if key in self._queued:
+            return
+        self._queued.add(key)
+        self._queue.append(key)
+
+    def _promote_locked(self) -> None:
+        now = time.monotonic()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, key = heapq.heappop(self._delayed)
+            self._add_locked(key)
+
+    def add(self, key: str) -> None:
+        with self._cv:
+            if self._shutting_down:
+                return
+            self._add_locked(key)
+            self._cv.notify()
+
+    def add_after(self, key: str, delay: float) -> None:
+        with self._cv:
+            if self._shutting_down:
+                return
+            if delay <= 0:
+                self._add_locked(key)
+            else:
+                self._seq += 1
+                heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, key))
+            self._cv.notify()
+
+    def add_rate_limited(self, key: str) -> None:
+        with self._cv:
+            n = self._failures.get(key, 0) + 1
+            self._failures[key] = n
+        delay = min(self._base * (2 ** (n - 1)), self._max)
+        self.add_after(key, delay)
+
+    def forget(self, key: str) -> None:
+        with self._cv:
+            self._failures.pop(key, None)
+
+    def num_requeues(self, key: str) -> int:
+        with self._cv:
+            return self._failures.get(key, 0)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                self._promote_locked()
+                if self._queue:
+                    break
+                if self._shutting_down:
+                    raise SHUTDOWN()
+                wait = None
+                if self._delayed:
+                    wait = max(0.0, self._delayed[0][0] - time.monotonic())
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cv.wait(wait)
+            key = self._queue.pop(0)
+            self._queued.discard(key)
+            self._processing.add(key)
+            return key
+
+    def done(self, key: str) -> None:
+        with self._cv:
+            self._processing.discard(key)
+            if key in self._dirty:
+                self._dirty.discard(key)
+                self._add_locked(key)
+                self._cv.notify()
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutting_down = True
+            self._cv.notify_all()
+
+    @property
+    def shutting_down(self) -> bool:
+        with self._cv:
+            return self._shutting_down
+
+
+class PyExpectations:
+    def __init__(self, ttl: float = 300.0):
+        self._ttl = ttl
+        self._lock = threading.Lock()
+        self._entries: Dict[str, Tuple[int, int, float]] = {}  # adds, dels, created
+
+    def expect(self, key: str, adds: int = 0, dels: int = 0) -> None:
+        """Accumulates onto a live entry (RaiseExpectations semantics):
+        creating N pods in one sync raises the expectation N times."""
+        with self._lock:
+            e = self._entries.get(key)
+            now = time.monotonic()
+            if e is not None and (e[0] > 0 or e[1] > 0) and now - e[2] <= self._ttl:
+                self._entries[key] = (e[0] + adds, e[1] + dels, e[2])
+            else:
+                self._entries[key] = (adds, dels, now)
+
+    def _observe(self, key: str, add: bool) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return
+            adds, dels, created = e
+            if add and adds > 0:
+                adds -= 1
+            elif not add and dels > 0:
+                dels -= 1
+            self._entries[key] = (adds, dels, created)
+
+    def observe_add(self, key: str) -> None:
+        self._observe(key, True)
+
+    def observe_del(self, key: str) -> None:
+        self._observe(key, False)
+
+    def satisfied(self, key: str) -> bool:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return True
+            adds, dels, created = e
+            if adds <= 0 and dels <= 0:
+                return True
+            return time.monotonic() - created > self._ttl  # expired => resync
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+
+def py_retryable_exit_code(code: int) -> bool:
+    """train_util.go:18-53 table: SIGINT/SIGKILL/SIGUSR1/SIGTERM retryable."""
+    return code in (130, 137, 138, 143)

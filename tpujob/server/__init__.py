@@ -1,0 +1,1 @@
+"""Operator server: entrypoint, options, leader election, metrics."""

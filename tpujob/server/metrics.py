@@ -1,0 +1,86 @@
+"""Prometheus-style metrics registry.
+
+The reference exposes 7 series via promauto (SURVEY.md §5): jobs
+created/deleted/successful/failed/restarted totals, plus the is_leader
+gauge, served at ``--monitoring-port`` (``main.go:31-40``).  This module is
+the registry; ``tpujob.server.monitoring`` serves it in Prometheus text
+exposition format.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str, registry: "Registry"):
+        self.name = name
+        self.help = help_text
+        self._value = 0.0
+        self._lock = threading.Lock()
+        registry._register(self)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def kind(self) -> str:
+        return "counter"
+
+
+class Gauge(Counter):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def kind(self) -> str:
+        return "gauge"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, Counter] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, m: Counter) -> None:
+        with self._lock:
+            self._metrics[m.name] = m
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind()}")
+            v = m.value
+            lines.append(f"{m.name} {int(v) if v == int(v) else v}")
+        return "\n".join(lines) + "\n"
+
+
+# Global registry with the reference's 7 series (renamed for tpujob).
+REGISTRY = Registry()
+jobs_created = Counter(
+    "tpujob_operator_jobs_created_total", "Counts number of TPU jobs created", REGISTRY
+)
+jobs_deleted = Counter(
+    "tpujob_operator_jobs_deleted_total", "Counts number of TPU jobs deleted", REGISTRY
+)
+jobs_successful = Counter(
+    "tpujob_operator_jobs_successful_total", "Counts number of TPU jobs successful", REGISTRY
+)
+jobs_failed = Counter(
+    "tpujob_operator_jobs_failed_total", "Counts number of TPU jobs failed", REGISTRY
+)
+jobs_restarted = Counter(
+    "tpujob_operator_jobs_restarted_total", "Counts number of TPU jobs restarted", REGISTRY
+)
+is_leader = Gauge(
+    "tpujob_operator_is_leader", "Whether this operator instance is the leader", REGISTRY
+)
