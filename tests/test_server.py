@@ -1,0 +1,239 @@
+"""Server layer: HTTP API transport, leader election, monitoring, app wiring."""
+import threading
+import time
+
+import pytest
+
+from tpujob.api import constants as c
+from tpujob.kube.client import ClientSet
+from tpujob.kube.errors import ConflictError, NotFoundError
+from tpujob.kube.httpclient import HTTPApiClient
+from tpujob.kube.httpserver import APIServerHTTP
+from tpujob.server.app import OperatorApp
+from tpujob.server.leader_election import LeaderElector
+from tpujob.server.monitoring import MonitoringServer
+from tpujob.server.options import ServerOption, parse_options
+
+from jobtestutil import new_tpujob
+
+
+@pytest.fixture
+def http_api():
+    server = APIServerHTTP().start()
+    yield server
+    server.stop()
+
+
+def test_http_transport_crud(http_api):
+    client = HTTPApiClient(http_api.address)
+    assert client.healthy()
+    created = client.create("pods", {"metadata": {"name": "p", "labels": {"a": "1"}}})
+    assert created["metadata"]["uid"]
+    assert client.get("pods", "default", "p")["metadata"]["name"] == "p"
+    assert len(client.list("pods", label_selector={"a": "1"})) == 1
+    assert client.list("pods", label_selector={"a": "2"}) == []
+    created["spec"] = {"nodeName": "n"}
+    updated = client.update("pods", created)
+    assert updated["spec"]["nodeName"] == "n"
+    with pytest.raises(ConflictError):
+        client.update("pods", created)  # stale rv
+    client.update_status("pods", {"metadata": {"name": "p"}, "status": {"phase": "Running"}})
+    assert client.get("pods", "default", "p")["status"]["phase"] == "Running"
+    patched = client.patch("pods", "default", "p", {"metadata": {"labels": {"b": "2"}}})
+    assert patched["metadata"]["labels"] == {"a": "1", "b": "2"}
+    client.delete("pods", "default", "p")
+    with pytest.raises(NotFoundError):
+        client.get("pods", "default", "p")
+
+
+def test_http_watch_stream(http_api):
+    client = HTTPApiClient(http_api.address)
+    watch = client.watch("pods")
+    time.sleep(0.1)  # stream established
+    client.create("pods", {"metadata": {"name": "a"}})
+    client.delete("pods", "default", "a")
+    evs = [watch.poll(timeout=2), watch.poll(timeout=2)]
+    assert [e.type for e in evs] == ["ADDED", "DELETED"]
+    watch.stop()
+
+
+def test_controller_over_http_transport(http_api):
+    """The full reconcile loop across a real network boundary."""
+    from tpujob.controller.reconciler import TPUJobController
+
+    client = HTTPApiClient(http_api.address)
+    clients = ClientSet(client)
+    ctrl = TPUJobController(clients)
+    stop = threading.Event()
+    ctrl.run(stop, threadiness=1)
+    try:
+        clients.tpujobs.create(new_tpujob(workers=1))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(clients.pods.list()) < 2:
+            time.sleep(0.05)
+        assert len(clients.pods.list()) == 2
+        pod = clients.pods.get("default", "test-job-master-0")
+        pod.status.phase = "Succeeded"
+        clients.pods.update_status(pod)
+        wpod = clients.pods.get("default", "test-job-worker-0")
+        wpod.status.phase = "Succeeded"
+        clients.pods.update_status(wpod)
+        deadline = time.monotonic() + 5
+        done = False
+        while time.monotonic() < deadline:
+            job = clients.tpujobs.get("default", "test-job")
+            if any(x.type == c.JOB_SUCCEEDED and x.status == "True"
+                   for x in job.status.conditions):
+                done = True
+                break
+            time.sleep(0.05)
+        assert done
+    finally:
+        stop.set()
+        ctrl.queue.shutdown()
+        ctrl.factory.stop()
+
+
+def test_leader_election_single_winner():
+    from tpujob.kube.memserver import InMemoryAPIServer
+
+    server = InMemoryAPIServer()
+    stop = threading.Event()
+    leaders = []
+    lock = threading.Lock()
+
+    def make(identity):
+        def on_lead():
+            with lock:
+                leaders.append(identity)
+
+        return LeaderElector(server, identity=identity, lease_duration=0.5,
+                             renew_deadline=0.2, retry_period=0.05,
+                             on_started_leading=on_lead)
+
+    e1, e2 = make("op-1"), make("op-2")
+    t1 = threading.Thread(target=e1.run, args=(stop,), daemon=True)
+    t2 = threading.Thread(target=e2.run, args=(stop,), daemon=True)
+    t1.start()
+    time.sleep(0.1)
+    t2.start()
+    time.sleep(0.3)
+    assert leaders == ["op-1"]  # exactly one leader
+    assert e1.is_leader and not e2.is_leader
+    stop.set()
+    t1.join(timeout=2)
+    t2.join(timeout=2)
+
+
+def test_leader_failover_on_lease_expiry():
+    from tpujob.kube.memserver import InMemoryAPIServer
+
+    server = InMemoryAPIServer()
+    stop1, stop2 = threading.Event(), threading.Event()
+    leaders = []
+    e1 = LeaderElector(server, identity="op-1", lease_duration=0.3,
+                       renew_deadline=0.15, retry_period=0.05,
+                       on_started_leading=lambda: leaders.append("op-1"))
+    e2 = LeaderElector(server, identity="op-2", lease_duration=0.3,
+                       renew_deadline=0.15, retry_period=0.05,
+                       on_started_leading=lambda: leaders.append("op-2"))
+    t1 = threading.Thread(target=e1.run, args=(stop1,), daemon=True)
+    t1.start()
+    time.sleep(0.1)
+    t2 = threading.Thread(target=e2.run, args=(stop2,), daemon=True)
+    t2.start()
+    time.sleep(0.1)
+    stop1.set()  # graceful stop releases the lease
+    t1.join(timeout=2)
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and not e2.is_leader:
+        time.sleep(0.05)
+    assert e2.is_leader
+    assert leaders == ["op-1", "op-2"]
+    stop2.set()
+    t2.join(timeout=2)
+
+
+def test_monitoring_endpoint():
+    import urllib.request
+
+    mon = MonitoringServer(host="127.0.0.1", port=0).start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{mon.port}/metrics").read().decode()
+        assert "tpujob_operator_jobs_created_total" in body
+        assert "# TYPE tpujob_operator_is_leader gauge" in body
+        health = urllib.request.urlopen(
+            f"http://127.0.0.1:{mon.port}/healthz").read()
+        assert health == b"ok"
+    finally:
+        mon.stop()
+
+
+def test_options_parsing():
+    opt = parse_options(["--threadiness", "4", "--enable-gang-scheduling",
+                         "--monitoring-port", "0", "--apiserver", "http://x:1"])
+    assert opt.threadiness == 4
+    assert opt.enable_gang_scheduling
+    assert opt.monitoring_port == 0
+    assert opt.apiserver == "http://x:1"
+    assert parse_options([]).gang_scheduler_name == "volcano"
+
+
+def test_operator_app_end_to_end():
+    """Full app wiring: leader election -> controller -> job lifecycle."""
+    opt = ServerOption(monitoring_port=0, lease_duration_s=1.0,
+                       renew_deadline_s=0.4, retry_period_s=0.1)
+    app = OperatorApp(opt)
+    thread = threading.Thread(target=app.run, kwargs={"block": True}, daemon=True)
+    thread.start()
+    try:
+        clients = app.clients
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and not app.controller.job_informer.has_synced():
+            time.sleep(0.05)
+        clients.tpujobs.create(new_tpujob(workers=1))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(clients.pods.list()) < 2:
+            time.sleep(0.05)
+        assert len(clients.pods.list()) == 2
+        from tpujob.server.metrics import is_leader
+
+        assert is_leader.value == 1
+    finally:
+        app.stop_event.set()
+        thread.join(timeout=3)
+        app.shutdown()
+
+
+def test_watch_reconnect_after_apiserver_restart():
+    """A dead watch stream must be detected and re-established (informer
+    relist), not spun on forever."""
+    from tpujob.kube.informers import InformerFactory
+
+    server = APIServerHTTP(port=0)
+    port = server.httpd.server_address[1]
+    server.start()
+    client = HTTPApiClient(f"http://127.0.0.1:{port}")
+    client.create("pods", {"metadata": {"name": "before"}})
+    factory = InformerFactory(client)
+    inf = factory.informer("pods")
+    inf.sync_once()
+    assert {o["metadata"]["name"] for o in inf.store.list()} == {"before"}
+
+    backend = server.backend
+    server.stop()  # stream dies
+    deadline = time.time() + 3
+    while time.time() < deadline and not inf._watch.closed:
+        time.sleep(0.05)
+    assert inf._watch.closed
+
+    # same backend comes back on the same port with new state
+    server2 = APIServerHTTP(port=port, backend=backend).start()
+    try:
+        client2_state = {"metadata": {"name": "after"}}
+        HTTPApiClient(f"http://127.0.0.1:{port}").create("pods", client2_state)
+        inf.sync_once()  # detects closed watch, relists + rewatches
+        assert {o["metadata"]["name"] for o in inf.store.list()} == {"before", "after"}
+    finally:
+        server2.stop()

@@ -1,0 +1,197 @@
+"""HTTP client transport implementing the ApiServer interface.
+
+Speaks to :mod:`tpujob.kube.httpserver` over REST, so clients/informers/
+controllers work identically over the network or in-process (the same
+duck-typed surface as :class:`InMemoryAPIServer`).
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import queue
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Iterator, List, Optional
+
+log = logging.getLogger("tpujob.httpclient")
+
+from tpujob.kube.errors import (
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+)
+from tpujob.kube.memserver import WatchEvent
+
+
+def _raise_for(status: int, payload: Dict[str, Any]) -> None:
+    reason = payload.get("reason", "")
+    message = payload.get("message", "")
+    if reason == "NotFound" or status == 404:
+        raise NotFoundError(message)
+    if reason == "AlreadyExists":
+        raise AlreadyExistsError(message)
+    if reason == "Conflict" or status == 409:
+        raise ConflictError(message)
+    if reason == "Invalid" or status == 422:
+        raise InvalidError(message)
+    raise ApiError(message or f"HTTP {status}")
+
+
+class HTTPWatch:
+    """Client side of an ndjson watch stream (same surface as memserver.Watch).
+
+    A dead stream is observable via ``closed`` so consumers (informers) can
+    re-establish the watch instead of spinning on a frozen one.
+    """
+
+    def __init__(self, url: str):
+        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._stopped = threading.Event()
+        self.closed = False
+        self._resp = urllib.request.urlopen(url)  # noqa: S310 (local trusted)
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self) -> None:
+        try:
+            for raw in self._resp:
+                if self._stopped.is_set():
+                    break
+                line = raw.strip()
+                if not line or line.startswith(b":"):
+                    continue
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    log.warning("watch stream: malformed line %r; closing", line[:200])
+                    break
+                self._q.put(WatchEvent(d["type"], "", d["object"]))
+        except Exception as e:
+            if not self._stopped.is_set():
+                log.warning("watch stream terminated: %s", e)
+        finally:
+            self.closed = True
+            self._q.put(None)
+
+    def poll(self, timeout: float = 0.0) -> Optional[WatchEvent]:
+        try:
+            ev = self._q.get(timeout=timeout) if timeout else self._q.get_nowait()
+        except queue.Empty:
+            return None
+        return ev
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._resp.close()
+        except Exception:
+            pass
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                return
+            yield ev
+
+
+class HTTPApiClient:
+    """ApiServer-interface client over HTTP."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        parsed = urllib.parse.urlparse(self.base_url)
+        self._host = parsed.hostname or "127.0.0.1"
+        self._port = parsed.port or (443 if parsed.scheme == "https" else 80)
+        self.timeout = timeout
+        self.hooks: List = []  # parity with InMemoryAPIServer surface
+        self._local = threading.local()  # per-thread keep-alive connection
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _conn(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(self._host, self._port, timeout=self.timeout)
+            self._local.conn = conn
+        return conn
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            self._local.conn = None
+
+    def _request(self, method: str, path: str, body: Optional[Dict] = None) -> Dict[str, Any]:
+        data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        last_err: Optional[Exception] = None
+        for attempt in range(2):  # retry once on a stale keep-alive socket
+            conn = self._conn()
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
+                payload_raw = resp.read() or b"{}"
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                self._drop_conn()
+                last_err = e
+                continue
+            if resp.status >= 400:
+                try:
+                    payload = json.loads(payload_raw)
+                except ValueError:
+                    payload = {}
+                _raise_for(resp.status, payload)
+            return json.loads(payload_raw)
+        raise ApiError(f"cannot reach API server at {self.base_url}: {last_err}")
+
+    # -- ApiServer surface ---------------------------------------------------
+
+    def create(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self._request("POST", f"/api/{resource}", obj)
+
+    def get(self, resource: str, namespace: str, name: str) -> Dict[str, Any]:
+        return self._request("GET", f"/api/{resource}/{namespace or 'default'}/{name}")
+
+    def list(self, resource: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
+        params = []
+        if namespace:
+            params.append(f"namespace={namespace}")
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            params.append(f"labelSelector={sel}")
+        q = ("?" + "&".join(params)) if params else ""
+        return self._request("GET", f"/api/{resource}{q}").get("items", [])
+
+    def update(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self._request("PUT", f"/api/{resource}", obj)
+
+    def update_status(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self._request("PUT", f"/api/{resource}/status", obj)
+
+    def patch(self, resource: str, namespace: str, name: str, patch: Dict) -> Dict[str, Any]:
+        return self._request("PATCH", f"/api/{resource}/{namespace or 'default'}/{name}", patch)
+
+    def delete(self, resource: str, namespace: str, name: str) -> None:
+        self._request("DELETE", f"/api/{resource}/{namespace or 'default'}/{name}")
+
+    def watch(self, resource: Optional[str] = None, send_initial: bool = False) -> HTTPWatch:
+        if resource is None:
+            raise InvalidError("HTTP transport requires a per-resource watch")
+        suffix = "?initial=1" if send_initial else ""
+        return HTTPWatch(f"{self.base_url}/watch/{resource}{suffix}")
+
+    def healthy(self) -> bool:
+        try:
+            return self._request("GET", "/healthz").get("status") == "ok"
+        except Exception:
+            return False

@@ -1,0 +1,208 @@
+"""HTTP front-end for the in-memory API server.
+
+Gives the framework a real API-server process boundary: the operator, the
+SDK and E2E tests can all talk REST to one ``tpujob-apiserver`` process the
+way the reference components talk to the Kubernetes API server.  Watches are
+served as newline-delimited JSON streams.
+
+Routes:
+    POST   /api/{resource}                    create (body: object)
+    GET    /api/{resource}/{ns}/{name}        get
+    GET    /api/{resource}?namespace=&labelSelector=k=v,k2=v2   list
+    PUT    /api/{resource}                    update (body: object)
+    PUT    /api/{resource}/status             update_status (body: object)
+    PATCH  /api/{resource}/{ns}/{name}        strategic-merge patch
+    DELETE /api/{resource}/{ns}/{name}        delete
+    GET    /watch/{resource}[?initial=1]      ndjson watch stream
+    GET    /healthz                           liveness
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from tpujob.kube.errors import ApiError
+from tpujob.kube.memserver import InMemoryAPIServer
+
+
+def _parse_selector(raw: Optional[str]):
+    if not raw:
+        return None
+    out = {}
+    for part in raw.split(","):
+        k, _, v = part.partition("=")
+        if k:
+            out[k] = v
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "tpujob-apiserver/0.1"
+    protocol_version = "HTTP/1.1"
+
+    # injected by serve()
+    backend: InMemoryAPIServer = None  # type: ignore
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    # -- helpers ------------------------------------------------------------
+
+    def _json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, e: ApiError) -> None:
+        self._json(e.code, {"kind": "Status", "reason": e.reason, "message": str(e)})
+
+    def _body(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    def _route(self) -> Tuple[str, list, dict]:
+        parsed = urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        return parsed.path, parts, parse_qs(parsed.query)
+
+    # -- methods ------------------------------------------------------------
+
+    def do_GET(self):
+        _, parts, query = self._route()
+        try:
+            if parts == ["healthz"]:
+                self._json(200, {"status": "ok"})
+            elif len(parts) == 2 and parts[0] == "watch":
+                self._serve_watch(parts[1], query)
+            elif len(parts) == 2 and parts[0] == "api":
+                ns = (query.get("namespace") or [None])[0]
+                sel = _parse_selector((query.get("labelSelector") or [None])[0])
+                items = self.backend.list(parts[1], ns, sel)
+                self._json(200, {"kind": "List", "items": items})
+            elif len(parts) == 4 and parts[0] == "api":
+                self._json(200, self.backend.get(parts[1], parts[2], parts[3]))
+            else:
+                self._json(404, {"message": f"no route {self.path}"})
+        except ApiError as e:
+            self._error(e)
+
+    def do_POST(self):
+        _, parts, _ = self._route()
+        try:
+            if len(parts) == 2 and parts[0] == "api":
+                self._json(201, self.backend.create(parts[1], self._body()))
+            else:
+                self._json(404, {"message": f"no route {self.path}"})
+        except ApiError as e:
+            self._error(e)
+
+    def do_PUT(self):
+        _, parts, _ = self._route()
+        try:
+            if len(parts) == 2 and parts[0] == "api":
+                self._json(200, self.backend.update(parts[1], self._body()))
+            elif len(parts) == 3 and parts[0] == "api" and parts[2] == "status":
+                self._json(200, self.backend.update_status(parts[1], self._body()))
+            else:
+                self._json(404, {"message": f"no route {self.path}"})
+        except ApiError as e:
+            self._error(e)
+
+    def do_PATCH(self):
+        _, parts, _ = self._route()
+        try:
+            if len(parts) == 4 and parts[0] == "api":
+                self._json(200, self.backend.patch(parts[1], parts[2], parts[3], self._body()))
+            else:
+                self._json(404, {"message": f"no route {self.path}"})
+        except ApiError as e:
+            self._error(e)
+
+    def do_DELETE(self):
+        _, parts, _ = self._route()
+        try:
+            if len(parts) == 4 and parts[0] == "api":
+                self.backend.delete(parts[1], parts[2], parts[3])
+                self._json(200, {"kind": "Status", "status": "Success"})
+            else:
+                self._json(404, {"message": f"no route {self.path}"})
+        except ApiError as e:
+            self._error(e)
+
+    def _serve_watch(self, resource: str, query) -> None:
+        initial = (query.get("initial") or ["0"])[0] in ("1", "true")
+        watch = self.backend.watch(resource, send_initial=initial)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            while not getattr(self.server, "_stopping", threading.Event()).is_set():
+                ev = watch.poll(timeout=0.2)
+                if ev is None:
+                    chunk = b": keepalive\n"
+                else:
+                    chunk = (json.dumps({"type": ev.type, "object": ev.object}) + "\n").encode()
+                self.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            watch.stop()
+
+
+class APIServerHTTP:
+    """The tpujob API server process: in-memory store + HTTP front-end."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 backend: Optional[InMemoryAPIServer] = None):
+        self.backend = backend or InMemoryAPIServer()
+        handler = type("Handler", (_Handler,), {"backend": self.backend})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.httpd._stopping = threading.Event()  # terminates watch streams
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "APIServerHTTP":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="tpujob-apiserver")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd._stopping.set()  # watch streams drain and close
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+
+def main(argv=None) -> int:  # pragma: no cover - exercised by E2E subprocess
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="tpujob-apiserver")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8001)
+    args = parser.parse_args(argv)
+    server = APIServerHTTP(args.host, args.port)
+    print(f"tpujob-apiserver listening on {server.address}", flush=True)
+    try:
+        server.httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -87,18 +87,39 @@ class SharedInformer:
 
     # -- run ----------------------------------------------------------------
 
+    def _establish(self) -> None:
+        """Open the watch, then LIST (watch-first so no events are lost) and
+        reconcile the local cache against the fresh list."""
+        self._watch = self.server.watch(self.resource)
+        initial = self.server.list(self.resource)
+        known = {Store._key(o) for o in initial}
+        for stale in [o for o in self.store.list() if Store._key(o) not in known]:
+            self.store.remove(stale)
+            self._dispatch_delete(stale)
+        for obj in initial:
+            old = self.store.get(*Store._key(obj))
+            self.store.upsert(obj)
+            if old is None:
+                self._dispatch_add(obj)
+            elif old.get("metadata", {}).get("resourceVersion") != obj.get(
+                "metadata", {}
+            ).get("resourceVersion"):
+                self._dispatch_update(old, obj)
+        self._synced.set()
+
     def run(self, stop_event: threading.Event) -> None:
         """Start the watch loop in a background thread (client-go Run)."""
-        self._watch = self.server.watch(self.resource)
-        # initial LIST (after watch established so no events are lost)
-        initial = self.server.list(self.resource)
-        self.store.replace(initial)
-        for obj in initial:
-            self._dispatch_add(obj)
-        self._synced.set()
+        self._establish()
 
         def loop():
             while not stop_event.is_set():
+                if getattr(self._watch, "closed", False):
+                    # stream died (apiserver restart / network): relist+rewatch
+                    try:
+                        self._establish()
+                    except Exception:
+                        stop_event.wait(0.5)
+                        continue
                 ev = self._watch.poll(timeout=0.05)
                 if ev is None:
                     continue
@@ -119,14 +140,10 @@ class SharedInformer:
         Returns the number of events processed.  Usable instead of run();
         establishes the watch + initial list on first call.
         """
-        if self._watch is None:
-            self._watch = self.server.watch(self.resource)
-            initial = self.server.list(self.resource)
-            self.store.replace(initial)
-            for obj in initial:
-                self._dispatch_add(obj)
-            self._synced.set()
-            return len(initial)
+        if self._watch is None or getattr(self._watch, "closed", False):
+            n0 = len(self.store.list())
+            self._establish()
+            return max(len(self.store.list()), n0)
         n = 0
         while True:
             ev = self._watch.poll()

@@ -1,0 +1,258 @@
+"""Real-Kubernetes transport: adapts the ``kubernetes`` python client to the
+ApiServer interface used by clients/informers/controllers.
+
+Import-gated: only loaded via ``--apiserver=kube`` (tpujob.server.app) when
+the kubernetes package is installed.  This module is the deployment-time
+bridge; in-repo tests exercise the same code paths through the in-memory and
+HTTP transports, which share the interface.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+from tpujob.api import constants as c
+from tpujob.kube.errors import (
+    AlreadyExistsError,
+    ApiError,
+    ConflictError,
+    NotFoundError,
+)
+from tpujob.kube.memserver import WatchEvent
+
+try:
+    import kubernetes as k8s
+    from kubernetes import client as k8s_client
+    from kubernetes import config as k8s_config
+    from kubernetes import watch as k8s_watch
+except ImportError as _e:  # pragma: no cover - gated by caller
+    raise ImportError("kubernetes python client is required for KubeApiTransport") from _e
+
+# custom resources served via CustomObjectsApi: resource -> (group, version)
+_CUSTOM = {
+    c.PLURAL: (c.GROUP_NAME, c.VERSION),
+    "podgroups": ("scheduling.volcano.sh", "v1beta1"),
+    "leases": ("coordination.k8s.io", "v1"),
+}
+
+
+def _map_api_error(e) -> ApiError:
+    status = getattr(e, "status", 500)
+    body = str(getattr(e, "body", e))
+    if status == 404:
+        return NotFoundError(body)
+    if status == 409:
+        if "AlreadyExists" in body:
+            return AlreadyExistsError(body)
+        return ConflictError(body)
+    return ApiError(body)
+
+
+class _KubeWatch:
+    """Adapts kubernetes.watch to the Watch interface (poll/stop/closed)."""
+
+    def __init__(self, list_fn, **kwargs):
+        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._stopped = threading.Event()
+        self.closed = False
+        self._w = k8s_watch.Watch()
+        self._thread = threading.Thread(
+            target=self._pump, args=(list_fn,), kwargs=kwargs, daemon=True
+        )
+        self._thread.start()
+
+    def _pump(self, list_fn, **kwargs) -> None:
+        try:
+            for ev in self._w.stream(list_fn, **kwargs):
+                if self._stopped.is_set():
+                    break
+                obj = ev["object"]
+                if hasattr(obj, "to_dict"):
+                    obj = k8s_client.ApiClient().sanitize_for_serialization(obj)
+                self._q.put(WatchEvent(ev["type"], "", obj))
+        except Exception:
+            pass
+        finally:
+            self.closed = True
+            self._q.put(None)
+
+    def poll(self, timeout: float = 0.0) -> Optional[WatchEvent]:
+        try:
+            return self._q.get(timeout=timeout) if timeout else self._q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.closed = True
+        try:
+            self._w.stop()
+        except Exception:
+            pass
+
+
+class KubeApiTransport:
+    """ApiServer-interface facade over CoreV1Api + CustomObjectsApi."""
+
+    def __init__(self, namespace: Optional[str] = None, in_cluster: Optional[bool] = None):
+        if in_cluster is None:
+            try:
+                k8s_config.load_incluster_config()
+            except Exception:
+                k8s_config.load_kube_config()
+        elif in_cluster:
+            k8s_config.load_incluster_config()
+        else:
+            k8s_config.load_kube_config()
+        self.core = k8s_client.CoreV1Api()
+        self.objs = k8s_client.CustomObjectsApi()
+        self._serializer = k8s_client.ApiClient()
+        self.namespace = namespace or "default"
+        self.hooks: List = []
+
+    # -- helpers ------------------------------------------------------------
+
+    def _ns(self, obj_or_ns) -> str:
+        if isinstance(obj_or_ns, str):
+            return obj_or_ns or self.namespace
+        return ((obj_or_ns.get("metadata") or {}).get("namespace")) or self.namespace
+
+    def _to_dict(self, obj) -> Dict[str, Any]:
+        return self._serializer.sanitize_for_serialization(obj)
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        ns = self._ns(obj)
+        try:
+            if resource in _CUSTOM:
+                group, version = _CUSTOM[resource]
+                return self.objs.create_namespaced_custom_object(group, version, ns, resource, obj)
+            if resource == "pods":
+                return self._to_dict(self.core.create_namespaced_pod(ns, obj))
+            if resource == "services":
+                return self._to_dict(self.core.create_namespaced_service(ns, obj))
+            if resource == "events":
+                return self._to_dict(self.core.create_namespaced_event(ns, obj))
+        except k8s_client.ApiException as e:
+            raise _map_api_error(e)
+        raise ApiError(f"unsupported resource {resource}")
+
+    def get(self, resource: str, namespace: str, name: str) -> Dict[str, Any]:
+        ns = namespace or self.namespace
+        try:
+            if resource in _CUSTOM:
+                group, version = _CUSTOM[resource]
+                return self.objs.get_namespaced_custom_object(group, version, ns, resource, name)
+            if resource == "pods":
+                return self._to_dict(self.core.read_namespaced_pod(name, ns))
+            if resource == "services":
+                return self._to_dict(self.core.read_namespaced_service(name, ns))
+        except k8s_client.ApiException as e:
+            raise _map_api_error(e)
+        raise ApiError(f"unsupported resource {resource}")
+
+    def list(self, resource: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
+        sel = ",".join(f"{k}={v}" for k, v in (label_selector or {}).items()) or None
+        try:
+            if resource in _CUSTOM:
+                group, version = _CUSTOM[resource]
+                if namespace:
+                    out = self.objs.list_namespaced_custom_object(
+                        group, version, namespace, resource, label_selector=sel)
+                else:
+                    out = self.objs.list_cluster_custom_object(
+                        group, version, resource, label_selector=sel)
+                return out.get("items", [])
+            if resource == "pods":
+                if namespace:
+                    out = self.core.list_namespaced_pod(namespace, label_selector=sel)
+                else:
+                    out = self.core.list_pod_for_all_namespaces(label_selector=sel)
+            elif resource == "services":
+                if namespace:
+                    out = self.core.list_namespaced_service(namespace, label_selector=sel)
+                else:
+                    out = self.core.list_service_for_all_namespaces(label_selector=sel)
+            else:
+                raise ApiError(f"unsupported resource {resource}")
+            return [self._to_dict(x) for x in out.items]
+        except k8s_client.ApiException as e:
+            raise _map_api_error(e)
+
+    def update(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        ns = self._ns(obj)
+        name = (obj.get("metadata") or {}).get("name")
+        try:
+            if resource in _CUSTOM:
+                group, version = _CUSTOM[resource]
+                return self.objs.replace_namespaced_custom_object(
+                    group, version, ns, resource, name, obj)
+            if resource == "pods":
+                return self._to_dict(self.core.replace_namespaced_pod(name, ns, obj))
+            if resource == "services":
+                return self._to_dict(self.core.replace_namespaced_service(name, ns, obj))
+        except k8s_client.ApiException as e:
+            raise _map_api_error(e)
+        raise ApiError(f"unsupported resource {resource}")
+
+    def update_status(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        ns = self._ns(obj)
+        name = (obj.get("metadata") or {}).get("name")
+        try:
+            if resource in _CUSTOM:
+                group, version = _CUSTOM[resource]
+                return self.objs.patch_namespaced_custom_object_status(
+                    group, version, ns, resource, name,
+                    [{"op": "replace", "path": "/status", "value": obj.get("status") or {}}],
+                )
+            if resource == "pods":
+                return self._to_dict(self.core.patch_namespaced_pod_status(name, ns, obj))
+        except k8s_client.ApiException as e:
+            raise _map_api_error(e)
+        raise ApiError(f"unsupported resource {resource}")
+
+    def patch(self, resource: str, namespace: str, name: str, patch: Dict) -> Dict[str, Any]:
+        ns = namespace or self.namespace
+        try:
+            if resource in _CUSTOM:
+                group, version = _CUSTOM[resource]
+                return self.objs.patch_namespaced_custom_object(
+                    group, version, ns, resource, name, patch)
+            if resource == "pods":
+                return self._to_dict(self.core.patch_namespaced_pod(name, ns, patch))
+            if resource == "services":
+                return self._to_dict(self.core.patch_namespaced_service(name, ns, patch))
+        except k8s_client.ApiException as e:
+            raise _map_api_error(e)
+        raise ApiError(f"unsupported resource {resource}")
+
+    def delete(self, resource: str, namespace: str, name: str) -> None:
+        ns = namespace or self.namespace
+        try:
+            if resource in _CUSTOM:
+                group, version = _CUSTOM[resource]
+                self.objs.delete_namespaced_custom_object(group, version, ns, resource, name)
+            elif resource == "pods":
+                self.core.delete_namespaced_pod(name, ns)
+            elif resource == "services":
+                self.core.delete_namespaced_service(name, ns)
+            else:
+                raise ApiError(f"unsupported resource {resource}")
+        except k8s_client.ApiException as e:
+            raise _map_api_error(e)
+
+    def watch(self, resource: Optional[str] = None, send_initial: bool = False):
+        if resource in _CUSTOM:
+            group, version = _CUSTOM[resource]
+            return _KubeWatch(
+                self.objs.list_cluster_custom_object,
+                group=group, version=version, plural=resource,
+            )
+        if resource == "pods":
+            return _KubeWatch(self.core.list_pod_for_all_namespaces)
+        if resource == "services":
+            return _KubeWatch(self.core.list_service_for_all_namespaces)
+        raise ApiError(f"unsupported watch resource {resource}")

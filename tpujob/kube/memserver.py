@@ -65,6 +65,7 @@ class Watch:
         self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue(maxsize=maxsize)
         self._server = server
         self._stopped = False
+        self.closed = False  # True once the stream can deliver no more events
 
     def _put(self, ev: WatchEvent) -> None:
         if not self._stopped:
@@ -72,6 +73,7 @@ class Watch:
 
     def stop(self) -> None:
         self._stopped = True
+        self.closed = True
         self._q.put(None)
         self._server._remove_watch(self)
 

@@ -1,0 +1,22 @@
+"""Operator CLI entrypoint: ``python -m tpujob.server [flags]``.
+
+Mirrors reference ``cmd/pytorch-operator.v1/main.go``.
+"""
+from __future__ import annotations
+
+import sys
+
+import tpujob
+from tpujob.server.app import OperatorApp
+from tpujob.server.options import parse_options
+
+
+def main(argv=None) -> int:
+    opt = parse_options(argv)
+    print(f"tpujob-operator {tpujob.__version__} (apiserver={opt.apiserver})", file=sys.stderr)
+    OperatorApp(opt).run(block=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,136 @@
+"""Operator application wiring.
+
+Mirrors reference ``cmd/pytorch-operator.v1/app/server.go:66-174``: build
+the transport, start monitoring, run leader election, hand leadership to the
+controller run loop, wire signal handling.
+"""
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Optional
+
+from tpujob.controller.job_base import ControllerConfig
+from tpujob.controller.reconciler import TPUJobController
+from tpujob.kube.client import ClientSet
+from tpujob.kube.httpclient import HTTPApiClient
+from tpujob.kube.memserver import InMemoryAPIServer
+from tpujob.server.leader_election import LeaderElector
+from tpujob.server.monitoring import MonitoringServer
+from tpujob.server.options import ServerOption
+
+log = logging.getLogger("tpujob.server")
+
+
+def build_transport(opt: ServerOption):
+    if opt.apiserver == "memory":
+        return InMemoryAPIServer()
+    if opt.apiserver == "kube":
+        # real-cluster transport: adapt the kubernetes python client to the
+        # ApiServer interface (gated: the client library may not be present)
+        try:
+            import kubernetes  # noqa: F401
+        except ImportError:
+            raise SystemExit(
+                "--apiserver=kube requires the 'kubernetes' python package; "
+                "install it in the operator image, or point --apiserver at a "
+                "tpujob-apiserver URL"
+            )
+        from tpujob.kube.kubetransport import KubeApiTransport  # noqa: PLC0415
+
+        return KubeApiTransport(namespace=opt.namespace or None)
+    client = HTTPApiClient(opt.apiserver)
+    if not client.healthy():
+        raise SystemExit(f"cannot reach tpujob API server at {opt.apiserver}")
+    return client
+
+
+def setup_signal_handler(stop_event: threading.Event) -> None:
+    """SIGTERM/SIGINT graceful stop; second signal exits hard
+    (vendored signals package semantics)."""
+
+    def handler(signum, frame):
+        if stop_event.is_set():
+            raise SystemExit(1)
+        log.info("received signal %s; shutting down", signum)
+        stop_event.set()
+
+    try:
+        signal.signal(signal.SIGTERM, handler)
+        signal.signal(signal.SIGINT, handler)
+    except ValueError:
+        pass  # not the main thread (tests)
+
+
+class OperatorApp:
+    def __init__(self, opt: ServerOption, transport=None):
+        self.opt = opt
+        self.transport = transport if transport is not None else build_transport(opt)
+        self.clients = ClientSet(self.transport)
+        self.controller = TPUJobController(
+            self.clients,
+            config=ControllerConfig(
+                threadiness=opt.threadiness,
+                enable_gang_scheduling=opt.enable_gang_scheduling,
+                gang_scheduler_name=opt.gang_scheduler_name,
+                init_container_image=opt.init_container_image,
+                namespace=opt.namespace or None,
+            ),
+        )
+        self.monitoring: Optional[MonitoringServer] = None
+        self.stop_event = threading.Event()
+
+    def run(self, block: bool = True) -> None:
+        logging.basicConfig(
+            level=logging.INFO,
+            format='{"time":"%(asctime)s","level":"%(levelname)s","logger":"%(name)s","msg":"%(message)s"}'
+            if self.opt.json_log_format
+            else "%(asctime)s %(levelname)s %(name)s: %(message)s",
+        )
+        setup_signal_handler(self.stop_event)
+        if self.opt.monitoring_port:
+            self.monitoring = MonitoringServer(port=self.opt.monitoring_port).start()
+            log.info("monitoring on :%d/metrics", self.monitoring.port)
+
+        def start_controller():
+            log.info("leadership acquired; starting controller (threadiness=%d)",
+                     self.opt.threadiness)
+            self.controller.run(self.stop_event, threadiness=self.opt.threadiness)
+
+        def lost_leadership():
+            # loss of leadership is fatal; the Deployment restarts us
+            log.error("leader election lost; exiting")
+            self.stop_event.set()
+
+        if self.opt.enable_leader_election:
+            elector = LeaderElector(
+                self.transport,
+                lock_name=self.opt.leader_election_id,
+                lease_duration=self.opt.lease_duration_s,
+                renew_deadline=self.opt.renew_deadline_s,
+                retry_period=self.opt.retry_period_s,
+                on_started_leading=start_controller,
+                on_stopped_leading=lost_leadership,
+            )
+            thread = threading.Thread(
+                target=elector.run, args=(self.stop_event,), daemon=True,
+                name="leader-elector",
+            )
+            thread.start()
+        else:
+            start_controller()
+
+        if block:
+            try:
+                while not self.stop_event.wait(0.5):
+                    pass
+            finally:
+                self.shutdown()
+
+    def shutdown(self) -> None:
+        self.stop_event.set()
+        self.controller.queue.shutdown()
+        self.controller.factory.stop()
+        if self.monitoring:
+            self.monitoring.stop()

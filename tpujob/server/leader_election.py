@@ -1,0 +1,138 @@
+"""Leader election over an API-server lease object.
+
+Mirrors reference ``app/server.go:146-171``: a resource lock (there an
+EndpointsLock, here a ``leases`` object in the API server) with
+lease-duration/renew-deadline/retry-period semantics, an ``is_leader``
+gauge, and fatal loss-of-leadership.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from tpujob.kube.errors import ConflictError, NotFoundError
+from tpujob.server import metrics
+
+log = logging.getLogger("tpujob.leaderelection")
+
+RESOURCE_LEASES = "leases"
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        server,  # ApiServer-interface transport
+        lock_name: str = "tpujob-operator",
+        namespace: str = "default",
+        identity: Optional[str] = None,
+        lease_duration: float = 15.0,
+        renew_deadline: float = 5.0,
+        retry_period: float = 3.0,
+        on_started_leading: Optional[Callable[[], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+    ):
+        self.server = server
+        self.lock_name = lock_name
+        self.namespace = namespace
+        self.identity = identity or f"{lock_name}-{uuid.uuid4().hex[:8]}"
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.is_leader = False
+
+    # -- lock record ---------------------------------------------------------
+
+    def _try_acquire_or_renew(self) -> bool:
+        try:
+            return self._try_acquire_or_renew_inner()
+        except (NotFoundError, ConflictError):
+            return False
+        except Exception as e:  # transport errors must NOT kill the elector:
+            # a dead elector thread with a live controller is split-brain
+            log.warning("leader election transport error: %s", e)
+            return False
+
+    def _try_acquire_or_renew_inner(self) -> bool:
+        now = time.time()
+        record = {
+            "metadata": {"name": self.lock_name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": self.lease_duration,
+                "renewTime": now,
+            },
+        }
+        try:
+            current = self.server.get(RESOURCE_LEASES, self.namespace, self.lock_name)
+        except NotFoundError:
+            try:
+                self.server.create(RESOURCE_LEASES, record)
+                return True
+            except Exception:
+                return False
+        spec = current.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        renew = float(spec.get("renewTime") or 0)
+        expired = now - renew > float(spec.get("leaseDurationSeconds") or self.lease_duration)
+        if holder == self.identity or expired or not holder:
+            record["metadata"]["resourceVersion"] = (current.get("metadata") or {}).get(
+                "resourceVersion"
+            )
+            try:
+                self.server.update(RESOURCE_LEASES, record)
+                return True
+            except (ConflictError, NotFoundError):
+                return False
+        return False
+
+    # -- run loop ------------------------------------------------------------
+
+    def run(self, stop_event: threading.Event) -> None:
+        """Blocks: acquire, then renew until loss (which is fatal, like the
+        reference) or stop."""
+        while not stop_event.is_set():
+            if self._try_acquire_or_renew():
+                break
+            log.info("%s waiting for leadership", self.identity)
+            if stop_event.wait(self.retry_period):
+                return
+        if stop_event.is_set():
+            return
+        self.is_leader = True
+        metrics.is_leader.set(1)
+        log.info("%s became leader", self.identity)
+        if self.on_started_leading:
+            self.on_started_leading()
+        while not stop_event.is_set():
+            deadline = time.time() + self.renew_deadline
+            renewed = False
+            while time.time() < deadline and not stop_event.is_set():
+                if self._try_acquire_or_renew():
+                    renewed = True
+                    break
+                time.sleep(min(0.1, self.retry_period))
+            if stop_event.is_set():
+                break
+            if not renewed:
+                self.is_leader = False
+                metrics.is_leader.set(0)
+                log.error("%s lost leadership", self.identity)
+                if self.on_stopped_leading:
+                    self.on_stopped_leading()
+                return
+            if stop_event.wait(self.retry_period):
+                break
+        # clean stop: release the lease for a fast failover
+        self.is_leader = False
+        metrics.is_leader.set(0)
+        try:
+            current = self.server.get(RESOURCE_LEASES, self.namespace, self.lock_name)
+            if (current.get("spec") or {}).get("holderIdentity") == self.identity:
+                self.server.delete(RESOURCE_LEASES, self.namespace, self.lock_name)
+        except Exception:
+            pass

@@ -1,0 +1,54 @@
+"""/metrics + /healthz HTTP listener (reference main.go:31-40)."""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tpujob.server.metrics import REGISTRY
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):
+        if self.path.startswith("/metrics"):
+            body = REGISTRY.expose().encode()
+            ctype = "text/plain; version=0.0.4"
+            code = 200
+        elif self.path.startswith("/healthz"):
+            body, ctype, code = b"ok", "text/plain", 200
+        else:
+            body, ctype, code = b"not found", "text/plain", 404
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class MonitoringServer:
+    def __init__(self, host: str = "0.0.0.0", port: int = 8443):
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "MonitoringServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True, name="tpujob-monitoring"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)

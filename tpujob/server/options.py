@@ -1,0 +1,71 @@
+"""Operator flags.
+
+Mirrors reference ``cmd/pytorch-operator.v1/app/options/options.go:27-84``
+(ServerOption + AddFlags), adapted: ``--apiserver`` points at the tpujob
+API server (HTTP) or selects the in-process simulator.
+"""
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass
+class ServerOption:
+    apiserver: str = "memory"  # "memory" or an http://host:port
+    namespace: str = ""  # "" = all namespaces (corev1.NamespaceAll)
+    threadiness: int = 1
+    json_log_format: bool = True
+    enable_gang_scheduling: bool = False
+    gang_scheduler_name: str = "volcano"
+    monitoring_port: int = 8443
+    resync_period_s: float = 12 * 3600
+    init_container_image: str = "alpine:3.10"
+    enable_leader_election: bool = True
+    leader_election_id: str = "tpujob-operator"
+    lease_duration_s: float = 15.0
+    renew_deadline_s: float = 5.0
+    retry_period_s: float = 3.0
+    qps: float = 50.0
+    burst: int = 100
+
+
+def add_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--apiserver", default="memory",
+                        help="tpujob API server URL, or 'memory' for the in-process simulator")
+    parser.add_argument("--namespace", default="",
+                        help="namespace to watch ('' = all namespaces)")
+    parser.add_argument("--threadiness", type=int, default=1,
+                        help="number of concurrent reconcile workers")
+    parser.add_argument("--json-log-format", action="store_true", default=True)
+    parser.add_argument("--no-json-log-format", dest="json_log_format", action="store_false")
+    parser.add_argument("--enable-gang-scheduling", action="store_true", default=False)
+    parser.add_argument("--gang-scheduler-name", default="volcano")
+    parser.add_argument("--monitoring-port", type=int, default=8443,
+                        help="port for /metrics and /healthz (0 disables)")
+    parser.add_argument("--resync-period", type=float, default=12 * 3600, dest="resync_period_s")
+    parser.add_argument("--init-container-image", default="alpine:3.10")
+    parser.add_argument("--enable-leader-election", action="store_true", default=True)
+    parser.add_argument("--no-leader-election", dest="enable_leader_election", action="store_false")
+    parser.add_argument("--leader-election-id", default="tpujob-operator")
+    parser.add_argument("--lease-duration", type=float, default=15.0, dest="lease_duration_s")
+    parser.add_argument("--renew-deadline", type=float, default=5.0, dest="renew_deadline_s")
+    parser.add_argument("--retry-period", type=float, default=3.0, dest="retry_period_s")
+    parser.add_argument("--kube-api-qps", type=float, default=50.0, dest="qps")
+    parser.add_argument("--kube-api-burst", type=int, default=100, dest="burst")
+
+
+def parse_options(argv: Optional[List[str]] = None) -> ServerOption:
+    import os
+
+    parser = argparse.ArgumentParser(prog="tpujob-operator",
+                                     description="TPU-native job operator")
+    add_flags(parser)
+    ns = parser.parse_args(argv)
+    opt = ServerOption(**{k: v for k, v in vars(ns).items() if k in ServerOption.__dataclass_fields__})
+    # in-cluster namespace detection (reference server.go:72-76 reads
+    # KUBEFLOW_NAMESPACE from the downward API)
+    if not opt.namespace:
+        opt.namespace = os.environ.get("OPERATOR_NAMESPACE", "")
+    return opt
