@@ -1,15 +1,25 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any jax import so XLA picks up the flags; model/parallel
-tests shard over these 8 virtual devices exactly as they would over a TPU
-slice.
+XLA_FLAGS must be set before the backend initializes; model/parallel tests
+then shard over these 8 virtual devices exactly as they would over a TPU
+slice.  The sandbox's sitecustomize may pre-register an accelerator plugin
+and force its platform, so after importing jax we explicitly pin the
+platform back to cpu (effective as long as no backend has initialized,
+which is true at conftest time).
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # operator-layer tests run fine without jax
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,145 @@
+"""Workload-side distributed bootstrap + smoke-dist tests.
+
+Runs on the conftest-provided virtual 8-device CPU mesh — the same way the
+reference tests distributed logic without a cluster (SURVEY.md §4).
+"""
+import jax
+import pytest
+
+from tpujob.api.topology import SliceTopology
+from tpujob.api.types import TPUJob
+from tpujob.controller.tpu_env import cluster_env
+from tpujob.workloads import distributed as dist
+from tpujob.workloads import smoke_dist
+
+
+def make_job(name="smoke"):
+    return TPUJob.from_dict(
+        {
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {
+                "tpuReplicaSpecs": {
+                    "Master": {"replicas": 1, "template": {"spec": {"containers": [
+                        {"name": "tpujob", "image": "img"}]}}},
+                    "Worker": {"replicas": 3, "template": {"spec": {"containers": [
+                        {"name": "tpujob", "image": "img"}]}}},
+                }
+            },
+        }
+    )
+
+
+class TestProcessEnv:
+    def test_parses_tpujob_env(self):
+        """The workload parses exactly what the controller injects — the
+        round-trip the reference validates with dist_sendrecv logging."""
+        job = make_job()
+        topo = SliceTopology.resolve("v4-32")
+        env = cluster_env(job, "Worker", 1, topo, 23456)
+        pe = dist.process_env(env)
+        assert pe.num_processes == topo.num_processes == 4
+        assert pe.process_id == 2  # master=0, worker i => i+1
+        assert pe.coordinator_address == "smoke-master-0.default:23456"
+        assert pe.devices_per_host == 4
+        assert pe.global_devices == 16
+        assert pe.accelerator == "v4-32"
+        assert not pe.is_coordinator
+        assert pe.is_distributed
+
+    def test_master_is_coordinator_localhost(self):
+        job = make_job()
+        env = cluster_env(job, "Master", 0, SliceTopology.resolve("v4-32"), 23456)
+        pe = dist.process_env(env)
+        assert pe.process_id == 0
+        assert pe.is_coordinator
+        assert pe.coordinator_address == "localhost:23456"
+
+    def test_falls_back_to_torch_spelling(self):
+        """Same container image runs under reference-style env injection."""
+        pe = dist.process_env(
+            {"MASTER_ADDR": "j-master-0", "MASTER_PORT": "23456",
+             "WORLD_SIZE": "4", "RANK": "3"}
+        )
+        assert pe.coordinator_address == "j-master-0:23456"
+        assert pe.num_processes == 4
+        assert pe.process_id == 3
+
+    def test_empty_env_single_process(self):
+        pe = dist.process_env({})
+        assert pe.num_processes == 1 and pe.process_id == 0
+        assert not pe.is_distributed
+
+    def test_multislice_fields(self):
+        job = make_job()
+        topo = SliceTopology.resolve("v4-16", num_slices=2)
+        env = cluster_env(job, "Worker", 2, topo, 23456)
+        pe = dist.process_env(env)
+        assert pe.num_slices == 2
+        assert pe.slice_id == 1  # process 3 of 4 => slice 1, host 1
+
+    def test_initialize_single_process_noop(self):
+        pe = dist.initialize(dist.process_env({}))
+        assert pe.num_processes == 1
+
+
+class TestMesh:
+    def test_default_pure_dp(self):
+        mesh = dist.make_mesh(env=dist.process_env({}))
+        assert mesh.axis_names == ("data",)
+        assert mesh.size == 8
+
+    def test_dp_by_tp(self):
+        mesh = dist.make_mesh({"data": -1, "tensor": 4}, env=dist.process_env({}))
+        assert mesh.axis_names == ("data", "tensor")
+        assert mesh.shape["data"] == 2 and mesh.shape["tensor"] == 4
+
+    def test_axis_order_data_slowest(self):
+        mesh = dist.make_mesh({"tensor": 2, "data": 2, "sequence": 2},
+                              env=dist.process_env({}))
+        assert mesh.axis_names == ("data", "sequence", "tensor")
+
+    def test_bad_factorization_raises(self):
+        with pytest.raises(ValueError):
+            dist.make_mesh({"data": 3}, env=dist.process_env({}))
+        with pytest.raises(ValueError):
+            dist.make_mesh({"data": -1, "tensor": -1}, env=dist.process_env({}))
+        with pytest.raises(ValueError):
+            dist.make_mesh({"data": -1, "tensor": 3}, env=dist.process_env({}))
+
+    def test_multislice_hybrid_mesh(self):
+        """2 virtual slices of 4 devices: data axis spans the DCN boundary."""
+        pe = dist.process_env(
+            {"TPUJOB_NUM_SLICES": "2", "TPUJOB_NUM_PROCESSES": "2",
+             "TPUJOB_PROCESS_ID": "0",
+             "TPUJOB_COORDINATOR_ADDRESS": "x:1"}
+        )
+        mesh = dist.make_mesh({"data": -1, "tensor": 2}, env=pe)
+        assert mesh.shape["data"] == 4 and mesh.shape["tensor"] == 2
+
+    def test_local_batch_slice(self):
+        pe = dist.process_env({"TPUJOB_NUM_PROCESSES": "4", "TPUJOB_PROCESS_ID": "2",
+                               "TPUJOB_COORDINATOR_ADDRESS": "x:1"})
+        assert dist.local_batch_slice(64, pe) == (32, 16)
+        with pytest.raises(ValueError):
+            dist.local_batch_slice(63, pe)
+
+    def test_batch_sharding_spreads_batch(self):
+        import numpy as np
+
+        mesh = dist.make_mesh({"data": -1}, env=dist.process_env({}))
+        sh = dist.batch_sharding(mesh)
+        x = jax.device_put(np.zeros((16, 4)), sh)
+        assert len({d for d in x.devices()}) == 8
+
+
+class TestSmokeDist:
+    def test_smoke_passes_on_8_device_mesh(self):
+        """The send/recv-equivalent collective smoke passes — the same
+        assertion the reference's E2E smoke image makes end-to-end."""
+        mesh = dist.make_mesh({"data": -1}, env=dist.process_env({}))
+        assert smoke_dist.run(mesh)
+
+    def test_main_single_host(self, monkeypatch, capsys):
+        monkeypatch.delenv("TPUJOB_NUM_PROCESSES", raising=False)
+        monkeypatch.delenv("WORLD_SIZE", raising=False)
+        assert smoke_dist.main() == 0

@@ -1,0 +1,10 @@
+"""TPU-native example workloads (the reference's ``examples/`` layer).
+
+These are the containers a TPUJob schedules: they consume the environment
+the controller injects (``tpujob/controller/tpu_env.py``) the same way the
+reference workloads consume ``MASTER_ADDR``/``WORLD_SIZE``/``RANK``
+(``examples/mnist/mnist.py:100-138``, ``examples/smoke-dist/dist_sendrecv.py``)
+— but rendezvous through the JAX/PJRT distributed coordinator and run SPMD
+over a ``jax.sharding.Mesh`` instead of DistributedDataParallel over
+gloo/NCCL.
+"""

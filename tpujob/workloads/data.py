@@ -1,0 +1,128 @@
+"""Dataset plumbing for the example workloads.
+
+The reference's MNIST example streams FashionMNIST through torchvision with
+a per-rank DataLoader (``examples/mnist/mnist.py:117-132``).  This module
+supplies the TPU equivalent: numpy arrays fed host-sharded into the global
+batch (each host loads only its ``local_batch_slice`` rows).
+
+Zero-egress environments can't download FashionMNIST, so the default is a
+deterministic synthetic set with the same shape/num-classes and a learnable
+class structure (class-conditional templates + noise); real IDX files are
+used when present at ``data_dir`` (the torchvision on-disk format).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+# FashionMNIST normalization constants used by the reference
+# (examples/mnist/mnist.py:123-124)
+MEAN, STD = 0.1307, 0.3081
+
+IMAGE_SHAPE = (28, 28, 1)  # NHWC, channels-last is the TPU-friendly layout
+NUM_CLASSES = 10
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">HBB", f.read(4))
+        dtype = {8: np.uint8, 9: np.int8, 11: np.int16, 12: np.int32, 13: np.float32}[
+            magic[1]
+        ]
+        dims = struct.unpack(">" + "I" * magic[2], f.read(4 * magic[2]))
+        return np.frombuffer(f.read(), dtype=dtype).reshape(dims)
+
+
+def _find_idx(data_dir: str, stem: str) -> Optional[str]:
+    for sub in ("", "FashionMNIST/raw", "MNIST/raw"):
+        for ext in ("", ".gz"):
+            p = os.path.join(data_dir, sub, stem + ext)
+            if os.path.exists(p):
+                return p
+    return None
+
+
+def synthetic_split(
+    n: int, seed: int, noise: float = 0.2
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Class-conditional synthetic images: each class is a fixed random
+    28x28 template; samples are template + gaussian noise.  Linearly
+    separable enough that the reference CNN reaches high accuracy in one
+    epoch, so accuracy assertions stay meaningful."""
+    rng = np.random.RandomState(1234)  # templates shared across splits
+    templates = rng.rand(NUM_CLASSES, 28, 28).astype(np.float32)
+    rng2 = np.random.RandomState(seed)
+    labels = rng2.randint(0, NUM_CLASSES, size=n).astype(np.int32)
+    images = templates[labels] + noise * rng2.randn(n, 28, 28).astype(np.float32)
+    images = np.clip(images, 0.0, 1.0)
+    return images[..., None], labels
+
+
+def mnist_datasets(
+    data_dir: Optional[str] = None,
+    train_size: int = 60000,
+    test_size: int = 10000,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """(train_x, train_y, test_x, test_y), normalized, NHWC float32.
+
+    Prefers real IDX files under ``data_dir`` (torchvision layout); falls
+    back to the synthetic set.
+    """
+    if data_dir:
+        ti = _find_idx(data_dir, "train-images-idx3-ubyte")
+        tl = _find_idx(data_dir, "train-labels-idx1-ubyte")
+        vi = _find_idx(data_dir, "t10k-images-idx3-ubyte")
+        vl = _find_idx(data_dir, "t10k-labels-idx1-ubyte")
+        if ti and tl and vi and vl:
+            tx = _read_idx(ti).astype(np.float32)[..., None] / 255.0
+            vx = _read_idx(vi).astype(np.float32)[..., None] / 255.0
+            ty = _read_idx(tl).astype(np.int32)
+            vy = _read_idx(vl).astype(np.int32)
+            return (
+                (tx - MEAN) / STD, ty,
+                (vx - MEAN) / STD, vy,
+            )
+    tx, ty = synthetic_split(train_size, seed=0)
+    vx, vy = synthetic_split(test_size, seed=1)
+    return (tx - MEAN) / STD, ty, (vx - MEAN) / STD, vy
+
+
+def batches(
+    x: np.ndarray,
+    y: np.ndarray,
+    batch_size: int,
+    *,
+    seed: int = 0,
+    shuffle: bool = True,
+    drop_remainder: bool = True,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Epoch iterator (the DataLoader equivalent).  Drops the ragged tail by
+    default — static shapes keep every step on the same compiled program."""
+    n = len(x)
+    idx = np.arange(n)
+    if shuffle:
+        np.random.RandomState(seed).shuffle(idx)
+    end = n - n % batch_size if drop_remainder else n
+    for start in range(0, end, batch_size):
+        sel = idx[start : start + batch_size]
+        yield x[sel], y[sel]
+
+
+def synthetic_imagenet_batch(batch: int, image_size: int = 224, seed: int = 0):
+    """A deterministic ImageNet-shaped batch for ResNet-50 benchmarking."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(batch, image_size, image_size, 3).astype(np.float32)
+    y = rng.randint(0, 1000, size=batch).astype(np.int32)
+    return x, y
+
+
+def synthetic_token_batch(batch: int, seq_len: int, vocab: int = 30522, seed: int = 0):
+    """A deterministic token batch for BERT benchmarking/pretraining."""
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, vocab, size=(batch, seq_len)).astype(np.int32)
+    return ids

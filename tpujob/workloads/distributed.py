@@ -1,0 +1,255 @@
+"""Workload-side distributed bootstrap: the consumer of the injected env.
+
+The reference workload contract is ``dist.init_process_group(backend)``
+reading ``MASTER_ADDR``/``MASTER_PORT``/``WORLD_SIZE``/``RANK``
+(``examples/mnist/mnist.py:114-116``, env injected by
+``pkg/controller.v1/pytorch/pod.go:234-281``).  The TPU-native contract is
+``jax.distributed.initialize(coordinator_address, num_processes, process_id)``
+reading the ``TPUJOB_*`` variables injected by
+``tpujob/controller/tpu_env.py`` — after which every host holds one JAX
+process whose local devices are its slice chips, and collectives ride
+ICI within a slice / DCN across slices via XLA.
+
+Mesh construction lives here too: workloads declare logical axes
+(data/fsdp/tensor/sequence/expert) and this module lays physical devices out
+so that the fastest-varying axes land on ICI neighbours and only the data
+axis crosses slice (DCN) boundaries.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+log = logging.getLogger("tpujob.workloads")
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessEnv:
+    """The injected cluster spec, parsed (tpu_env.cluster_env is the writer)."""
+
+    coordinator_address: Optional[str]
+    num_processes: int
+    process_id: int
+    num_slices: int
+    slice_id: int
+    devices_per_host: Optional[int]
+    global_devices: Optional[int]
+    accelerator: Optional[str]
+    topology: Optional[str]
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def _geti(env: Dict[str, str], key: str, default: Optional[int] = None) -> Optional[int]:
+    v = env.get(key)
+    if v is None or v == "":
+        return default
+    return int(v)
+
+
+def process_env(env: Optional[Dict[str, str]] = None) -> ProcessEnv:
+    """Parse TPUJOB_* (preferred) or MASTER_ADDR-compat env into a ProcessEnv.
+
+    Mirrors the reference workload's env reads (dist_sendrecv.py:44-54) with
+    the TPU vocabulary first and the torch.distributed spelling as fallback,
+    so the same container image runs under either injection style.
+    """
+    e = dict(os.environ) if env is None else env
+    coord = e.get("TPUJOB_COORDINATOR_ADDRESS")
+    if coord is None and e.get("MASTER_ADDR"):
+        coord = f"{e['MASTER_ADDR']}:{e.get('MASTER_PORT', '23456')}"
+    num = _geti(e, "TPUJOB_NUM_PROCESSES") or _geti(e, "WORLD_SIZE", 1) or 1
+    pid = _geti(e, "TPUJOB_PROCESS_ID")
+    if pid is None:
+        pid = _geti(e, "RANK", 0) or 0
+    return ProcessEnv(
+        coordinator_address=coord,
+        num_processes=num,
+        process_id=pid,
+        num_slices=_geti(e, "TPUJOB_NUM_SLICES", 1) or 1,
+        slice_id=_geti(e, "TPUJOB_SLICE_ID", 0) or 0,
+        devices_per_host=_geti(e, "TPUJOB_DEVICES_PER_HOST"),
+        global_devices=_geti(e, "TPUJOB_GLOBAL_DEVICES"),
+        accelerator=e.get("TPU_ACCELERATOR_TYPE"),
+        topology=e.get("TPU_TOPOLOGY"),
+    )
+
+
+def initialize(env: Optional[ProcessEnv] = None) -> ProcessEnv:
+    """The TPU-native ``init_process_group``.
+
+    Single-process jobs (the reference's WORLD_SIZE==1 fast path,
+    mnist.py:68-70 ``should_distribute``) skip coordinator setup entirely;
+    multi-process jobs dial the coordinator service the controller exposed
+    via headless DNS.  Idempotent: safe to call when already initialized.
+    """
+    pe = env or process_env()
+    if not pe.is_distributed:
+        log.info("single-process job; skipping jax.distributed.initialize")
+        return pe
+    import jax
+
+    # Idempotency probe must not touch the backend: jax.process_count()
+    # would initialize XLA and make the subsequent initialize() raise.
+    try:
+        from jax._src.distributed import global_state
+
+        if global_state.client is not None:  # already initialized
+            return pe
+    except ImportError:
+        pass
+    log.info(
+        "jax.distributed.initialize coordinator=%s num_processes=%d process_id=%d",
+        pe.coordinator_address, pe.num_processes, pe.process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=pe.coordinator_address,
+        num_processes=pe.num_processes,
+        process_id=pe.process_id,
+    )
+    return pe
+
+
+# ---------------------------------------------------------------------------
+# Mesh construction
+# ---------------------------------------------------------------------------
+
+# Canonical logical axis order, slowest-varying (DCN-friendly) first.  Data
+# parallelism tolerates the slowest links, so it gets the outermost placement;
+# tensor/sequence axes communicate per-layer and must stay on ICI neighbours.
+AXIS_ORDER: Tuple[str, ...] = ("data", "fsdp", "expert", "pipeline", "sequence", "tensor")
+
+
+def _factor_axes(
+    n_devices: int, axes: Dict[str, int]
+) -> Dict[str, int]:
+    """Resolve at most one -1 axis to soak up the remaining devices."""
+    sizes = dict(axes)
+    wild = [k for k, v in sizes.items() if v == -1]
+    if len(wild) > 1:
+        raise ValueError(f"at most one axis may be -1, got {wild}")
+    fixed = 1
+    for k, v in sizes.items():
+        if v != -1:
+            if v <= 0:
+                raise ValueError(f"axis {k!r} must be positive or -1, got {v}")
+            fixed *= v
+    if wild:
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes product {fixed}"
+            )
+        sizes[wild[0]] = n_devices // fixed
+        fixed = n_devices
+    if fixed != n_devices:
+        raise ValueError(
+            f"mesh axes {sizes} multiply to {fixed}, but {n_devices} devices present"
+        )
+    return sizes
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None,
+    *,
+    env: Optional[ProcessEnv] = None,
+    devices=None,
+):
+    """Build a ``jax.sharding.Mesh`` over all global devices.
+
+    ``axes`` maps logical axis name -> size, with one ``-1`` wildcard
+    (default ``{"data": -1}`` — pure DP, the reference's only strategy,
+    SURVEY.md §2.5).  Axes are laid out in AXIS_ORDER so "data" varies
+    slowest; for multislice jobs the data axis is additionally split across
+    slices with ``create_hybrid_device_mesh`` so only DP gradient
+    all-reduces cross the DCN.
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    sizes = _factor_axes(n, dict(axes or {"data": -1}))
+    names = [a for a in AXIS_ORDER if a in sizes]
+    extra = [a for a in sizes if a not in AXIS_ORDER]
+    names += sorted(extra)
+    shape = [sizes[a] for a in names]
+
+    pe = env or process_env()
+    if pe.num_slices > 1 and n % pe.num_slices == 0:
+        # multislice: the slowest axis must absorb the slice boundary so
+        # only it crosses the DCN.  Virtual (CPU) devices carry no
+        # slice_index — fall back to a plain mesh there so the sharding
+        # still compiles in tests/dryruns.
+        first = sizes[names[0]]
+        if hasattr(devices[0], "slice_index"):
+            if first % pe.num_slices != 0:
+                raise ValueError(
+                    f"multislice mesh: slowest axis {names[0]!r}={first} must be "
+                    f"divisible by num_slices={pe.num_slices}, or per-layer "
+                    f"collectives would cross the DCN"
+                )
+            dcn = [1] * len(shape)
+            dcn[0] = pe.num_slices
+            ici = list(shape)
+            ici[0] = first // pe.num_slices
+            dmesh = mesh_utils.create_hybrid_device_mesh(
+                ici, dcn, devices=devices, allow_split_physical_axes=True
+            )
+            return Mesh(dmesh, axis_names=tuple(names))
+    dmesh = mesh_utils.create_device_mesh(shape, devices=devices)
+    return Mesh(dmesh, axis_names=tuple(names))
+
+
+def batch_sharding(mesh, *batch_axes: str):
+    """NamedSharding for a batch: dim 0 split over the given mesh axes
+    (default: every non-model axis present on the mesh)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if not batch_axes:
+        batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+        if not batch_axes:
+            raise ValueError(
+                f"mesh axes {mesh.axis_names} contain no batch axis "
+                "('data'/'fsdp'); pass batch_axes explicitly"
+            )
+    return NamedSharding(mesh, P(batch_axes if len(batch_axes) > 1 else batch_axes[0]))
+
+
+def batch_divisor(mesh, *batch_axes: str) -> int:
+    """Global batch dim 0 must be a multiple of this (the number of batch
+    shards the mesh produces)."""
+    if not batch_axes:
+        batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    out = 1
+    for a in batch_axes:
+        out *= mesh.shape[a]
+    return out
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def local_batch_slice(global_batch: int, env: Optional[ProcessEnv] = None) -> Tuple[int, int]:
+    """(start, size) of this host's rows of a globally-sharded batch — the
+    per-rank DistributedSampler split, TPU-style (each host feeds only its
+    local devices)."""
+    pe = env or process_env()
+    if global_batch % pe.num_processes != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {pe.num_processes} processes"
+        )
+    per = global_batch // pe.num_processes
+    return pe.process_id * per, per

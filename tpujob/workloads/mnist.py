@@ -1,0 +1,202 @@
+"""Distributed MNIST example, TPU-native.
+
+Mirror of ``examples/mnist/mnist.py`` arg-for-arg: the same CNN (conv 20@5x5
+→ pool → conv 50@5x5 → pool → fc 500 → fc 10 → log_softmax, mnist.py:17-33),
+the same flags (batch-size/test-batch-size/epochs/lr/momentum/seed/
+log-interval/save-model/dir, mnist.py:79-102), the same train/test log lines
+(mnist.py:44-49,64-65) and SummaryWriter scalars ('loss' per log-interval,
+'accuracy' per epoch).
+
+TPU-first deltas: the model is flax/linen in NHWC; distribution is SPMD data
+parallelism over a ``jax.sharding.Mesh`` (jit inserts the gradient
+all-reduce — the compiled form of the DDP wrapper, mnist.py:135-138);
+``--backend`` accepts only ``xla``; ``--save-model`` writes an orbax
+checkpoint instead of ``torch.save``.
+
+Entrypoint of the MNIST TPUJob examples:
+    python -m tpujob.workloads.mnist --epochs 1
+"""
+from __future__ import annotations
+
+import argparse
+import time
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from tpujob.workloads import data as datalib
+from tpujob.workloads import distributed as dist
+from tpujob.workloads import train_lib
+
+
+class Net(nn.Module):
+    """The reference CNN (mnist.py:17-33), NHWC."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Conv(20, (5, 5), padding="VALID", name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(50, (5, 5), padding="VALID", name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))  # 4*4*50
+        x = nn.relu(nn.Dense(500, name="fc1")(x))
+        x = nn.Dense(10, name="fc2")(x)
+        return nn.log_softmax(x)
+
+
+def nll_loss(params: Any, batch) -> jax.Array:
+    """F.nll_loss on log-probs (mnist.py:41): mean over the global batch."""
+    x, y = batch
+    logp = Net().apply(params, x)
+    return -jnp.take_along_axis(logp, y[:, None].astype(jnp.int32), axis=1).mean()
+
+
+def eval_metrics(params: Any, batch):
+    """Summed nll + correct-prediction count (mnist.py:53-62)."""
+    x, y = batch
+    logp = Net().apply(params, x)
+    y = y.astype(jnp.int32)
+    loss_sum = -jnp.take_along_axis(logp, y[:, None], axis=1).sum()
+    correct = (jnp.argmax(logp, axis=1) == y).sum()
+    return loss_sum, correct
+
+
+def train_epoch(args, state, train_step, mesh, train_x, train_y, epoch, writer, pe):
+    n = len(train_x) - len(train_x) % args.batch_size
+    steps_per_epoch = n // args.batch_size
+    # every host iterates the same global batch order (same seed) and feeds
+    # only its own rows — the DistributedSampler split, TPU-style
+    lo, sz = dist.local_batch_slice(args.batch_size, pe)
+    last_loss = None
+    for batch_idx, (bx, by) in enumerate(
+        datalib.batches(train_x, train_y, args.batch_size, seed=args.seed + epoch)
+    ):
+        state, loss = train_step(
+            state, train_lib.put_batch((bx[lo : lo + sz], by[lo : lo + sz]), mesh)
+        )
+        if batch_idx % args.log_interval == 0:
+            loss_v = float(loss)
+            print(
+                "Train Epoch: {} [{}/{} ({:.0f}%)]\tloss={:.4f}".format(
+                    epoch, batch_idx * args.batch_size, n,
+                    100.0 * batch_idx / steps_per_epoch, loss_v,
+                )
+            )
+            writer.add_scalar("loss", loss_v, epoch * steps_per_epoch + batch_idx)
+            last_loss = loss_v
+    return state, last_loss
+
+
+def test_epoch(args, state, eval_step, mesh, test_x, test_y, epoch, writer, pe) -> float:
+    total = len(test_x) - len(test_x) % args.test_batch_size
+    lo, sz = dist.local_batch_slice(args.test_batch_size, pe)
+    loss_sum, correct = 0.0, 0
+    for bx, by in datalib.batches(
+        test_x, test_y, args.test_batch_size, shuffle=False
+    ):
+        ls, c = eval_step(
+            state["params"], train_lib.put_batch((bx[lo : lo + sz], by[lo : lo + sz]), mesh)
+        )
+        loss_sum += float(ls)
+        correct += int(c)
+    accuracy = correct / max(1, total)
+    print("\naccuracy={:.4f}\n".format(accuracy))
+    writer.add_scalar("accuracy", accuracy, epoch)
+    return accuracy
+
+
+def build_parser() -> argparse.ArgumentParser:
+    # flag-for-flag with mnist.py:79-102
+    p = argparse.ArgumentParser(description="TPU-native MNIST Example")
+    p.add_argument("--batch-size", type=int, default=64, metavar="N",
+                   help="input batch size for training (default: 64)")
+    p.add_argument("--test-batch-size", type=int, default=1000, metavar="N",
+                   help="input batch size for testing (default: 1000)")
+    p.add_argument("--epochs", type=int, default=1, metavar="N",
+                   help="number of epochs to train (default: 1)")
+    p.add_argument("--lr", type=float, default=0.01, metavar="LR",
+                   help="learning rate (default: 0.01)")
+    p.add_argument("--momentum", type=float, default=0.5, metavar="M",
+                   help="SGD momentum (default: 0.5)")
+    p.add_argument("--seed", type=int, default=1, metavar="S",
+                   help="random seed (default: 1)")
+    p.add_argument("--log-interval", type=int, default=10, metavar="N",
+                   help="how many batches to wait before logging training status")
+    p.add_argument("--save-model", action="store_true", default=False,
+                   help="For Saving the current Model")
+    p.add_argument("--dir", default="logs", metavar="L",
+                   help="directory where summary logs are stored")
+    p.add_argument("--backend", type=str, choices=["xla"], default="xla",
+                   help="Distributed backend (XLA collectives over ICI/DCN)")
+    p.add_argument("--data-dir", default=None,
+                   help="IDX dataset dir (torchvision layout); synthetic if absent")
+    p.add_argument("--train-size", type=int, default=60000)
+    p.add_argument("--test-size", type=int, default=10000)
+    return p
+
+
+def run(args, mesh=None) -> Dict[str, Any]:
+    pe = dist.initialize()
+    if pe.is_distributed:
+        print("Using distributed TPU with {} backend".format(args.backend))
+    if mesh is None:
+        mesh = dist.make_mesh({"data": -1}, env=pe)
+    writer = train_lib.SummaryWriter(args.dir, enabled=pe.process_id == 0)
+
+    train_x, train_y, test_x, test_y = datalib.mnist_datasets(
+        args.data_dir, args.train_size, args.test_size
+    )
+    # clamp so a small test set still yields at least one full batch
+    # (drop_remainder would otherwise silently produce accuracy=0), rounded
+    # to the mesh's batch-shard divisor so dim 0 stays evenly shardable
+    div = dist.batch_divisor(mesh)
+    args.test_batch_size = max(div, min(args.test_batch_size, len(test_x)) // div * div)
+    args.batch_size = max(div, min(args.batch_size, len(train_x)) // div * div)
+
+    model = Net()
+    optimizer = train_lib.sgd(args.lr, args.momentum)
+    rng = jax.random.PRNGKey(args.seed)
+    state = train_lib.init_state(
+        lambda r, x: model.init(r, x), optimizer, rng,
+        jnp.zeros((1,) + datalib.IMAGE_SHAPE), mesh,
+    )
+    train_step = train_lib.make_train_step(nll_loss, optimizer, mesh)
+    eval_step = train_lib.make_eval_step(eval_metrics, mesh)
+
+    accuracy, last_loss = 0.0, None
+    t0 = time.perf_counter()
+    for epoch in range(1, args.epochs + 1):
+        state, last_loss = train_epoch(
+            args, state, train_step, mesh, train_x, train_y, epoch, writer, pe
+        )
+        accuracy = test_epoch(
+            args, state, eval_step, mesh, test_x, test_y, epoch, writer, pe
+        )
+    wall = time.perf_counter() - t0
+
+    if args.save_model and pe.process_id == 0:
+        ckpt = train_lib.Checkpointer(args.dir + "/ckpt")
+        ckpt.save(int(state["step"]), jax.device_get(state))
+        ckpt.close()
+    writer.close()
+    return {
+        "accuracy": accuracy,
+        "final_loss": last_loss,
+        "wall_s": wall,
+        "samples": (len(train_x) - len(train_x) % args.batch_size) * args.epochs,
+        "state": state,
+    }
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    result = run(args)
+    return 0 if result["accuracy"] > 0.0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

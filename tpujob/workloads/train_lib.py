@@ -1,0 +1,179 @@
+"""Shared SPMD training machinery for the example workloads.
+
+The reference's training loop is torch-imperative: forward, ``loss.backward()``,
+allreduce via the DDP hook, ``optimizer.step()``
+(``examples/mnist/mnist.py:35-49``).  The TPU-native loop is one jitted
+functional step: ``jax.value_and_grad`` under ``jit`` over a Mesh, with the
+gradient all-reduce inserted by XLA from the sharding annotations (params
+replicated, batch sharded on the data axis) — there is no explicit
+collective to write for DP.
+
+Also here: checkpoint/save-restore (orbax — the ``torch.save`` equivalent,
+mnist.py:146-147, upgraded to resumable distributed checkpointing the
+reference lacks, SURVEY.md §5) and a SummaryWriter-compatible scalar logger
+(the tensorboardX shim; JSONL on disk, no display deps).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpujob.workloads import distributed as dist
+
+
+class SummaryWriter:
+    """tensorboardX-shaped scalar writer (mnist.py:6,49,65 ``add_scalar``).
+
+    Writes one JSONL file per run; only process 0 writes, matching the
+    usual multi-host convention.
+    """
+
+    def __init__(self, logdir: str, enabled: Optional[bool] = None):
+        self.logdir = logdir
+        if enabled is None:
+            enabled = dist.process_env().process_id == 0
+        self.enabled = enabled
+        self._f = None
+        if enabled:
+            os.makedirs(logdir, exist_ok=True)
+            self._f = open(os.path.join(logdir, "scalars.jsonl"), "a")
+
+    def add_scalar(self, tag: str, value, step: int) -> None:
+        if self._f:
+            self._f.write(
+                json.dumps({"tag": tag, "value": float(value), "step": int(step),
+                            "wall_time": time.time()}) + "\n"
+            )
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()
+            self._f = None
+
+
+# ---------------------------------------------------------------------------
+# Train state + step
+# ---------------------------------------------------------------------------
+
+
+def sgd(lr: float, momentum: float) -> optax.GradientTransformation:
+    """The reference's optimizer (optim.SGD(lr, momentum), mnist.py:141)."""
+    return optax.sgd(lr, momentum=momentum)
+
+
+def init_state(
+    model_init: Callable[..., Any],
+    optimizer: optax.GradientTransformation,
+    rng: jax.Array,
+    sample_input: jax.Array,
+    mesh=None,
+) -> Dict[str, Any]:
+    """{'params','opt','step'} pytree, replicated over the mesh when given."""
+    params = model_init(rng, sample_input)
+    state = {"params": params, "opt": optimizer.init(params), "step": jnp.zeros((), jnp.int32)}
+    if mesh is not None:
+        state = jax.device_put(state, dist.replicated(mesh))
+    return state
+
+
+def make_train_step(
+    loss_fn: Callable[[Any, Tuple[jax.Array, ...]], jax.Array],
+    optimizer: optax.GradientTransformation,
+    mesh,
+    donate: bool = True,
+):
+    """Build the jitted DP train step.
+
+    ``loss_fn(params, batch) -> scalar mean loss``.  Shardings: state
+    replicated, batch split on the data axis; XLA inserts the psum for the
+    replicated-output gradients (this is DDP's allreduce, compiled).
+    """
+    repl = dist.replicated(mesh)
+    bsh = dist.batch_sharding(mesh)
+
+    def step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        updates, opt = optimizer.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "opt": opt, "step": state["step"] + 1}, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(repl, bsh),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_eval_step(
+    metric_fn: Callable[[Any, Tuple[jax.Array, ...]], Any],
+    mesh,
+):
+    """Jitted eval step: replicated params, sharded batch, replicated metrics."""
+    repl = dist.replicated(mesh)
+    bsh = dist.batch_sharding(mesh)
+    return jax.jit(metric_fn, in_shardings=(repl, bsh), out_shardings=repl)
+
+
+def put_batch(batch, mesh):
+    """Assemble the global batch, dim-0 sharded on the batch axes.
+
+    Each process passes only its own rows (its ``local_batch_slice`` of the
+    global batch).  Single-process: the local rows are the global batch and
+    a plain device_put suffices.  Multi-host: only this host's devices are
+    addressable, so the global array is assembled with
+    ``make_array_from_process_local_data`` — no cross-host transfer.
+    """
+    sh = dist.batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda a: jax.device_put(a, sh), batch)
+    return jax.tree.map(
+        lambda a: jax.make_array_from_process_local_data(sh, a), batch
+    )
+
+
+# ---------------------------------------------------------------------------
+# Checkpointing (orbax)
+# ---------------------------------------------------------------------------
+
+
+class Checkpointer:
+    """Step-numbered save/restore for the train state.
+
+    The resume story the reference leaves to the workload (SURVEY.md §5
+    "Checkpoint/resume: none in the operator"): with OnFailure restarts the
+    re-scheduled pod calls ``latest_step`` + ``restore`` and continues.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, state) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: int, like):
+        import orbax.checkpoint as ocp
+
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(like))
+
+    def close(self) -> None:
+        self._mgr.close()
