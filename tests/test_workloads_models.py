@@ -1,0 +1,156 @@
+"""ResNet-50 / BERT / parallelism-strategy tests on the virtual 8-device mesh.
+
+The reference has no TP/SP to test (SURVEY.md §2.5); these cover the
+TPU-first extensions: ring attention exactness, rule-based TP partitioning,
+and strategy-equivalence (TP/SP runs must match pure-DP numerics).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpujob.workloads import bert as bertlib
+from tpujob.workloads import distributed as dist
+from tpujob.workloads import parallel, resnet
+
+
+def cpu_env():
+    return dist.process_env({})
+
+
+class TestRingAttention:
+    def _qkv(self, b=2, s=32, h=4, d=8, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        shape = (b, s, h, d)
+        return tuple(jax.random.normal(k, shape) for k in ks)
+
+    def test_matches_full_attention(self):
+        q, k, v = self._qkv()
+        mesh = dist.make_mesh({"sequence": 8}, env=cpu_env())
+        ring = parallel.ring_attention(q, k, v, mesh)
+        full = parallel.full_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(full),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_matches_full_attention_causal(self):
+        q, k, v = self._qkv(seed=1)
+        mesh = dist.make_mesh({"sequence": 8}, env=cpu_env())
+        ring = parallel.ring_attention(q, k, v, mesh, causal=True)
+        full = parallel.full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(full),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_composes_with_data_and_head_axes(self):
+        q, k, v = self._qkv(b=4, s=16, h=4, seed=2)
+        mesh = dist.make_mesh({"data": 2, "sequence": 2, "tensor": 2}, env=cpu_env())
+        ring = parallel.ring_attention(q, k, v, mesh, head_axis="tensor")
+        full = parallel.full_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(full),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_flow(self):
+        q, k, v = self._qkv(s=16)
+        mesh = dist.make_mesh({"sequence": 8}, env=cpu_env())
+
+        def loss(q):
+            return parallel.ring_attention(q, k, v, mesh).sum()
+
+        g = jax.grad(loss)(q)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestPartitionRules:
+    def test_spec_tree_by_regex(self):
+        params = {"layer_0": {"attn": {"query": {"kernel": jnp.zeros((4, 4)),
+                                                 "bias": jnp.zeros((4,))},
+                                       "out": {"kernel": jnp.zeros((4, 4))}},
+                  "ln": {"scale": jnp.ones((4,))}}}
+        specs = parallel.partition_spec_tree(params, bertlib.PARTITION_RULES)
+        assert specs["layer_0"]["attn"]["query"]["kernel"] == P(None, "tensor")
+        assert specs["layer_0"]["attn"]["query"]["bias"] == P("tensor")
+        assert specs["layer_0"]["attn"]["out"]["kernel"] == P("tensor", None)
+        assert specs["layer_0"]["ln"]["scale"] == P()
+
+    def test_shard_params_places_on_mesh(self):
+        mesh = dist.make_mesh({"data": 2, "tensor": 4}, env=cpu_env())
+        params = {"attn": {"query": {"kernel": jnp.zeros((8, 8))}}}
+        sharded = parallel.shard_params(params, mesh, bertlib.PARTITION_RULES)
+        sh = sharded["attn"]["query"]["kernel"].sharding
+        assert sh.spec == P(None, "tensor")
+
+
+def tiny_bert_args(tmp_path, **over):
+    argv = ["--vocab", "211", "--hidden", "64", "--layers", "2", "--heads", "4",
+            "--intermediate", "128", "--seq-len", "64", "--batch-size", "16",
+            "--steps", "6", "--log-interval", "2",
+            "--dir", str(tmp_path / "logs"), "--no-bf16"]
+    for k, v in over.items():
+        flag = f"--{k.replace('_', '-')}"
+        if v is True:
+            argv.append(flag)
+        else:
+            argv += [flag, str(v)]
+    return bertlib.build_parser().parse_args(argv)
+
+
+class TestBert:
+    def test_loss_decreases_dp(self, tmp_path):
+        res = bertlib.run(tiny_bert_args(tmp_path, steps=30, lr=0.003))
+        # MLM memorizing one batch: loss must drop well below ln(211)≈5.35
+        assert res["final_loss"] < 4.0, res
+
+    def test_tp_matches_dp_numerics(self, tmp_path):
+        """Megatron-style TP is an annotation, not an algorithm change:
+        first-step loss must match pure DP to fp tolerance."""
+        r_dp = bertlib.run(tiny_bert_args(tmp_path, steps=2))
+        r_tp = bertlib.run(tiny_bert_args(tmp_path, steps=2, tensor_parallel=4))
+        assert abs(r_dp["final_loss"] - r_tp["final_loss"]) < 1e-3
+
+    def test_ring_attention_path_matches(self, tmp_path):
+        r_dp = bertlib.run(tiny_bert_args(tmp_path, steps=2))
+        r_sp = bertlib.run(tiny_bert_args(tmp_path, steps=2, sequence_parallel=4))
+        assert abs(r_dp["final_loss"] - r_sp["final_loss"]) < 1e-3
+
+    def test_checkpoint_resume(self, tmp_path):
+        """The preemption story: run 4 steps checkpointing every 2, kill,
+        rerun — resumes from step 4, not scratch."""
+        args = tiny_bert_args(tmp_path, steps=4, checkpoint_interval=2)
+        bertlib.run(args)
+        args2 = tiny_bert_args(tmp_path, steps=6, checkpoint_interval=2)
+        res = bertlib.run(args2)  # must resume from 4 and run 2 more
+        from tpujob.workloads import train_lib
+
+        ckpt = train_lib.Checkpointer(str(tmp_path / "logs" / "ckpt"))
+        assert ckpt.latest_step() == 6
+        ckpt.close()
+
+
+class TestResNet:
+    def _args(self, tmp_path, **over):
+        argv = ["--width", "16", "--image-size", "64", "--batch-size", "16",
+                "--steps", "2", "--warmup-steps", "1", "--no-bf16",
+                "--dir", str(tmp_path / "logs")]
+        for k, v in over.items():
+            argv += [f"--{k.replace('_', '-')}", str(v)]
+        return resnet.build_parser().parse_args(argv)
+
+    def test_resnet50_shapes(self):
+        model = resnet.ResNet(depth=50, width=16, num_classes=10)
+        v = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)), train=False)
+        out = model.apply(v, jnp.zeros((2, 64, 64, 3)), train=False)
+        assert out.shape == (2, 10)
+        # 16 bottlenecks for depth-50: 3+4+6+3
+        blocks = [k for k in v["params"] if k.startswith("Bottleneck")]
+        assert len(blocks) == 16
+
+    def test_trains_and_reports_throughput(self, tmp_path):
+        res = resnet.run(self._args(tmp_path))
+        assert res["samples_per_sec"] > 0
+        assert np.isfinite(res["final_loss"])
+
+    def test_batchnorm_stats_update(self, tmp_path):
+        res = resnet.run(self._args(tmp_path))
+        stats = jax.device_get(res["state"]["extra"])
+        leaves = jax.tree_util.tree_leaves(stats)
+        assert any(np.abs(l).sum() > 0 for l in leaves)

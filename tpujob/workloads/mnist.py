@@ -161,8 +161,7 @@ def run(args, mesh=None) -> Dict[str, Any]:
     optimizer = train_lib.sgd(args.lr, args.momentum)
     rng = jax.random.PRNGKey(args.seed)
     state = train_lib.init_state(
-        lambda r, x: model.init(r, x), optimizer, rng,
-        jnp.zeros((1,) + datalib.IMAGE_SHAPE), mesh,
+        model.init(rng, jnp.zeros((1,) + datalib.IMAGE_SHAPE)), optimizer, mesh
     )
     train_step = train_lib.make_train_step(nll_loss, optimizer, mesh)
     eval_step = train_lib.make_eval_step(eval_metrics, mesh)
@@ -180,7 +179,7 @@ def run(args, mesh=None) -> Dict[str, Any]:
 
     if args.save_model and pe.process_id == 0:
         ckpt = train_lib.Checkpointer(args.dir + "/ckpt")
-        ckpt.save(int(state["step"]), jax.device_get(state))
+        ckpt.save(int(state["step"]), state)
         ckpt.close()
     writer.close()
     return {

@@ -1,0 +1,193 @@
+"""Parallelism strategies beyond data parallelism.
+
+The reference implements exactly one strategy — multi-process DP via DDP in
+the workload (SURVEY.md §2.5, ``examples/mnist/mnist.py:135-138``).  This
+module carries the TPU-first extensions that make the framework usable at
+slice scale:
+
+- **Tensor parallelism**: rule-based parameter partition specs; XLA/GSPMD
+  inserts the per-layer collectives from the annotations (no hand-written
+  all-reduces).
+- **Sequence/context parallelism**: ring attention — K/V blocks rotate
+  around the ICI ring via ``ppermute`` while each device keeps a
+  flash-attention-style running softmax over its Q shard, so attention over
+  a sequence of length S costs O(S/n) memory per device and overlaps
+  compute with neighbour exchange.  This is the long-context story.
+
+All collective layout follows the mesh built by
+``tpujob.workloads.distributed.make_mesh`` (data slowest / tensor+sequence
+on ICI neighbours).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+# ---------------------------------------------------------------------------
+# Rule-based tensor-parallel parameter partitioning
+# ---------------------------------------------------------------------------
+
+
+def partition_spec_tree(params: Any, rules: Sequence[Tuple[str, P]]) -> Any:
+    """Map each param leaf to a PartitionSpec by first regex match on its
+    '/'-joined path; unmatched leaves replicate (P()).
+
+    This is the GSPMD idiom: annotate parameters once, let the compiler
+    derive every collective — the TPU-native replacement for hand-placed
+    NCCL calls.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def spec_for(path) -> P:
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        )
+        for pat, spec in rules:
+            if re.search(pat, name):
+                return spec
+        return P()
+
+    specs = [spec_for(path) for path, _ in flat]
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def sanitize_spec(spec: P, mesh) -> P:
+    """Drop mesh axes the rule names but this mesh doesn't carry (a TP rule
+    on a pure-DP mesh degrades to replication, not an error)."""
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in mesh.axis_names)
+            return kept if kept else None
+        return entry if entry in mesh.axis_names else None
+
+    return P(*(keep(e) for e in spec))
+
+
+def shard_params(params: Any, mesh, rules: Sequence[Tuple[str, P]]) -> Any:
+    """device_put params with their rule-derived shardings."""
+    specs = partition_spec_tree(params, rules)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, sanitize_spec(s, mesh))),
+        params, specs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (sequence/context parallelism)
+# ---------------------------------------------------------------------------
+
+
+def _block_attention(q, k, v, bias, m_prev, l_prev, o_prev, scale):
+    """One flash-style block update: softmax statistics (m, l) and output
+    accumulator o folded over an incoming K/V block."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # renormalize previous accumulator to the new max
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l_prev * alpha + p.sum(axis=-1)
+    o_new = o_prev * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    axis: str = "sequence",
+    head_axis: Optional[str] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over a sequence sharded on ``axis``.
+
+    Inputs are [batch, seq, heads, head_dim] with seq sharded over the mesh
+    ``axis``; output has the same sharding.  Each of the n ring steps
+    computes attention of the local Q block against the K/V block currently
+    resident, then rotates K/V one hop with ``ppermute`` (neighbour-only ICI
+    traffic — this is why the sequence axis must sit on ICI, see
+    ``distributed.AXIS_ORDER``).  Softmax is exact via running (m, l)
+    statistics, so results match full attention to numerical precision.
+
+    ``head_axis`` additionally splits the heads dim over a tensor-parallel
+    mesh axis (ring-over-sequence composes with Megatron-style TP: each
+    device holds its head shard of its sequence block).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = mesh.shape[axis]
+
+    def local(qb, kb, vb):
+        idx = jax.lax.axis_index(axis)
+        b, sq, h, d = qb.shape
+        m0 = jnp.full((b, h, sq), -jnp.inf, q.dtype)
+        l0 = jnp.zeros((b, h, sq), q.dtype)
+        o0 = jnp.zeros((b, h, sq, d), q.dtype)
+
+        def step(i, carry):
+            m, l, o, kc, vc = carry
+            # kc/vc arrived from neighbour idx+1 at each hop, so after i
+            # hops the resident block is (idx + i) % n
+            src_block = (idx + i) % n
+            bias = None
+            if causal:
+                sk = kc.shape[1]
+                q_pos = idx * sq + jnp.arange(sq)
+                k_pos = src_block * sk + jnp.arange(sk)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                # finite mask value: a fully-masked block (all-future K) must
+                # not poison the running max with -inf (exp(-inf+inf)=nan)
+                bias = jnp.where(mask, 0.0, -1e30)[None, None]
+            m, l, o = _block_attention(qb, kc, vc, bias, m, l, o, scale)
+            # rotate K/V to the next device (receive from idx+1)
+            perm = [(j, (j - 1) % n) for j in range(n)]
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            return m, l, o, kc, vc
+
+        m, l, o, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, o0, kb, vb))
+        out = o / l[..., None]
+        return out.transpose(0, 2, 1, 3)  # [b, sq, h, d]
+
+    # batch stays split over the data axis inside the manual region (an
+    # unsharded first dim would force an all-gather of the whole batch);
+    # skipped when the static batch doesn't divide it (e.g. batch-1 traces
+    # during model.init)
+    batch_axis = (
+        "data"
+        if "data" in mesh.axis_names and q.shape[0] % mesh.shape["data"] == 0
+        else None
+    )
+    spec = P(batch_axis, axis, head_axis, None)
+    return shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def full_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
+    """Reference dense attention (same layout) for parity tests and the
+    unsharded path."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

@@ -1,0 +1,185 @@
+"""ResNet-50 workload (the multi-host north-star model, BASELINE.md:
+"ResNet-50 ImageNet samples/sec/chip, multi-host v4-32").
+
+The reference provides no ResNet code — its v4-32 config is a driver target
+(BASELINE.json), the operator just schedules whatever image the user ships.
+This is that image's workload: flax ResNet-50 v1.5 (stride-2 on the 3x3,
+the variant every published benchmark uses), NHWC + bfloat16-friendly,
+trained with the same SPMD DP machinery as MNIST.
+
+Under jit the BatchNorm batch statistics are computed over the *global*
+batch dimension (the array is one logical tensor; XLA inserts the
+cross-device mean) — this is sync-BN for free, where torch DDP needs
+SyncBatchNorm.
+
+Entrypoint:
+    python -m tpujob.workloads.resnet --steps 100 --batch-size 256
+"""
+from __future__ import annotations
+
+import argparse
+import time
+from functools import partial
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from tpujob.workloads import data as datalib
+from tpujob.workloads import distributed as dist
+from tpujob.workloads import train_lib
+
+STAGE_SIZES = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)  # zero-init last BN gamma
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides, self.strides),
+                            name="downsample_conv")(residual)
+            residual = norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    depth: int = 50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="conv_init")(x)
+        x = nn.relu(norm(name="bn_init")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, blocks in enumerate(STAGE_SIZES[self.depth]):
+            for block in range(blocks):
+                x = Bottleneck(
+                    filters=self.width * 2**stage,
+                    strides=2 if block == 0 and stage > 0 else 1,
+                    dtype=self.dtype,
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype)
+    return -(onehot * jax.nn.log_softmax(logits)).sum(axis=-1).mean()
+
+
+def make_model(args) -> ResNet:
+    return ResNet(depth=args.depth, width=args.width,
+                  dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+
+
+def build_loss(model: ResNet):
+    def loss_fn(params, batch_stats, batch):
+        x, y = batch
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x, train=True,
+            mutable=["batch_stats"],
+        )
+        return cross_entropy(logits, y), mutated["batch_stats"]
+
+    return loss_fn
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="TPU-native ResNet-50")
+    p.add_argument("--depth", type=int, default=50, choices=sorted(STAGE_SIZES))
+    p.add_argument("--width", type=int, default=64,
+                   help="base filter count (64 = standard ResNet)")
+    p.add_argument("--batch-size", type=int, default=256,
+                   help="global batch size")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--warmup-steps", type=int, default=2,
+                   help="compile+warmup steps excluded from throughput")
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--no-bf16", dest="bf16", action="store_false")
+    p.add_argument("--log-interval", type=int, default=20)
+    p.add_argument("--dir", default="logs")
+    return p
+
+
+def run(args, mesh=None) -> Dict[str, Any]:
+    pe = dist.initialize()
+    if mesh is None:
+        mesh = dist.make_mesh({"data": -1}, env=pe)
+    writer = train_lib.SummaryWriter(args.dir, enabled=pe.process_id == 0)
+
+    model = make_model(args)
+    optimizer = train_lib.sgd(args.lr, args.momentum)
+    rng = jax.random.PRNGKey(args.seed)
+    sample = jnp.zeros((1, args.image_size, args.image_size, 3))
+    variables = model.init(rng, sample, train=False)
+    state = train_lib.init_state(
+        variables["params"], optimizer, mesh, extra=variables["batch_stats"]
+    )
+
+    train_step = train_lib.make_train_step(
+        build_loss(model), optimizer, mesh, has_extra=True
+    )
+
+    lo, sz = dist.local_batch_slice(args.batch_size, pe)
+    x, y = datalib.synthetic_imagenet_batch(args.batch_size, args.image_size)
+    batch = train_lib.put_batch((x[lo : lo + sz], y[lo : lo + sz]), mesh)
+
+    # warmup (compile) then timed steps
+    loss = None
+    for _ in range(args.warmup_steps):
+        state, loss = train_step(state, batch)
+    if loss is not None:
+        jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, loss = train_step(state, batch)
+        if i % args.log_interval == 0:
+            writer.add_scalar("loss", float(loss), i)
+    jax.block_until_ready(loss)
+    wall = time.perf_counter() - t0
+    sps = args.steps * args.batch_size / wall
+    writer.close()
+    if pe.process_id == 0:
+        print(f"resnet{args.depth}: {sps:.1f} samples/sec "
+              f"({sps / max(1, len(jax.devices())):.1f}/device), loss={float(loss):.3f}")
+    return {"samples_per_sec": sps, "wall_s": wall, "final_loss": float(loss),
+            "state": state}
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    run(args)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -68,42 +68,76 @@ def sgd(lr: float, momentum: float) -> optax.GradientTransformation:
     return optax.sgd(lr, momentum=momentum)
 
 
+def adamw(lr: float, weight_decay: float = 0.01) -> optax.GradientTransformation:
+    """Transformer-default optimizer (BERT pretraining)."""
+    return optax.adamw(lr, weight_decay=weight_decay)
+
+
 def init_state(
-    model_init: Callable[..., Any],
+    params: Any,
     optimizer: optax.GradientTransformation,
-    rng: jax.Array,
-    sample_input: jax.Array,
     mesh=None,
+    extra: Any = None,
 ) -> Dict[str, Any]:
-    """{'params','opt','step'} pytree, replicated over the mesh when given."""
-    params = model_init(rng, sample_input)
+    """{'params','opt','step'[,'extra']} pytree, replicated over the mesh.
+
+    ``extra`` carries non-gradient mutable collections (e.g. BatchNorm
+    running stats) threaded through the train step.
+    """
     state = {"params": params, "opt": optimizer.init(params), "step": jnp.zeros((), jnp.int32)}
+    if extra is not None:
+        state["extra"] = extra
     if mesh is not None:
         state = jax.device_put(state, dist.replicated(mesh))
     return state
 
 
 def make_train_step(
-    loss_fn: Callable[[Any, Tuple[jax.Array, ...]], jax.Array],
+    loss_fn: Callable[..., Any],
     optimizer: optax.GradientTransformation,
     mesh,
     donate: bool = True,
+    has_extra: bool = False,
+    state_shardings: Any = None,
 ):
     """Build the jitted DP train step.
 
-    ``loss_fn(params, batch) -> scalar mean loss``.  Shardings: state
-    replicated, batch split on the data axis; XLA inserts the psum for the
-    replicated-output gradients (this is DDP's allreduce, compiled).
+    ``loss_fn(params, batch) -> scalar mean loss`` (or, with ``has_extra``,
+    ``loss_fn(params, extra, batch) -> (loss, new_extra)`` for mutable
+    collections like BatchNorm stats).  Shardings: state replicated (or
+    with ``committed_state`` inferred from the caller's committed rule-based
+    shardings for tensor parallelism), batch split on the data axis; XLA inserts the gradient psum from the
+    annotations (this is DDP's allreduce, compiled).
     """
     repl = dist.replicated(mesh)
     bsh = dist.batch_sharding(mesh)
 
     def step(state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        if has_extra:
+            (loss, extra), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state["params"], state["extra"], batch
+            )
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
         updates, opt = optimizer.update(grads, state["opt"], state["params"])
         params = optax.apply_updates(state["params"], updates)
-        return {"params": params, "opt": opt, "step": state["step"] + 1}, loss
+        out = {"params": params, "opt": opt, "step": state["step"] + 1}
+        if has_extra:
+            out["extra"] = extra
+        return out, loss
 
+    if state_shardings is not None:
+        # Tensor-parallel case: the caller committed params (and the
+        # optimizer moments initialized from them) to rule-derived layouts;
+        # pin outputs to the same layouts so the step is layout-stable
+        # (an AOT-compiled executable must see identical shardings each call)
+        return jax.jit(
+            step,
+            in_shardings=(state_shardings, bsh),
+            out_shardings=(state_shardings, repl),
+            donate_argnums=(0,) if donate else (),
+        )
+    # a single sharding is a valid pytree prefix for the whole state dict
     return jax.jit(
         step,
         in_shardings=(repl, bsh),
