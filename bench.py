@@ -1,0 +1,84 @@
+"""Headline benchmark: dist-MNIST training throughput per TPU chip.
+
+North-star metric #1 (BASELINE.md): the reference's only quantitative
+claim is the MNIST example at ~10 epochs of 60k samples in 5-10 minutes on
+a CPU cluster with Master=1/Worker=1 gloo (`/root/reference/README.md:37`)
+— i.e. ~1,333 samples/sec at the midpoint (450 s).  ``vs_baseline`` is
+measured throughput per chip divided by that number.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+Runs on whatever jax.devices() provides (the real TPU chip under the
+driver; a CPU mesh locally).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# ~1,333 samples/s: 10 epochs x 60k samples / 450 s (README.md:37 midpoint)
+BASELINE_SAMPLES_PER_SEC = 10 * 60000 / 450.0
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from tpujob.workloads import data as datalib
+    from tpujob.workloads import distributed as dist
+    from tpujob.workloads import mnist, train_lib
+
+    n_chips = max(1, len(jax.devices()))
+    pe = dist.process_env({})
+    mesh = dist.make_mesh({"data": -1}, env=pe)
+
+    # -- accuracy parity gate (one epoch must learn, like the reference) ---
+    acc_args = mnist.build_parser().parse_args(
+        ["--train-size", "8192", "--test-size", "2048", "--epochs", "1",
+         "--dir", "/tmp/tpujob_bench_logs"]
+    )
+    acc = mnist.run(acc_args, mesh=mesh)["accuracy"]
+
+    # -- throughput: big-batch steady-state train steps ---------------------
+    batch = 1024 * n_chips
+    model = mnist.Net()
+    optimizer = train_lib.sgd(0.01, 0.5)
+    state = train_lib.init_state(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1,) + datalib.IMAGE_SHAPE)),
+        optimizer, mesh,
+    )
+    step = train_lib.make_train_step(mnist.nll_loss, optimizer, mesh)
+    x, y = datalib.synthetic_split(batch, seed=0)
+    b = train_lib.put_batch(((x - datalib.MEAN) / datalib.STD, y), mesh)
+
+    state, loss = step(state, b)  # compile
+    jax.block_until_ready(loss)
+    # run for ~2 seconds of steady state
+    t0 = time.perf_counter()
+    steps = 0
+    while time.perf_counter() - t0 < 2.0 or steps < 5:
+        state, loss = step(state, b)
+        steps += 1
+    jax.block_until_ready(loss)
+    wall = time.perf_counter() - t0
+
+    sps_per_chip = steps * batch / wall / n_chips
+    print(json.dumps({
+        "metric": "mnist_train_samples_per_sec_per_chip",
+        "value": round(sps_per_chip, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(sps_per_chip / BASELINE_SAMPLES_PER_SEC, 2),
+        "accuracy_1epoch": round(float(acc), 4),
+        "chips": n_chips,
+        "platform": jax.devices()[0].platform,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

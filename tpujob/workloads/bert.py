@@ -5,7 +5,8 @@ The reference ships no BERT code — this is the user-container workload for
 the driver's preemption config, built TPU-first:
 
 - **DP × TP × SP sharding**: parameters are annotated with rule-based
-  PartitionSpecs (``tpujob.workloads.parallel.PARTITION_RULES``) — QKV and
+  PartitionSpecs (``PARTITION_RULES`` below, applied via
+  ``tpujob.workloads.parallel.shard_params``) — QKV and
   MLP-in kernels column-split on the ``tensor`` axis, projection and MLP-out
   row-split, embeddings vocab-split — and XLA/GSPMD derives every
   collective.  No hand-written all-reduces.
@@ -251,6 +252,19 @@ def run(args, mesh=None) -> Dict[str, Any]:
     ids, mask = mask_batch(ids, args.seed)
     batch = train_lib.put_batch((ids[lo : lo + sz], mask[lo : lo + sz]), mesh)
 
+    if start_step >= args.steps:
+        # the pod was restarted after the final checkpoint (the preemption
+        # race): report completion instead of training further
+        final_loss = float(jax.jit(mlm_loss(model))(state["params"], batch))
+        if pe.process_id == 0:
+            print(f"already complete: resumed at step {start_step} >= "
+                  f"--steps {args.steps}")
+        writer.close()
+        if ckpt:
+            ckpt.close()
+        return {"samples_per_sec": 0.0, "tokens_per_sec": 0.0, "wall_s": 0.0,
+                "final_loss": final_loss, "state": state}
+
     # AOT compile instead of warmup steps: no optimizer updates happen
     # outside the counted loop, so a resumed run is step-exact
     compiled = train_step.lower(state, batch).compile()
@@ -262,13 +276,12 @@ def run(args, mesh=None) -> Dict[str, Any]:
             writer.add_scalar("loss", float(loss), i)
         if ckpt and args.checkpoint_interval and (i + 1) % args.checkpoint_interval == 0:
             ckpt.save(i + 1, state)
-    if loss is not None:
-        jax.block_until_ready(loss)
+    jax.block_until_ready(loss)
     wall = time.perf_counter() - t0
-    steps_run = max(1, args.steps - start_step)
+    steps_run = args.steps - start_step
     sps = steps_run * args.batch_size / wall
     tps = sps * args.seq_len
-    final_loss = float(loss) if loss is not None else float("nan")
+    final_loss = float(loss)
     writer.close()
     if ckpt:
         ckpt.close()

@@ -177,7 +177,10 @@ def run(args, mesh=None) -> Dict[str, Any]:
         )
     wall = time.perf_counter() - t0
 
-    if args.save_model and pe.process_id == 0:
+    if args.save_model:
+        # collective: every process participates in the orbax save (each
+        # contributes its addressable shards; the dir must be a shared FS
+        # on multi-host)
         ckpt = train_lib.Checkpointer(args.dir + "/ckpt")
         ckpt.save(int(state["step"]), state)
         ckpt.close()

@@ -104,10 +104,11 @@ def make_train_step(
 
     ``loss_fn(params, batch) -> scalar mean loss`` (or, with ``has_extra``,
     ``loss_fn(params, extra, batch) -> (loss, new_extra)`` for mutable
-    collections like BatchNorm stats).  Shardings: state replicated (or
-    with ``committed_state`` inferred from the caller's committed rule-based
-    shardings for tensor parallelism), batch split on the data axis; XLA inserts the gradient psum from the
-    annotations (this is DDP's allreduce, compiled).
+    collections like BatchNorm stats).  Shardings: state replicated, or
+    pinned to ``state_shardings`` (a pytree of NamedShardings) when the
+    caller committed rule-based tensor-parallel layouts; batch is split on
+    the data axis.  XLA inserts the gradient psum from the annotations
+    (this is DDP's allreduce, compiled).
     """
     repl = dist.replicated(mesh)
     bsh = dist.batch_sharding(mesh)
