@@ -38,11 +38,19 @@ def main() -> int:
     mesh = dist.make_mesh({"data": -1}, env=pe)
 
     # -- accuracy parity gate (one epoch must learn, like the reference) ---
+    import contextlib
+    import io
+
     acc_args = mnist.build_parser().parse_args(
         ["--train-size", "8192", "--test-size", "2048", "--epochs", "1",
          "--dir", "/tmp/tpujob_bench_logs"]
     )
-    acc = mnist.run(acc_args, mesh=mesh)["accuracy"]
+    with contextlib.redirect_stdout(io.StringIO()):  # keep stdout = 1 JSON line
+        acc = mnist.run(acc_args, mesh=mesh)["accuracy"]
+    if acc <= 0.8:
+        print(f"FAIL: one-epoch accuracy {acc:.4f} <= 0.8 — training is broken",
+              file=sys.stderr)
+        return 1
 
     # -- throughput: big-batch steady-state train steps ---------------------
     batch = 1024 * n_chips

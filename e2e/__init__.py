@@ -1,0 +1,9 @@
+"""End-to-end harness (the reference's ``test/e2e/v1`` tier).
+
+The reference E2E binaries run against a real EKS cluster with a smoke
+image (``test/e2e/v1/default/defaults.go``, ``cleanpolicy_all.go``).  Here
+the cluster substrate is the in-memory API server plus :mod:`e2e.kubelet`
+— a simulated kubelet that pulls pods through their phase lifecycle — so
+the identical scenario list runs hermetically in CI and, by swapping the
+transport, against a real cluster.
+"""

@@ -1,0 +1,83 @@
+"""CleanPodPolicy E2E: the reference's ``test/e2e/v1/cleanpolicy_all.go``.
+
+Same flow as defaults but with ``cleanPodPolicy: All`` — after the job
+succeeds the controller itself must delete the pods (no job deletion
+needed), per cleanpolicy_all.go and job.go:153-184.
+
+Runnable:  python -m e2e.cleanpolicy
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+from e2e.cluster import E2ECluster
+from e2e.defaults import expected_pods, smoke_job
+from tpujob.api import constants as c
+
+
+def run_cleanpolicy_all(cluster: E2ECluster, name: str = "smoke-cleanpolicy",
+                        workers: int = 3, timeout: float = 30) -> None:
+    sdk = cluster.sdk
+    sdk.create(smoke_job(name, workers, clean_pod_policy="All"))
+    job = sdk.wait_for_job(name, timeout_seconds=timeout, polling_interval=0.05)
+    assert any(cond.type == c.JOB_SUCCEEDED and cond.status == "True"
+               for cond in job.status.conditions), job.status.to_dict()
+
+    # pods must be deleted by the controller after success
+    deadline = time.monotonic() + 10
+    leftover = None
+    while time.monotonic() < deadline:
+        leftover = [p for p in cluster.pod_names() if p.startswith(name + "-")]
+        if not leftover:
+            break
+        time.sleep(0.05)
+    assert not leftover, f"CleanPodPolicy=All left pods: {leftover}"
+
+    # the job object itself survives with its terminal status
+    final = sdk.get(name)
+    assert any(cond.type == c.JOB_SUCCEEDED for cond in final.status.conditions)
+
+
+def run_cleanpolicy_running(name: str = "smoke-cpr", workers: int = 2,
+                            timeout: float = 30) -> None:
+    """CleanPodPolicy=Running deletes only still-running pods at terminal
+    (kubeflow/common types.go:130-137 semantics).
+
+    Builds its own cluster: workers are scripted to run "forever" so they
+    are still Running when the master completes — the policy must then
+    delete them (a fast-succeeding worker would make the assertion vacuous).
+    """
+    from e2e.kubelet import PodScript
+
+    scripts = [PodScript(match="-worker-", run_seconds=300),
+               PodScript(match="-master-", run_seconds=0.2)]
+    with E2ECluster(scripts=scripts) as cluster:
+        sdk = cluster.sdk
+        sdk.create(smoke_job(name, workers, clean_pod_policy="Running"))
+        sdk.wait_for_job(name, timeout_seconds=timeout, polling_interval=0.05)
+        # the still-running workers must be deleted by the controller
+        deadline = time.monotonic() + 10
+        leftover = None
+        while time.monotonic() < deadline:
+            leftover = [p.metadata.name for p in cluster.clients.pods.list()
+                        if p.metadata.name.startswith(name + "-worker-")]
+            if not leftover:
+                break
+            time.sleep(0.05)
+        assert not leftover, f"CleanPodPolicy=Running left running pods: {leftover}"
+        # the completed master pod is kept (it was not Running)
+        master = [p.metadata.name for p in cluster.clients.pods.list()
+                  if p.metadata.name.startswith(name + "-master-")]
+        assert master, "completed master pod should survive CleanPodPolicy=Running"
+
+
+def main(argv=None) -> int:
+    with E2ECluster() as cluster:
+        run_cleanpolicy_all(cluster)
+    print("cleanPodPolicy=All E2E: PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

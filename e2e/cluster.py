@@ -1,0 +1,64 @@
+"""E2E cluster bootstrap: operator app + simulated kubelet + SDK client.
+
+The in-process equivalent of the reference CI's "create EKS cluster →
+deploy operator" steps (``test/workflows/components/workflows.libsonnet:
+292-345``); swap ``transport`` for a real-cluster transport to run the
+same scenarios against real infrastructure.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from e2e.kubelet import KubeletSim, PodScript
+from tpujob.sdk import TPUJobClient
+from tpujob.server.app import OperatorApp
+from tpujob.server.options import ServerOption
+
+
+class E2ECluster:
+    def __init__(
+        self,
+        scripts: Optional[List[PodScript]] = None,
+        leader_election: bool = False,
+        run_seconds: float = 0.05,
+    ):
+        opt = ServerOption(
+            monitoring_port=0,
+            enable_leader_election=leader_election,
+            lease_duration_s=1.0, renew_deadline_s=0.4, retry_period_s=0.1,
+        )
+        self.app = OperatorApp(opt)
+        self.sdk = TPUJobClient(self.app.transport)
+        self.kubelet = KubeletSim(self.app.clients, run_seconds=run_seconds,
+                                  scripts=scripts)
+        self._thread: Optional[threading.Thread] = None
+
+    def __enter__(self) -> "E2ECluster":
+        self._thread = threading.Thread(
+            target=self.app.run, kwargs={"block": True}, daemon=True,
+            name="operator-app",
+        )
+        self._thread.start()
+        deadline = time.monotonic() + 5
+        while (time.monotonic() < deadline
+               and not self.app.controller.job_informer.has_synced()):
+            time.sleep(0.02)
+        self.kubelet.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.kubelet.stop()
+        self.app.stop_event.set()
+        if self._thread:
+            self._thread.join(timeout=3)
+        self.app.shutdown()
+
+    # convenience
+    @property
+    def clients(self):
+        return self.app.clients
+
+    def pod_names(self, ns: str = "default") -> List[str]:
+        return sorted(p.metadata.name for p in self.clients.pods.list(ns))

@@ -1,0 +1,107 @@
+"""Defaults E2E scenario: the reference's ``test/e2e/v1/default/defaults.go``.
+
+Flow (defaults.go:116-189): create a Master=1/Worker=3 job, wait until
+Succeeded, assert every expected pod name exists, delete the job, assert
+pods/services are garbage-collected.  ``run_concurrent`` is the
+``--num_jobs`` harness (defaults.go:198-248).
+
+Runnable:  python -m e2e.defaults [--num-jobs N]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Optional
+
+from e2e.cluster import E2ECluster
+from tpujob.api import constants as c
+from tpujob.api.types import TPUJob
+
+
+def smoke_job(name: str, workers: int = 3, clean_pod_policy: Optional[str] = None,
+              entry: str = "python -m tpujob.workloads.smoke_dist") -> TPUJob:
+    """The send/recv smoke job the reference CI submits (scripts/v1/
+    run-defaults.sh uses the smoke-dist image)."""
+    spec = {
+        "runPolicy": {"cleanPodPolicy": clean_pod_policy} if clean_pod_policy else {},
+        "tpuReplicaSpecs": {
+            "Master": {"replicas": 1, "restartPolicy": "OnFailure", "template": {
+                "spec": {"containers": [{
+                    "name": c.DEFAULT_CONTAINER_NAME,
+                    "image": "tpujob/examples:smoke-dist",
+                    "command": entry.split(),
+                }]}}},
+            "Worker": {"replicas": workers, "restartPolicy": "OnFailure",
+                       "template": {"spec": {"containers": [{
+                           "name": c.DEFAULT_CONTAINER_NAME,
+                           "image": "tpujob/examples:smoke-dist",
+                           "command": entry.split(),
+                       }]}}},
+        },
+    }
+    return TPUJob.from_dict({
+        "apiVersion": f"{c.GROUP_NAME}/{c.VERSION}", "kind": c.KIND,
+        "metadata": {"name": name, "namespace": "default"}, "spec": spec,
+    })
+
+
+def expected_pods(name: str, workers: int = 3):
+    return sorted([f"{name}-master-0"] + [f"{name}-worker-{i}" for i in range(workers)])
+
+
+def run_single(cluster: E2ECluster, name: str = "smoke-defaults",
+               workers: int = 3, timeout: float = 30) -> None:
+    sdk = cluster.sdk
+    sdk.create(smoke_job(name, workers))
+    job = sdk.wait_for_job(name, timeout_seconds=timeout, polling_interval=0.05)
+    assert any(cond.type == c.JOB_SUCCEEDED and cond.status == "True"
+               for cond in job.status.conditions), job.status.to_dict()
+
+    # every expected pod exists (defaults.go:151-170)
+    pods = sdk.get_pod_names(name)
+    assert pods == expected_pods(name, workers), (pods, expected_pods(name, workers))
+
+    # delete -> owned pods/services garbage-collected (defaults.go:172-189)
+    sdk.delete(name)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        leftover = [p for p in cluster.pod_names() if p.startswith(name + "-")]
+        if not leftover:
+            break
+        time.sleep(0.05)
+    assert not leftover, f"pods not GC'd: {leftover}"
+    svcs = [s.metadata.name for s in cluster.clients.services.list()
+            if s.metadata.name.startswith(name + "-")]
+    assert not svcs, f"services not GC'd: {svcs}"
+
+
+def run_concurrent(cluster: E2ECluster, num_jobs: int, workers: int = 1,
+                   timeout: float = 60) -> None:
+    names = [f"smoke-defaults-{i}" for i in range(num_jobs)]
+    for n in names:
+        cluster.sdk.create(smoke_job(n, workers))
+    for n in names:
+        job = cluster.sdk.wait_for_job(n, timeout_seconds=timeout,
+                                       polling_interval=0.05)
+        assert any(cond.type == c.JOB_SUCCEEDED and cond.status == "True"
+                   for cond in job.status.conditions), n
+        assert cluster.sdk.get_pod_names(n) == expected_pods(n, workers)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="tpujob defaults E2E")
+    p.add_argument("--num-jobs", type=int, default=1)
+    p.add_argument("--workers", type=int, default=3)
+    args = p.parse_args(argv)
+    with E2ECluster() as cluster:
+        if args.num_jobs <= 1:
+            run_single(cluster, workers=args.workers)
+        else:
+            run_concurrent(cluster, args.num_jobs, workers=args.workers)
+    print("defaults E2E: PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

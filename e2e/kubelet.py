@@ -1,0 +1,134 @@
+"""Simulated kubelet: drives pod phase transitions like a node would.
+
+The reference's E2E tier delegates this to real kubelets on EKS; this
+simulator provides the same observable behavior against the in-memory API
+server: created pods go Pending → Running → Succeeded on a timer, with
+per-pod scripted failures (exit codes, flakes) to exercise the restart
+machinery (the send/recv smoke image's role, SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpujob.api import constants as c
+from tpujob.kube.client import ClientSet
+from tpujob.kube.errors import ConflictError, NotFoundError
+from tpujob.kube.objects import Pod, PodStatus
+
+
+@dataclass
+class PodScript:
+    """Scripted behavior for pods whose name contains ``match``.
+
+    ``exit_codes`` are consumed one per completion: nonzero makes the pod
+    Fail with that code, 0 (or exhaustion) makes it Succeed.
+    """
+
+    match: str
+    run_seconds: float = 0.05
+    exit_codes: List[int] = field(default_factory=list)
+
+
+class KubeletSim:
+    """Watches pods and advances their status (one thread, poll-based)."""
+
+    def __init__(
+        self,
+        clients: ClientSet,
+        run_seconds: float = 0.05,
+        scripts: Optional[List[PodScript]] = None,
+        auto_succeed: bool = True,
+    ):
+        self.clients = clients
+        self.run_seconds = run_seconds
+        self.scripts = scripts or []
+        self.auto_succeed = auto_succeed
+        self._started: Dict[str, float] = {}  # uid -> time Running began
+        self._consumed: Dict[str, int] = {}  # script match -> codes used
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "KubeletSim":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="kubelet-sim")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    # -- behavior -----------------------------------------------------------
+
+    def _script_for(self, pod_name: str) -> Optional[PodScript]:
+        for s in self.scripts:
+            if s.match in pod_name:
+                return s
+        return None
+
+    def _next_exit_code(self, script: PodScript) -> int:
+        used = self._consumed.get(script.match, 0)
+        if used < len(script.exit_codes):
+            self._consumed[script.match] = used + 1
+            return script.exit_codes[used]
+        return 0
+
+    def _set_status(self, pod: Pod, phase: str, exit_code: Optional[int],
+                    restart_count: int) -> None:
+        cs = {"name": c.DEFAULT_CONTAINER_NAME, "restartCount": restart_count,
+              "ready": phase == "Running"}
+        if exit_code is not None:
+            cs["state"] = {"terminated": {"exitCode": exit_code}}
+        pod.status = PodStatus.from_dict(
+            {"phase": phase, "containerStatuses": [cs]}
+        )
+        try:
+            self.clients.pods.update_status(pod)
+        except (ConflictError, NotFoundError):
+            pass  # raced with controller delete/update; next poll re-reads
+
+    def _restart_count(self, pod: Pod) -> int:
+        return sum(cs.restart_count for cs in pod.status.container_statuses)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                pods = self.clients.pods.list()
+            except Exception:
+                pods = []
+            now = time.monotonic()
+            for pod in pods:
+                uid = pod.metadata.uid or pod.metadata.name
+                phase = pod.status.phase
+                if phase in ("Succeeded", "Failed"):
+                    continue
+                script = self._script_for(pod.metadata.name)
+                run_for = script.run_seconds if script else self.run_seconds
+                if uid not in self._started:
+                    # Pending -> Running (image pulled, container started)
+                    self._started[uid] = now
+                    self._set_status(pod, "Running", None,
+                                     self._restart_count(pod))
+                    continue
+                if self.auto_succeed and now - self._started[uid] >= run_for:
+                    code = self._next_exit_code(script) if script else 0
+                    in_place_restart = (
+                        code != 0 and pod.spec.restart_policy in ("Always", "OnFailure")
+                    )
+                    if in_place_restart:
+                        # kubelet restarts the container itself; restartCount++
+                        self._started[uid] = now
+                        self._set_status(pod, "Running", None,
+                                         self._restart_count(pod) + 1)
+                    else:
+                        self._set_status(
+                            pod, "Failed" if code != 0 else "Succeeded", code,
+                            self._restart_count(pod),
+                        )
+            self._stop.wait(0.02)

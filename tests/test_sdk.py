@@ -1,0 +1,162 @@
+"""SDK client tests against the in-memory cluster + real controller.
+
+The reference's SDK tier is a live-cluster E2E (sdk/python/test/test_e2e.py)
+— here the cluster is the in-memory API server and the controller drives
+status, so every SDK behavior is covered hermetically (SURVEY.md §4).
+"""
+import io
+import threading
+import time
+
+import pytest
+
+from tpujob.api import constants as c
+from tpujob.sdk import TPUJobClient, watch_job
+
+from jobtestutil import Harness, new_tpujob
+
+
+def make_client(h: Harness) -> TPUJobClient:
+    return TPUJobClient(h.server)
+
+
+class TestCrud:
+    def test_create_defaults_and_validates(self):
+        h = Harness()
+        client = make_client(h)
+        job = client.create(new_tpujob(name="sdk-job"))
+        assert job.metadata.uid
+        # defaulting ran (replicas filled in)
+        assert job.spec.tpu_replica_specs["Master"].replicas == 1
+
+    def test_create_from_manifest_dict(self):
+        h = Harness()
+        client = make_client(h)
+        job = client.create({
+            "apiVersion": f"{c.GROUP_NAME}/{c.VERSION}",
+            "kind": c.KIND,
+            "metadata": {"name": "yaml-job"},
+            "spec": {"tpuReplicaSpecs": {"Master": {"replicas": 1, "template": {
+                "spec": {"containers": [{"name": c.DEFAULT_CONTAINER_NAME,
+                                         "image": "img"}]}}}}},
+        })
+        assert client.get("yaml-job").metadata.name == "yaml-job"
+
+    def test_create_invalid_spec_raises(self):
+        h = Harness()
+        client = make_client(h)
+        bad = new_tpujob(name="bad")
+        bad.spec.tpu_replica_specs["Master"].replicas = 2  # exactly-1 rule
+        with pytest.raises(ValueError, match="invalid TPUJob spec"):
+            client.create(bad)
+
+    def test_patch_and_delete(self):
+        h = Harness()
+        client = make_client(h)
+        client.create(new_tpujob(name="p-job"))
+        patched = client.patch("p-job", {"metadata": {"labels": {"x": "y"}}})
+        assert patched.metadata.labels["x"] == "y"
+        client.delete("p-job")
+        from tpujob.kube.errors import NotFoundError
+
+        with pytest.raises(NotFoundError):
+            client.get("p-job")
+
+
+class TestStatusAndWait:
+    def test_status_predicates_through_lifecycle(self):
+        h = Harness()
+        client = make_client(h)
+        client.create(new_tpujob())
+        h.sync()
+        assert client.get_job_status("test-job") == c.JOB_CREATED
+        h.set_all_phases("test-job", "Running")
+        h.sync()
+        assert client.is_job_running("test-job")
+        h.set_all_phases("test-job", "Succeeded")
+        h.sync()
+        assert client.is_job_succeeded("test-job")
+
+    def test_wait_for_job_returns_on_success(self):
+        h = Harness()
+        client = make_client(h)
+        client.create(new_tpujob())
+        h.sync()
+
+        def drive():
+            time.sleep(0.15)
+            h.set_all_phases("test-job", "Running")
+            h.sync()
+            time.sleep(0.15)
+            h.set_all_phases("test-job", "Succeeded")
+            h.sync()
+
+        t = threading.Thread(target=drive)
+        t.start()
+        seen = []
+        job = client.wait_for_job("test-job", timeout_seconds=10,
+                                  polling_interval=0.05,
+                                  status_callback=lambda j: seen.append(j))
+        t.join()
+        assert any(cond.type == c.JOB_SUCCEEDED and cond.status == "True"
+                   for cond in job.status.conditions)
+        assert seen  # callback observed polls
+
+    def test_wait_timeout_raises(self):
+        h = Harness()
+        client = make_client(h)
+        client.create(new_tpujob())
+        with pytest.raises(TimeoutError, match="Timeout waiting for TPUJob"):
+            client.wait_for_job("test-job", timeout_seconds=0.2,
+                                polling_interval=0.05)
+
+
+class TestPodsAndLogs:
+    def test_get_pod_names_with_filters(self):
+        h = Harness()
+        client = make_client(h)
+        client.create(new_tpujob())
+        h.sync()
+        assert client.get_pod_names("test-job") == [
+            "test-job-master-0", "test-job-worker-0",
+            "test-job-worker-1", "test-job-worker-2",
+        ]
+        assert client.get_pod_names("test-job", replica_type="worker",
+                                    replica_index=1) == ["test-job-worker-1"]
+        assert client.get_pod_names("test-job", replica_type="master") == [
+            "test-job-master-0"]
+
+    def test_get_logs_on_logless_transport(self):
+        h = Harness()
+        client = make_client(h)
+        client.create(new_tpujob())
+        h.sync()
+        logs = client.get_logs("test-job")
+        assert logs == {"test-job-master-0": ""}
+
+
+class TestWatch:
+    def test_watch_renders_transitions_and_stops(self):
+        h = Harness()
+        client = make_client(h)
+        client.create(new_tpujob())
+        h.sync()
+
+        def drive():
+            time.sleep(0.1)
+            h.set_all_phases("test-job", "Running")
+            h.sync()
+            time.sleep(0.1)
+            h.set_all_phases("test-job", "Succeeded")
+            h.sync()
+
+        t = threading.Thread(target=drive)
+        t.start()
+        buf = io.StringIO()
+        job = watch_job(client, "test-job", timeout_seconds=10,
+                        poll_interval=0.03, out=buf)
+        t.join()
+        text = buf.getvalue()
+        assert "NAME" in text and "STATE" in text
+        assert c.JOB_RUNNING in text and c.JOB_SUCCEEDED in text
+        assert any(cond.type == c.JOB_SUCCEEDED for cond in job.status.conditions)

@@ -1,0 +1,11 @@
+"""User-facing Python SDK (the reference's ``sdk/python/kubeflow/pytorchjob``).
+
+``TPUJobClient`` mirrors ``PyTorchJobClient``
+(``api/py_torch_job_client.py:29-393``): create/get/patch/delete,
+wait_for_job / wait_for_condition polling, status predicates, pod-name
+lookup by controller labels, and log retrieval — speaking the typed TPUJob
+objects of ``tpujob.api`` over any transport implementing the ApiServer
+surface (in-memory, HTTP, or a real cluster).
+"""
+from tpujob.sdk.client import TPUJobClient  # noqa: F401
+from tpujob.sdk.watch import watch_job  # noqa: F401

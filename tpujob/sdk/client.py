@@ -1,0 +1,177 @@
+"""TPUJobClient: the SDK entrypoint.
+
+Behavioral mirror of the reference SDK client
+(``sdk/python/kubeflow/pytorchjob/api/py_torch_job_client.py``):
+
+- create/get/patch/delete              (:29-197)
+- wait_for_job / wait_for_condition    (:200-279, poll loop + timeout)
+- get_job_status / is_job_running / is_job_succeeded  (:282-316)
+- get_pod_names / get_logs             (:319-393, label-selector lookup)
+
+Deltas: typed ``TPUJob`` objects instead of raw dicts (dicts accepted on
+create for YAML-manifest workflows), transport injection instead of baked
+kubeconfig handling (in-cluster vs kubeconfig auth lives in the transport
+layer, ``tpujob.kube``), and watch-based waiting as an alternative to
+polling.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from tpujob.api import constants as c
+from tpujob.api.defaults import set_defaults_tpujob
+from tpujob.api.types import TPUJob
+from tpujob.api.validation import validate_tpujob_spec
+from tpujob.kube.client import ClientSet
+from tpujob.kube.errors import NotFoundError
+
+TERMINAL_CONDITIONS = (c.JOB_SUCCEEDED, c.JOB_FAILED)
+
+
+def job_state(job: TPUJob) -> str:
+    """Latest condition with status True ('' when none yet)."""
+    latest = ""
+    for cond in job.status.conditions:
+        if cond.status == "True":
+            latest = cond.type
+    return latest
+
+
+class TPUJobClient:
+    """SDK client over any ApiServer-surface transport.
+
+    ``TPUJobClient(InMemoryAPIServer())`` for tests/simulation,
+    ``TPUJobClient(HTTPApiClient(url))`` for a tpujob API server, or
+    ``TPUJobClient(KubeApiTransport())`` in a real cluster.
+    """
+
+    def __init__(self, transport, namespace: str = "default"):
+        self.clients = ClientSet(transport)
+        self.namespace = namespace
+
+    # -- CRUD (reference :53-197) ------------------------------------------
+
+    def create(self, job: Union[TPUJob, Dict[str, Any]],
+               namespace: Optional[str] = None, validate: bool = True) -> TPUJob:
+        if isinstance(job, dict):
+            job = TPUJob.from_dict(job)
+        if not job.metadata.namespace:
+            job.metadata.namespace = namespace or self.namespace
+        if validate:
+            set_defaults_tpujob(job)
+            errs = validate_tpujob_spec(job.spec)
+            if errs:
+                raise ValueError(f"invalid TPUJob spec: {'; '.join(errs)}")
+        return self.clients.tpujobs.create(job)
+
+    def get(self, name: str, namespace: Optional[str] = None) -> TPUJob:
+        return self.clients.tpujobs.get(namespace or self.namespace, name)
+
+    def patch(self, name: str, patch: Dict[str, Any],
+              namespace: Optional[str] = None) -> TPUJob:
+        return self.clients.tpujobs.patch(namespace or self.namespace, name, patch)
+
+    def delete(self, name: str, namespace: Optional[str] = None) -> None:
+        self.clients.tpujobs.delete(namespace or self.namespace, name)
+
+    # -- waiting (reference :200-279) --------------------------------------
+
+    def wait_for_job(
+        self,
+        name: str,
+        namespace: Optional[str] = None,
+        timeout_seconds: float = 600,
+        polling_interval: float = 1.0,
+        status_callback: Optional[Callable[[TPUJob], None]] = None,
+    ) -> TPUJob:
+        """Block until the job reaches Succeeded or Failed."""
+        return self.wait_for_condition(
+            name, TERMINAL_CONDITIONS, namespace=namespace,
+            timeout_seconds=timeout_seconds, polling_interval=polling_interval,
+            status_callback=status_callback,
+        )
+
+    def wait_for_condition(
+        self,
+        name: str,
+        expected_conditions,
+        namespace: Optional[str] = None,
+        timeout_seconds: float = 600,
+        polling_interval: float = 1.0,
+        status_callback: Optional[Callable[[TPUJob], None]] = None,
+    ) -> TPUJob:
+        """Poll until any expected condition is True (reference :235-279)."""
+        deadline = time.monotonic() + timeout_seconds
+        job = None
+        while time.monotonic() < deadline:
+            try:
+                job = self.get(name, namespace)
+            except NotFoundError:
+                job = None
+            if job is not None:
+                if status_callback:
+                    status_callback(job)
+                for cond in job.status.conditions:
+                    if cond.type in expected_conditions and cond.status == "True":
+                        return job
+            time.sleep(polling_interval)
+        raise TimeoutError(
+            f"Timeout waiting for TPUJob {name} in namespace "
+            f"{namespace or self.namespace} to enter one of the conditions "
+            f"{tuple(expected_conditions)}."
+        )
+
+    # -- status predicates (reference :282-316) ----------------------------
+
+    def get_job_status(self, name: str, namespace: Optional[str] = None) -> str:
+        """Latest True condition type ('' when no status yet)."""
+        return job_state(self.get(name, namespace))
+
+    def is_job_running(self, name: str, namespace: Optional[str] = None) -> bool:
+        return self.get_job_status(name, namespace) == c.JOB_RUNNING
+
+    def is_job_succeeded(self, name: str, namespace: Optional[str] = None) -> bool:
+        return self.get_job_status(name, namespace) == c.JOB_SUCCEEDED
+
+    # -- pods & logs (reference :319-393) ----------------------------------
+
+    def get_pod_names(
+        self,
+        name: str,
+        namespace: Optional[str] = None,
+        replica_type: Optional[str] = None,
+        replica_index: Optional[int] = None,
+    ) -> List[str]:
+        """Pod names by the controller's labels (reference label-selector
+        semantics, utils.py:20-76)."""
+        selector = {c.LABEL_GROUP_NAME: c.GROUP_NAME, c.LABEL_JOB_NAME: name}
+        if replica_type:
+            selector[c.LABEL_REPLICA_TYPE] = replica_type.lower()
+        if replica_index is not None:
+            selector[c.LABEL_REPLICA_INDEX] = str(replica_index)
+        pods = self.clients.pods.list(namespace or self.namespace, selector)
+        return sorted(p.metadata.name for p in pods)
+
+    def get_logs(
+        self,
+        name: str,
+        namespace: Optional[str] = None,
+        replica_type: Optional[str] = "master",
+        replica_index: Optional[int] = None,
+        follow: bool = False,
+    ) -> Dict[str, str]:
+        """{pod_name: log_text} for the selected replica pods.
+
+        Transports without a log endpoint (the in-memory simulator) return
+        pods mapped to empty strings rather than failing, so tooling can
+        run against both.
+        """
+        ns = namespace or self.namespace
+        names = self.get_pod_names(name, ns, replica_type, replica_index)
+        server = self.clients.tpujobs.server
+        out: Dict[str, str] = {}
+        for pod_name in names:
+            reader = getattr(server, "pod_logs", None)
+            out[pod_name] = reader(ns, pod_name, follow=follow) if reader else ""
+        return out

@@ -1,0 +1,47 @@
+"""Watch a TPUJob's lifecycle as a rendered table.
+
+Mirror of ``sdk/python/kubeflow/pytorchjob/api/py_torch_job_watch.py``:
+poll the job, print NAME/STATE/TIME rows on transitions, stop on Succeeded
+or Failed (py_torch_job_watch.py:29-60 renders the k8s watch stream the
+same way; polling keeps this transport-agnostic).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from tpujob.api import constants as c
+from tpujob.api.types import TPUJob
+from tpujob.sdk.client import job_state
+
+TERMINAL = (c.JOB_SUCCEEDED, c.JOB_FAILED)
+_FMT = "{:<32} {:<12} {:<24}"
+
+
+def watch_job(
+    client,
+    name: str,
+    namespace: Optional[str] = None,
+    timeout_seconds: float = 600,
+    poll_interval: float = 0.5,
+    out=None,
+) -> TPUJob:
+    """Print one row per observed state change; return the terminal job."""
+    import sys
+
+    out = out or sys.stdout
+    ns = namespace or client.namespace
+    print(_FMT.format("NAME", "STATE", "TIME"), file=out)
+    deadline = time.monotonic() + timeout_seconds
+    last_state = None
+    job = None
+    while time.monotonic() < deadline:
+        job = client.get(name, ns)
+        state = job_state(job) or "Pending"
+        if state != last_state:
+            print(_FMT.format(name, state, time.strftime("%H:%M:%S")), file=out)
+            last_state = state
+        if state in TERMINAL:
+            return job
+        time.sleep(poll_interval)
+    raise TimeoutError(f"watch timeout for TPUJob {name} in {ns}")
