@@ -1,0 +1,139 @@
+"""Golden tests for the shipped example TPUJob manifests.
+
+The reference ships ready-to-apply job YAMLs (examples/mnist/v1/*.yaml,
+quoted at README.md:22-35); these tests keep ours honest: every manifest in
+examples/**/ must parse into a TPUJob, default, validate (with strict
+topology coherence), reconcile in the in-memory cluster, and produce pods
+whose injected TPU cluster env is globally coherent (unique process ids,
+per-slice hostname lists, one coordinator address).
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+import pytest
+import yaml
+
+from tests.jobtestutil import Harness
+from tpujob.api import constants as c
+from tpujob.api.defaults import set_defaults_tpujob
+from tpujob.api.types import TPUJob
+from tpujob.api.validation import validate_tpujob_spec
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
+MANIFESTS = sorted(glob.glob(os.path.join(EXAMPLES_DIR, "*", "*.yaml")))
+
+
+def _load(path: str) -> TPUJob:
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    job = TPUJob.from_dict(doc)
+    job.metadata.namespace = job.metadata.namespace or "default"
+    return job
+
+
+def test_manifests_exist():
+    """examples/README.md advertises these directories; they must be real."""
+    dirs = {os.path.basename(os.path.dirname(p)) for p in MANIFESTS}
+    assert {"smoke-dist", "mnist", "resnet50", "bert"} <= dirs, (
+        f"missing example manifest directories, found only {dirs}"
+    )
+
+
+@pytest.mark.parametrize("path", MANIFESTS, ids=[os.path.basename(p) for p in MANIFESTS])
+def test_manifest_valid(path):
+    job = _load(path)
+    assert job.kind == c.KIND and job.api_version == c.API_VERSION
+    set_defaults_tpujob(job)
+    errs = validate_tpujob_spec(job.spec, strict_topology=True)
+    assert errs == [], f"{os.path.basename(path)}: {errs}"
+    # round-trip stability: to_dict(from_dict(x)) is a fixed point
+    assert TPUJob.from_dict(job.to_dict()).to_dict() == job.to_dict()
+
+
+@pytest.mark.parametrize("path", MANIFESTS, ids=[os.path.basename(p) for p in MANIFESTS])
+def test_manifest_reconciles_with_coherent_env(path):
+    job = _load(path)
+    set_defaults_tpujob(job)
+    h = Harness()
+    h.submit(job)
+    h.sync()
+
+    specs = job.spec.tpu_replica_specs
+    expected = sum(r.replicas or 1 for r in specs.values())
+    pods = list(h.clients.pods.list("default"))
+    assert len(pods) == expected, (
+        f"{os.path.basename(path)}: expected {expected} pods, got "
+        f"{sorted(p.metadata.name for p in pods)}"
+    )
+
+    tpu = next(
+        (r.tpu for r in specs.values() if r.tpu and r.tpu.accelerator), None
+    )
+    envs = {}
+    for pod in pods:
+        managed = [x for x in pod.spec.containers if x.name == c.DEFAULT_CONTAINER_NAME]
+        assert managed, f"pod {pod.metadata.name} lost its managed container"
+        envs[pod.metadata.name] = {e.name: e.value for e in managed[0].env}
+
+    # process ids are a permutation of 0..N-1 and WORLD_SIZE agrees everywhere
+    pids = sorted(int(e["TPUJOB_PROCESS_ID"]) for e in envs.values())
+    if tpu is not None:
+        topo = tpu.resolve()
+        world = topo.num_processes
+        assert pids == list(range(world))
+    else:
+        world = expected
+        assert pids == list(range(world))
+    for name, e in envs.items():
+        assert int(e["TPUJOB_NUM_PROCESSES"]) == world, name
+        assert int(e["WORLD_SIZE"]) == world, name
+        assert e["PYTHONUNBUFFERED"] == "1", name
+
+    # one coordinator: process 0 sees itself as localhost, everyone else
+    # dials the same headless-service DNS name with the same port
+    coord_addrs = set()
+    for name, e in envs.items():
+        if int(e["TPUJOB_PROCESS_ID"]) == 0:
+            assert e["MASTER_ADDR"] == "localhost", name
+        else:
+            coord_addrs.add(e["TPUJOB_COORDINATOR_ADDRESS"])
+    assert len(coord_addrs) <= 1, f"workers disagree on coordinator: {coord_addrs}"
+
+    if tpu is None:
+        return
+    # libtpu per-slice contract: within a slice, every host lists the same
+    # hostnames in the same order and TPU_WORKER_ID is its index in that list
+    topo = tpu.resolve()
+    by_slice = {}
+    for name, e in envs.items():
+        assert e["PJRT_DEVICE"] == "TPU", name
+        assert e["TPU_ACCELERATOR_TYPE"] == topo.accelerator, name
+        assert e["TPU_TOPOLOGY"] == topo.topology, name
+        sid = int(e["TPUJOB_SLICE_ID"])
+        hosts = e["TPU_WORKER_HOSTNAMES"].split(",")
+        by_slice.setdefault(sid, set()).add(e["TPU_WORKER_HOSTNAMES"])
+        assert len(hosts) == topo.hosts, name
+        assert hosts[int(e["TPU_WORKER_ID"])] == name, (
+            f"{name}: TPU_WORKER_ID={e['TPU_WORKER_ID']} does not index its "
+            f"own hostname in {hosts}"
+        )
+    assert sorted(by_slice) == list(range(topo.num_slices))
+    for sid, lists in by_slice.items():
+        assert len(lists) == 1, f"slice {sid} hosts disagree on TPU_WORKER_HOSTNAMES"
+
+    if topo.num_slices > 1:
+        for name, e in envs.items():
+            assert int(e["MEGASCALE_NUM_SLICES"]) == topo.num_slices, name
+            assert e["MEGASCALE_COORDINATOR_ADDRESS"], name
+    else:
+        assert all("MEGASCALE_NUM_SLICES" not in e for e in envs.values())
+
+    # scheduling: every pod requests the host's chips and pins node selectors
+    for pod in pods:
+        managed = [x for x in pod.spec.containers if x.name == c.DEFAULT_CONTAINER_NAME][0]
+        assert str(managed.resources.limits.get(c.TPU_RESOURCE)) == str(topo.chips_per_host), (
+            pod.metadata.name
+        )
+        assert pod.spec.node_selector.get(c.TPU_ACCELERATOR_NODE_SELECTOR) == topo.accelerator
