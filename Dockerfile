@@ -1,0 +1,31 @@
+# Operator image: builds tpujob/operator:latest referenced by
+# manifests/base/deployment.yaml (reference: /root/reference/Dockerfile:1-16,
+# a 2-stage golang -> ubi8 build of the operator binary; here the compiled
+# artifact is the native controller kernel, built in a toolchain stage and
+# copied into a slim runtime image with the Python operator).
+#
+#   docker build -t tpujob/operator:latest .
+
+FROM python:3.12-slim AS build-image
+
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+ADD native/ /src/native/
+WORKDIR /src
+RUN make -C native TARGET=/src/libtpujob_native.so
+
+FROM python:3.12-slim
+
+# Runtime deps: the kubernetes client backs --apiserver=kube
+# (tpujob/kube/kubetransport.py); pyyaml parses manifests in the SDK.  The
+# control plane itself is stdlib-only.
+RUN pip install --no-cache-dir pyyaml kubernetes
+
+COPY tpujob/ /app/tpujob/
+COPY --from=build-image /src/libtpujob_native.so /app/tpujob/runtime/libtpujob_native.so
+
+WORKDIR /app
+ENV PYTHONPATH=/app PYTHONUNBUFFERED=1
+
+ENTRYPOINT ["python", "-m", "tpujob.server", "--apiserver=kube"]
