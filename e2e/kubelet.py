@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from tpujob.api import constants as c
 from tpujob.kube.client import ClientSet
@@ -25,11 +25,18 @@ class PodScript:
 
     ``exit_codes`` are consumed one per completion: nonzero makes the pod
     Fail with that code, 0 (or exhaustion) makes it Succeed.
+
+    ``exec_fn`` makes the pod run a REAL in-process workload instead of the
+    timer: called as ``exec_fn(attempt)`` on a worker thread (attempt counts
+    pod recreations, 0-based) and its return value becomes the container
+    exit code — the hermetic stand-in for the reference CI's real training
+    containers on EKS.
     """
 
     match: str
     run_seconds: float = 0.05
     exit_codes: List[int] = field(default_factory=list)
+    exec_fn: Optional[Callable[[int], int]] = None
 
 
 class KubeletSim:
@@ -48,6 +55,8 @@ class KubeletSim:
         self.auto_succeed = auto_succeed
         self._started: Dict[str, float] = {}  # uid -> time Running began
         self._consumed: Dict[str, int] = {}  # script match -> codes used
+        self._attempts: Dict[str, int] = {}  # pod name -> exec attempts
+        self._exec_threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -63,6 +72,8 @@ class KubeletSim:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2)
+        for t in self._exec_threads:
+            t.join(timeout=30)
 
     # -- behavior -----------------------------------------------------------
 
@@ -96,6 +107,53 @@ class KubeletSim:
     def _restart_count(self, pod: Pod) -> int:
         return sum(cs.restart_count for cs in pod.status.container_statuses)
 
+    def _spawn_exec(self, pod: Pod, script: PodScript) -> None:
+        """Launch one container lifetime of the scripted in-process workload.
+        The attempt counter is per pod NAME: recreations of the same pod
+        (and in-place container restarts) advance it; sibling replicas
+        matching the same script each start at attempt 0."""
+        attempt = self._attempts.get(pod.metadata.name, 0)
+        self._attempts[pod.metadata.name] = attempt + 1
+        t = threading.Thread(
+            target=self._run_exec, args=(pod, script, attempt),
+            daemon=True, name=f"kubelet-exec-{pod.metadata.name}",
+        )
+        self._exec_threads.append(t)
+        t.start()
+
+    def _run_exec(self, pod: Pod, script: PodScript, attempt: int) -> None:
+        """Run the scripted in-process workload and report its exit code as
+        the pod's terminal phase (like a container process finishing).
+        Mirrors the timer path's kubelet semantics: a nonzero exit under
+        restartPolicy Always/OnFailure restarts the container in place."""
+        try:
+            code = script.exec_fn(attempt)
+        except Exception:  # workload crash == container exit 1
+            import traceback
+
+            traceback.print_exc()
+            code = 1
+        try:
+            current = self.clients.pods.get(
+                pod.metadata.namespace or "default", pod.metadata.name
+            )
+        except NotFoundError:
+            return  # pod deleted while the workload ran (preempted mid-run)
+        if (current.metadata.uid or current.metadata.name) != (
+            pod.metadata.uid or pod.metadata.name
+        ):
+            return  # a recreated pod owns the name now
+        if code != 0 and current.spec.restart_policy in ("Always", "OnFailure"):
+            # kubelet restarts the container itself; restartCount++
+            self._set_status(current, "Running", None,
+                             self._restart_count(current) + 1)
+            self._spawn_exec(current, script)
+            return
+        self._set_status(
+            current, "Failed" if code != 0 else "Succeeded", code,
+            self._restart_count(current),
+        )
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
@@ -115,7 +173,11 @@ class KubeletSim:
                     self._started[uid] = now
                     self._set_status(pod, "Running", None,
                                      self._restart_count(pod))
+                    if script and script.exec_fn:
+                        self._spawn_exec(pod, script)
                     continue
+                if script and script.exec_fn:
+                    continue  # completion is driven by the exec thread
                 if self.auto_succeed and now - self._started[uid] >= run_for:
                     code = self._next_exit_code(script) if script else 0
                     in_place_restart = (

@@ -95,3 +95,12 @@ def test_exitcode_policy_permanent_fails_job():
             "doomed", (c.JOB_FAILED,), timeout_seconds=30, polling_interval=0.05)
         assert any(cond.type == c.JOB_FAILED and cond.status == "True"
                    for cond in got.status.conditions)
+
+
+def test_bert_preemption_resume():
+    """Operator-level preemption→resume (BASELINE.md row 5): a checkpointing
+    BERT job's worker is SIGKILLed mid-run (exit 137), the operator recreates
+    the pod, and the fresh container resumes from the orbax checkpoint."""
+    from e2e.preemption import run_preemption_resume
+
+    run_preemption_resume()
