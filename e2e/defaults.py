@@ -62,6 +62,11 @@ def run_single(cluster: E2ECluster, name: str = "smoke-defaults",
     pods = sdk.get_pod_names(name)
     assert pods == expected_pods(name, workers), (pods, expected_pods(name, workers))
 
+    # container logs are retrievable through the SDK (the simulated kubelet
+    # streams lifecycle lines into the API server's log store)
+    logs = sdk.get_logs(name, replica_type="master")
+    assert logs and all(text for text in logs.values()), logs
+
     # delete -> owned pods/services garbage-collected (defaults.go:172-189)
     sdk.delete(name)
     deadline = time.monotonic() + 10

@@ -102,7 +102,17 @@ class KubeletSim:
         try:
             self.clients.pods.update_status(pod)
         except (ConflictError, NotFoundError):
-            pass  # raced with controller delete/update; next poll re-reads
+            return  # raced with controller delete/update; next poll re-reads
+        # emit container output into the API server's log store so SDK
+        # get_logs has something real to read (a real kubelet streams the
+        # container's stdout; the simulator logs its lifecycle)
+        append = getattr(self.clients.pods.server, "append_pod_logs", None)
+        if append:
+            line = f"{pod.metadata.name}: phase={phase}"
+            if exit_code is not None:
+                line += f" exit_code={exit_code}"
+            append(pod.metadata.namespace or "default", pod.metadata.name,
+                   line + "\n")
 
     def _restart_count(self, pod: Pod) -> int:
         return sum(cs.restart_count for cs in pod.status.container_statuses)

@@ -126,13 +126,35 @@ class TestPodsAndLogs:
         assert client.get_pod_names("test-job", replica_type="master") == [
             "test-job-master-0"]
 
-    def test_get_logs_on_logless_transport(self):
+    def test_get_logs_reads_log_store(self):
+        """SDK logs plumb through the transport's pod_logs endpoint (the
+        read_namespaced_pod_log analog, py_torch_job_client.py:319-393)."""
         h = Harness()
         client = make_client(h)
         client.create(new_tpujob())
         h.sync()
-        logs = client.get_logs("test-job")
-        assert logs == {"test-job-master-0": ""}
+        assert client.get_logs("test-job") == {"test-job-master-0": ""}
+        h.server.append_pod_logs("default", "test-job-master-0", "epoch 1 done\n")
+        assert client.get_logs("test-job") == {
+            "test-job-master-0": "epoch 1 done\n"}
+
+    def test_get_logs_warns_without_endpoint(self, caplog):
+        """A transport lacking pod_logs yields empty strings but WARNS —
+        blank output must not masquerade as empty logs (ADVICE r1)."""
+        import logging
+
+        from tpujob.kube.memserver import InMemoryAPIServer
+
+        class LoglessTransport(InMemoryAPIServer):
+            pod_logs = None  # simulates a transport without the endpoint
+
+        server = LoglessTransport()
+        client = TPUJobClient(server)
+        client.create(new_tpujob())
+        with caplog.at_level(logging.WARNING, logger="tpujob.sdk"):
+            logs = client.get_logs("test-job")
+        assert logs == {}  # no controller ran, so no pods — but the warning fired
+        assert any("no pod_logs endpoint" in r.message for r in caplog.records)
 
 
 class TestWatch:

@@ -244,6 +244,37 @@ class KubeApiTransport:
         except k8s_client.ApiException as e:
             raise _map_api_error(e)
 
+    def pod_logs(
+        self,
+        namespace: str,
+        name: str,
+        follow: bool = False,
+        container: str = c.DEFAULT_CONTAINER_NAME,
+        tail_lines: Optional[int] = None,
+    ) -> str:
+        """Read (or follow to completion) one pod's managed-container logs.
+
+        The ``read_namespaced_pod_log`` path of the reference SDK
+        (``py_torch_job_client.py:319-393``); ``follow=True`` streams until
+        the container terminates and returns the accumulated text.
+        """
+        ns = namespace or self.namespace
+        try:
+            if not follow:
+                return self.core.read_namespaced_pod_log(
+                    name, ns, container=container, tail_lines=tail_lines
+                )
+            lines: List[str] = []
+            w = k8s_watch.Watch()
+            for line in w.stream(
+                self.core.read_namespaced_pod_log,
+                name=name, namespace=ns, container=container,
+            ):
+                lines.append(line)
+            return "\n".join(lines) + ("\n" if lines else "")
+        except k8s_client.ApiException as e:
+            raise _map_api_error(e)
+
     def watch(self, resource: Optional[str] = None, send_initial: bool = False):
         if resource in _CUSTOM:
             group, version = _CUSTOM[resource]

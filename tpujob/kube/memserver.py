@@ -102,6 +102,19 @@ class InMemoryAPIServer:
         self._enable_gc = enable_gc
         # hooks: callables invoked (event_type, resource, obj_dict) after commit
         self.hooks: List[Callable[[str, str, Dict[str, Any]], None]] = []
+        # pod log store: (ns, pod_name) -> text, fed by the simulated kubelet
+        self._pod_logs: Dict[Tuple[str, str], str] = {}
+
+    # -- pod logs (the read_namespaced_pod_log analog) -----------------------
+
+    def append_pod_logs(self, namespace: str, name: str, text: str) -> None:
+        with self._lock:
+            key = (namespace or "default", name)
+            self._pod_logs[key] = self._pod_logs.get(key, "") + text
+
+    def pod_logs(self, namespace: str, name: str, follow: bool = False) -> str:
+        with self._lock:
+            return self._pod_logs.get((namespace or "default", name), "")
 
     # -- internals ----------------------------------------------------------
 

@@ -163,15 +163,25 @@ class TPUJobClient:
     ) -> Dict[str, str]:
         """{pod_name: log_text} for the selected replica pods.
 
-        Transports without a log endpoint (the in-memory simulator) return
-        pods mapped to empty strings rather than failing, so tooling can
-        run against both.
+        Reads through the transport's ``pod_logs`` endpoint
+        (``KubeApiTransport.pod_logs`` → ``read_namespaced_pod_log`` on a
+        real cluster; the in-memory simulator's log store in tests).  A
+        transport without the endpoint returns empty strings but warns, so
+        a silent blank result can't masquerade as empty logs (reference
+        surfaces log-read errors, ``py_torch_job_client.py:319-393``).
         """
         ns = namespace or self.namespace
         names = self.get_pod_names(name, ns, replica_type, replica_index)
         server = self.clients.tpujobs.server
+        reader = getattr(server, "pod_logs", None)
+        if reader is None:
+            import logging
+
+            logging.getLogger("tpujob.sdk").warning(
+                "transport %s has no pod_logs endpoint; get_logs returns "
+                "empty strings", type(server).__name__,
+            )
         out: Dict[str, str] = {}
         for pod_name in names:
-            reader = getattr(server, "pod_logs", None)
             out[pod_name] = reader(ns, pod_name, follow=follow) if reader else ""
         return out
