@@ -1,0 +1,87 @@
+"""Per-job structured logging (reference logger.go:26-79 parity)."""
+import json
+import logging
+
+from jobtestutil import Harness, new_tpujob
+from tpujob.controller.joblogger import (
+    JsonFieldsFormatter,
+    TextFieldsFormatter,
+    logger_for_job,
+    logger_for_pod,
+    logger_for_replica,
+    logger_for_unstructured,
+)
+
+
+def _capture(adapter, msg, *args):
+    records = []
+
+    class Sink(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    sink = Sink()
+    adapter.logger.addHandler(sink)
+    adapter.logger.setLevel(logging.INFO)
+    try:
+        adapter.info(msg, *args)
+    finally:
+        adapter.logger.removeHandler(sink)
+    return records[0]
+
+
+def test_logger_for_job_tags_job_and_uid():
+    job = new_tpujob(name="tagged")
+    job.metadata.uid = "uid-123"
+    rec = _capture(logger_for_job(logging.getLogger("t1"), job), "hello %d", 7)
+    assert rec.fields == {"job": "default/tagged", "uid": "uid-123"}
+    assert rec.getMessage() == "hello 7"
+
+
+def test_logger_for_replica_and_pod_extend_fields():
+    job = new_tpujob(name="tagged")
+    rec = _capture(logger_for_replica(logging.getLogger("t2"), job, "Worker"), "m")
+    assert rec.fields["replica_type"] == "Worker"
+
+    h = Harness()
+    h.submit(job)
+    h.sync()
+    pod = h.clients.pods.get("default", "tagged-worker-0")
+    rec = _capture(logger_for_pod(logging.getLogger("t3"), pod, job), "m")
+    assert rec.fields["pod"] == "default/tagged-worker-0"
+    assert rec.fields["job"] == "default/tagged"
+
+
+def test_logger_for_unstructured_survives_malformed():
+    rec = _capture(
+        logger_for_unstructured(
+            logging.getLogger("t4"), {"metadata": {"name": "broken"}}
+        ),
+        "invalid",
+    )
+    assert rec.fields == {"job": "default/broken"}
+
+
+def test_formatters_render_fields():
+    job = new_tpujob(name="fmt")
+    job.metadata.uid = "u1"
+    rec = _capture(logger_for_job(logging.getLogger("t5"), job), "syncing")
+    text = TextFieldsFormatter().format(rec)
+    assert "syncing (job=default/fmt uid=u1)" in text
+    parsed = json.loads(JsonFieldsFormatter().format(rec))
+    assert parsed["msg"] == "syncing"
+    assert parsed["job"] == "default/fmt"
+    assert parsed["uid"] == "u1"
+
+
+def test_reconciler_tags_malformed_job_logs(caplog):
+    """The reconcile path emits tagged records (logger.go integration)."""
+    h = Harness()
+    bad = new_tpujob(name="badjob")
+    bad.spec.tpu_replica_specs["Master"].template.spec.containers = []
+    with caplog.at_level(logging.WARNING, logger="tpujob.reconciler"):
+        h.submit(bad)
+        h.sync()
+    tagged = [r for r in caplog.records
+              if getattr(r, "fields", {}).get("job") == "default/badjob"]
+    assert tagged, "no job-tagged reconcile log records"

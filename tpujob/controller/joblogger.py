@@ -1,0 +1,124 @@
+"""Per-job structured logging.
+
+Mirrors the reference's context-tagged loggers
+(``vendor/github.com/kubeflow/tf-operator/pkg/logger/logger.go:26-79``:
+``LoggerForJob/Replica/Pod/Key/Unstructured``): every reconcile log line
+carries job / uid / replica-type / pod fields so operators can grep one
+job out of a many-jobs controller log.
+
+Fields ride on the ``LogRecord`` as ``record.fields`` (a dict); the
+formatters below render them for both text and JSON output.  Loggers are
+cheap adapters — build them per call site, don't cache.
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, Optional
+
+
+class FieldsAdapter(logging.LoggerAdapter):
+    """LoggerAdapter carrying structured fields (logrus ``WithFields``)."""
+
+    def process(self, msg, kwargs):
+        extra = dict(kwargs.get("extra") or {})
+        fields = dict(self.extra)
+        fields.update(extra.get("fields") or {})
+        extra["fields"] = fields
+        kwargs["extra"] = extra
+        return msg, kwargs
+
+    def with_fields(self, **fields) -> "FieldsAdapter":
+        merged = dict(self.extra)
+        merged.update(fields)
+        return FieldsAdapter(self.logger, merged)
+
+
+def logger_for_key(logger: logging.Logger, key: str) -> FieldsAdapter:
+    """logger.go:67-73 (LoggerForKey)."""
+    return FieldsAdapter(logger, {"job": key})
+
+
+def logger_for_job(logger: logging.Logger, job) -> FieldsAdapter:
+    """logger.go:26-33 (LoggerForJob): job=ns/name + uid."""
+    fields: Dict[str, Any] = {"job": job.key}
+    if job.metadata.uid:
+        fields["uid"] = job.metadata.uid
+    return FieldsAdapter(logger, fields)
+
+
+def logger_for_replica(logger: logging.Logger, job, rtype: str) -> FieldsAdapter:
+    """logger.go:35-44 (LoggerForReplica)."""
+    return logger_for_job(logger, job).with_fields(replica_type=rtype)
+
+
+def logger_for_pod(logger: logging.Logger, pod, job=None) -> FieldsAdapter:
+    """logger.go:46-56 (LoggerForPod)."""
+    ns = pod.metadata.namespace or "default"
+    fields: Dict[str, Any] = {"pod": f"{ns}/{pod.metadata.name}"}
+    if pod.metadata.uid:
+        fields["pod_uid"] = pod.metadata.uid
+    base = logger_for_job(logger, job) if job is not None else FieldsAdapter(logger, {})
+    return base.with_fields(**fields)
+
+
+def logger_for_unstructured(logger: logging.Logger, obj: Dict[str, Any]) -> FieldsAdapter:
+    """logger.go:75-79 (LoggerForUnstructured): raw dict before conversion."""
+    meta = obj.get("metadata") or {}
+    ns = meta.get("namespace") or "default"
+    fields: Dict[str, Any] = {"job": f"{ns}/{meta.get('name')}"}
+    if meta.get("uid"):
+        fields["uid"] = meta["uid"]
+    return FieldsAdapter(logger, fields)
+
+
+# ---------------------------------------------------------------------------
+# Formatters rendering record.fields (wired by tpujob.server.app)
+# ---------------------------------------------------------------------------
+
+
+class TextFieldsFormatter(logging.Formatter):
+    """Plain text with a logfmt-style field suffix: ``msg (job=ns/n uid=..)``."""
+
+    def __init__(self):
+        super().__init__("%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = super().format(record)
+        fields = getattr(record, "fields", None)
+        if fields:
+            out += " (" + " ".join(f"{k}={v}" for k, v in fields.items()) + ")"
+        return out
+
+
+class JsonFieldsFormatter(logging.Formatter):
+    """One JSON object per line with the fields inlined (the reference's
+    logrus JSON format for Stackdriver, main.go:42-58)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out: Dict[str, Any] = {
+            "time": self.formatTime(record),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        out.update(getattr(record, "fields", None) or {})
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+def configure_root_logging(json_format: bool, level: int = logging.INFO) -> None:
+    """Install the fields-aware formatter on the root logger (idempotent)."""
+    root = logging.getLogger()
+    root.setLevel(level)
+    formatter: logging.Formatter = (
+        JsonFieldsFormatter() if json_format else TextFieldsFormatter()
+    )
+    if root.handlers:
+        for h in root.handlers:
+            h.setFormatter(formatter)
+    else:
+        h = logging.StreamHandler()
+        h.setFormatter(formatter)
+        root.addHandler(h)

@@ -25,6 +25,13 @@ from tpujob.api.validation import validate_tpujob_spec
 from tpujob.controller import status as st
 from tpujob.controller import tpu_env
 from tpujob.controller.config import render_init_containers
+from tpujob.controller.joblogger import (
+    logger_for_job,
+    logger_for_key,
+    logger_for_pod,
+    logger_for_replica,
+    logger_for_unstructured,
+)
 from tpujob.controller.job_base import JobController, expectation_key
 from tpujob.kube.client import RESOURCE_TPUJOBS
 from tpujob.kube.control import gen_general_name, gen_labels, gen_pod_group_name
@@ -125,7 +132,7 @@ class TPUJobController(JobController):
     def _fail_malformed(self, obj: Dict, errs: List[str]) -> None:
         meta = obj.get("metadata") or {}
         ns, name = meta.get("namespace") or "default", meta.get("name")
-        log.warning("invalid TPUJob %s/%s: %s", ns, name, errs)
+        logger_for_unstructured(log, obj).warning("invalid TPUJob: %s", errs)
         # write back through the raw transport: the typed client would choke
         # on the very malformation we are reporting (job.go:60-111 uses the
         # raw CRD REST client for the same reason)
@@ -154,7 +161,7 @@ class TPUJobController(JobController):
         ns, _, name = key.partition("/")
         cached = self.job_informer.store.get(ns, name)
         if cached is None:
-            log.info("job %s no longer exists", key)
+            logger_for_key(log, key).info("job no longer exists")
             return True
         try:
             job = TPUJob.from_dict(cached)
@@ -238,8 +245,8 @@ class TPUJobController(JobController):
         for index in range(replicas):
             pod_slice = slices[index]
             if len(pod_slice) > 1:
-                log.warning("job %s has %d %s pods with index %d",
-                            job.key, len(pod_slice), rtype, index)
+                logger_for_replica(log, job, rtype).warning(
+                    "%d pods share index %d", len(pod_slice), index)
                 continue
             if not pod_slice:
                 self._create_new_pod(job, rtype, rspec, index)
@@ -249,8 +256,8 @@ class TPUJobController(JobController):
             if pod.status.phase == "Failed" and rspec.restart_policy == c.RESTART_POLICY_EXIT_CODE:
                 code = self._managed_exit_code(pod)
                 if code is not None and is_retryable_exit_code(code):
-                    log.info("pod %s exited with retryable code %d; restarting",
-                             pod.metadata.name, code)
+                    logger_for_pod(log, pod, job).info(
+                        "exited with retryable code %d; restarting", code)
                     self.expectations.expect(
                         expectation_key(job.key, rtype, "pods"), adds=0, dels=1
                     )
@@ -303,8 +310,9 @@ class TPUJobController(JobController):
         if self.config.enable_gang_scheduling:
             # scheduler name + PodGroup annotation (pod.go:200-216)
             if pod.spec.scheduler_name and pod.spec.scheduler_name != self.config.gang_scheduler_name:
-                log.warning("job %s pod %s scheduler %s overridden by gang scheduler %s",
-                            key, name, pod.spec.scheduler_name, self.config.gang_scheduler_name)
+                logger_for_replica(log, job, rtype).warning(
+                    "pod %s scheduler %s overridden by gang scheduler %s",
+                    name, pod.spec.scheduler_name, self.config.gang_scheduler_name)
             pod.spec.scheduler_name = self.config.gang_scheduler_name
             pod.metadata.annotations[c.POD_GROUP_ANNOTATION] = gen_pod_group_name(job.metadata.name)
 
@@ -471,6 +479,7 @@ class TPUJobController(JobController):
         return time.time() - start >= ads
 
     def _fail_job(self, job: TPUJob, old_status, pods, services, message: str) -> bool:
+        logger_for_job(log, job).info(message)
         self._delete_pods_and_services(job, pods, services)
         self.recorder.event(job, "Warning", st.REASON_JOB_FAILED, message)
         if job.status.completion_time is None:

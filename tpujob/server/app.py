@@ -82,12 +82,11 @@ class OperatorApp:
         self.stop_event = threading.Event()
 
     def run(self, block: bool = True) -> None:
-        logging.basicConfig(
-            level=logging.INFO,
-            format='{"time":"%(asctime)s","level":"%(levelname)s","logger":"%(name)s","msg":"%(message)s"}'
-            if self.opt.json_log_format
-            else "%(asctime)s %(levelname)s %(name)s: %(message)s",
-        )
+        # fields-aware formatters: per-job tags from joblogger render in both
+        # text and JSON output (reference logrus setup, main.go:42-58)
+        from tpujob.controller.joblogger import configure_root_logging
+
+        configure_root_logging(self.opt.json_log_format)
         setup_signal_handler(self.stop_event)
         if self.opt.monitoring_port:
             self.monitoring = MonitoringServer(port=self.opt.monitoring_port).start()
