@@ -91,6 +91,12 @@ def test_env_injection_multislice():
     assert wenv["MEGASCALE_NUM_SLICES"] == "2"
     assert wenv["MEGASCALE_SLICE_ID"] == "1"
     assert wenv["TPU_WORKER_ID"] == "1"
+    # contract: the DCN coordinator address is always dialable host:port
+    from tpujob.controller.tpu_env import MEGASCALE_PORT, coordinator_dns
+
+    host, _, port = wenv["MEGASCALE_COORDINATOR_ADDRESS"].rpartition(":")
+    assert port == str(MEGASCALE_PORT)
+    assert host == coordinator_dns(h.get_job())
 
 
 def test_worker_init_container_dns_gate():

@@ -309,6 +309,15 @@ class JobController:
             self.queue.done(key)
         return True
 
+    def resync_all(self) -> int:
+        """Re-enqueue every cached job (the informer resync replay: drift
+        between cluster and desired state heals even if a watch event was
+        lost).  Returns the number of jobs enqueued."""
+        keys = [self.job_key_of(obj) for obj in self.job_informer.store.list()]
+        for key in keys:
+            self.enqueue_job(key)
+        return len(keys)
+
     def run(self, stop_event: threading.Event, threadiness: Optional[int] = None) -> List[threading.Thread]:
         """Start informers + N workers (controller.go:185-213)."""
         self.factory.start(stop_event)
@@ -325,6 +334,20 @@ class JobController:
             threading.Thread(target=worker, daemon=True, name=f"tpujob-worker-{i}")
             for i in range(n)
         ]
+
+        # periodic resync (--resync-period, options.go:62): the reference's
+        # 12h informer resync; <= 0 disables
+        period = self.config.resync_period
+        if period and period > 0:
+
+            def resync_loop():
+                while not stop_event.wait(period):
+                    count = self.resync_all()
+                    log.info("periodic resync: re-enqueued %d jobs", count)
+
+            threads.append(
+                threading.Thread(target=resync_loop, daemon=True, name="tpujob-resync")
+            )
         for t in threads:
             t.start()
         return threads

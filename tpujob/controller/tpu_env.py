@@ -32,6 +32,12 @@ from tpujob.api.types import TPUJob
 from tpujob.kube.control import gen_general_name
 from tpujob.kube.objects import EnvVar, Pod
 
+# The DCN (cross-slice) coordinator port.  Contract: the injected
+# MEGASCALE_COORDINATOR_ADDRESS is always host:port — libtpu defaults the
+# port when absent, but an explicit port keeps the address dialable under
+# any libtpu version and lets the coordinator service expose it by name.
+MEGASCALE_PORT = 8080
+
 
 def coordinator_replica(job: TPUJob) -> str:
     """The replica type hosting process 0: Master, or Worker for
@@ -144,7 +150,7 @@ def cluster_env(
         "PYTHONUNBUFFERED": "1",
     }
     if topo.num_slices > 1:
-        env["MEGASCALE_COORDINATOR_ADDRESS"] = coordinator_dns(job)
+        env["MEGASCALE_COORDINATOR_ADDRESS"] = f"{coordinator_dns(job)}:{MEGASCALE_PORT}"
         env["MEGASCALE_NUM_SLICES"] = str(topo.num_slices)
         env["MEGASCALE_SLICE_ID"] = str(slice_id)
     return env

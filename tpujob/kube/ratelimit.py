@@ -1,0 +1,70 @@
+"""Client-side API rate limiting: the client-go ``rest.Config{QPS, Burst}``
+analog behind the reference's ``--kube-api-qps``/``--kube-api-burst`` flags
+(``options.go:54-84``).
+
+``RateLimitedTransport`` wraps any ApiServer-surface transport and gates
+every API verb through a token bucket; watches stream outside the bucket
+(client-go likewise exempts long-running requests).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+
+class TokenBucket:
+    """Standard token bucket: ``qps`` refill rate, ``burst`` capacity."""
+
+    def __init__(self, qps: float, burst: int):
+        if qps <= 0:
+            raise ValueError(f"qps must be > 0, got {qps}")
+        self.qps = qps
+        self.burst = max(1, burst)
+        self._tokens = float(self.burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def acquire(self) -> float:
+        """Take one token, sleeping until available; returns seconds waited."""
+        waited = 0.0
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._tokens = min(
+                    float(self.burst), self._tokens + (now - self._last) * self.qps
+                )
+                self._last = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return waited
+                need = (1.0 - self._tokens) / self.qps
+            time.sleep(need)
+            waited += need
+
+
+class RateLimitedTransport:
+    """Proxy applying a shared token bucket to the API verbs of a transport.
+
+    Everything else (watch, hooks, pod_logs, helper attributes) passes
+    through untouched.
+    """
+
+    _LIMITED = frozenset(
+        {"create", "get", "list", "update", "update_status", "patch", "delete"}
+    )
+
+    def __init__(self, transport, qps: float, burst: int):
+        self._transport = transport
+        self.bucket = TokenBucket(qps, burst)
+
+    def __getattr__(self, name):
+        attr = getattr(self._transport, name)
+        if name in self._LIMITED and callable(attr):
+            bucket = self.bucket
+
+            def limited(*args, **kwargs):
+                bucket.acquire()
+                return attr(*args, **kwargs)
+
+            return limited
+        return attr

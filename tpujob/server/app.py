@@ -39,11 +39,22 @@ def build_transport(opt: ServerOption):
             )
         from tpujob.kube.kubetransport import KubeApiTransport  # noqa: PLC0415
 
-        return KubeApiTransport(namespace=opt.namespace or None)
+        return _maybe_rate_limit(KubeApiTransport(namespace=opt.namespace or None), opt)
     client = HTTPApiClient(opt.apiserver)
     if not client.healthy():
         raise SystemExit(f"cannot reach tpujob API server at {opt.apiserver}")
-    return client
+    return _maybe_rate_limit(client, opt)
+
+
+def _maybe_rate_limit(transport, opt: ServerOption):
+    """Apply --kube-api-qps/--kube-api-burst to real API transports
+    (client-go rest.Config QPS/Burst semantics, options.go:54-84).  The
+    in-process simulator has no API server to protect and stays unwrapped."""
+    if opt.qps and opt.qps > 0:
+        from tpujob.kube.ratelimit import RateLimitedTransport
+
+        return RateLimitedTransport(transport, opt.qps, opt.burst)
+    return transport
 
 
 def setup_signal_handler(stop_event: threading.Event) -> None:
@@ -72,6 +83,7 @@ class OperatorApp:
             self.clients,
             config=ControllerConfig(
                 threadiness=opt.threadiness,
+                resync_period=opt.resync_period_s,
                 enable_gang_scheduling=opt.enable_gang_scheduling,
                 gang_scheduler_name=opt.gang_scheduler_name,
                 init_container_image=opt.init_container_image,
