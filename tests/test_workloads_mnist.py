@@ -43,6 +43,41 @@ class TestData:
         b2 = next(datalib.batches(x, y, 64, seed=2))[1]
         assert not np.array_equal(b1, b2)
 
+    def test_digits_is_real_offline_data(self):
+        """The UCI digits set: MNIST-shaped, 10 classes, disjoint splits."""
+        tx, ty, vx, vy = datalib.digits_datasets()
+        assert tx.shape[1:] == (28, 28, 1) and vx.shape[1:] == (28, 28, 1)
+        assert len(tx) + len(vx) == 1797  # the full real dataset
+        assert set(np.unique(ty)) == set(range(10))
+        assert len(vx) >= 64
+
+    def test_resolve_dataset_priorities(self, tmp_path):
+        assert datalib.resolve_dataset(None, "auto") == "synthetic"
+        assert datalib.resolve_dataset(str(tmp_path), "auto") == "synthetic"
+        assert datalib.resolve_dataset(None, "digits") == "digits"
+        # an IDX fixture under data_dir flips auto to idx
+        import gzip
+        import struct
+
+        raw = tmp_path / "train-images-idx3-ubyte.gz"
+        with gzip.open(raw, "wb") as f:
+            f.write(struct.pack(">HBB", 0, 8, 3) + struct.pack(">III", 1, 28, 28)
+                    + bytes(28 * 28))
+        assert datalib.resolve_dataset(str(tmp_path), "auto") == "idx"
+
+
+class TestDigitsTraining:
+    def test_mnist_learns_real_digits(self, tmp_path):
+        """Accuracy-parity gate on REAL data (the bench.py gate path): the
+        reference CNN learns the UCI handwritten digits to >0.8."""
+        args = mnist.build_parser().parse_args(
+            ["--dataset", "digits", "--epochs", "6",
+             "--dir", str(tmp_path / "logs")]
+        )
+        result = mnist.run(args)
+        assert result["dataset"] == "digits"
+        assert result["accuracy"] > 0.8, result["accuracy"]
+
 
 class TestModel:
     def test_net_shapes_match_reference(self):

@@ -63,30 +63,76 @@ def synthetic_split(
     return images[..., None], labels
 
 
+def _load_idx_splits(data_dir: str):
+    ti = _find_idx(data_dir, "train-images-idx3-ubyte")
+    tl = _find_idx(data_dir, "train-labels-idx1-ubyte")
+    vi = _find_idx(data_dir, "t10k-images-idx3-ubyte")
+    vl = _find_idx(data_dir, "t10k-labels-idx1-ubyte")
+    if not (ti and tl and vi and vl):
+        return None
+    tx = _read_idx(ti).astype(np.float32)[..., None] / 255.0
+    vx = _read_idx(vi).astype(np.float32)[..., None] / 255.0
+    ty = _read_idx(tl).astype(np.int32)
+    vy = _read_idx(vl).astype(np.int32)
+    return (tx - MEAN) / STD, ty, (vx - MEAN) / STD, vy
+
+
+def digits_datasets(
+    train_size: int = 1500,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """The sklearn/UCI handwritten-digits set: REAL handwritten digit images
+    available offline (1,797 samples).  8x8 greyscale, upscaled 3x and
+    padded to 28x28 so the reference CNN runs unchanged.  The offline
+    stand-in for the FashionMNIST accuracy-parity gate
+    (``examples/mnist/mnist.py:117-132``) in zero-egress environments.
+    """
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x = d.images.astype(np.float32) / 16.0
+    x = np.repeat(np.repeat(x, 3, axis=1), 3, axis=2)  # 8x8 -> 24x24
+    x = np.pad(x, ((0, 0), (2, 2), (2, 2)))[..., None]  # -> 28x28 NHWC
+    y = d.target.astype(np.int32)
+    idx = np.arange(len(x))
+    np.random.RandomState(0).shuffle(idx)
+    x, y = (x - MEAN) / STD, y
+    train_size = min(train_size, len(x) - 64)  # keep a real test split
+    tr, te = idx[:train_size], idx[train_size:]
+    return x[tr], y[tr], x[te], y[te]
+
+
+def resolve_dataset(data_dir: Optional[str], dataset: str = "auto") -> str:
+    """Which dataset ``mnist_datasets`` will serve: explicit choice, or
+    ``auto`` = IDX files when present under ``data_dir``, else synthetic."""
+    if dataset in ("idx", "digits", "synthetic"):
+        return dataset
+    if data_dir and _find_idx(data_dir, "train-images-idx3-ubyte"):
+        return "idx"
+    return "synthetic"
+
+
 def mnist_datasets(
     data_dir: Optional[str] = None,
     train_size: int = 60000,
     test_size: int = 10000,
+    dataset: str = "auto",
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """(train_x, train_y, test_x, test_y), normalized, NHWC float32.
 
-    Prefers real IDX files under ``data_dir`` (torchvision layout); falls
-    back to the synthetic set.
+    ``dataset``: ``auto`` (IDX files under ``data_dir`` when present, else
+    synthetic), or explicitly ``idx`` / ``digits`` (real, offline) /
+    ``synthetic``.
     """
-    if data_dir:
-        ti = _find_idx(data_dir, "train-images-idx3-ubyte")
-        tl = _find_idx(data_dir, "train-labels-idx1-ubyte")
-        vi = _find_idx(data_dir, "t10k-images-idx3-ubyte")
-        vl = _find_idx(data_dir, "t10k-labels-idx1-ubyte")
-        if ti and tl and vi and vl:
-            tx = _read_idx(ti).astype(np.float32)[..., None] / 255.0
-            vx = _read_idx(vi).astype(np.float32)[..., None] / 255.0
-            ty = _read_idx(tl).astype(np.int32)
-            vy = _read_idx(vl).astype(np.int32)
-            return (
-                (tx - MEAN) / STD, ty,
-                (vx - MEAN) / STD, vy,
+    resolved = resolve_dataset(data_dir, dataset)
+    if resolved == "idx":
+        splits = _load_idx_splits(data_dir) if data_dir else None
+        if splits is None:
+            raise FileNotFoundError(
+                f"dataset 'idx' requested but no IDX files under {data_dir!r}"
             )
+        return splits
+    if resolved == "digits":
+        return digits_datasets(train_size)
     tx, ty = synthetic_split(train_size, seed=0)
     vx, vy = synthetic_split(test_size, seed=1)
     return (tx - MEAN) / STD, ty, (vx - MEAN) / STD, vy

@@ -134,6 +134,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Distributed backend (XLA collectives over ICI/DCN)")
     p.add_argument("--data-dir", default=None,
                    help="IDX dataset dir (torchvision layout); synthetic if absent")
+    p.add_argument("--dataset", choices=["auto", "synthetic", "digits", "idx"],
+                   default="auto",
+                   help="auto = IDX files when present, else synthetic; "
+                        "digits = real offline UCI handwritten digits")
     p.add_argument("--train-size", type=int, default=60000)
     p.add_argument("--test-size", type=int, default=10000)
     return p
@@ -147,8 +151,9 @@ def run(args, mesh=None) -> Dict[str, Any]:
         mesh = dist.make_mesh({"data": -1}, env=pe)
     writer = train_lib.SummaryWriter(args.dir, enabled=pe.process_id == 0)
 
+    dataset = datalib.resolve_dataset(args.data_dir, getattr(args, "dataset", "auto"))
     train_x, train_y, test_x, test_y = datalib.mnist_datasets(
-        args.data_dir, args.train_size, args.test_size
+        args.data_dir, args.train_size, args.test_size, dataset=dataset
     )
     # clamp so a small test set still yields at least one full batch
     # (drop_remainder would otherwise silently produce accuracy=0), rounded
@@ -190,6 +195,7 @@ def run(args, mesh=None) -> Dict[str, Any]:
         "final_loss": last_loss,
         "wall_s": wall,
         "samples": (len(train_x) - len(train_x) % args.batch_size) * args.epochs,
+        "dataset": dataset,
         "state": state,
     }
 
