@@ -25,6 +25,11 @@ RUN pip install --no-cache-dir pyyaml kubernetes
 COPY tpujob/ /app/tpujob/
 COPY --from=build-image /src/libtpujob_native.so /app/tpujob/runtime/libtpujob_native.so
 
+# bake the build SHA for `--version` (version.go:27-40 analog):
+#   docker build --build-arg GIT_SHA=$(git rev-parse --short HEAD) ...
+ARG GIT_SHA=unknown
+ENV TPUJOB_GIT_SHA=$GIT_SHA
+
 WORKDIR /app
 ENV PYTHONPATH=/app PYTHONUNBUFFERED=1
 

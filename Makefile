@@ -1,0 +1,49 @@
+# CI entrypoint: `make ci` reproduces the round's checks end to end,
+# hermetically (the reference assembles the same steps as an Argo DAG on
+# Prow: build -> deploy -> defaults E2E -> SDK tests -> cleanpolicy E2E;
+# test/workflows/components/workflows.libsonnet:292-345).
+
+PY ?= python
+# hermetic JAX config for CPU-only CI hosts (tests/conftest.py sets the
+# same for pytest; exported here for the e2e/bench targets)
+export JAX_PLATFORMS ?= cpu
+export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
+
+.PHONY: ci native lint codegen-verify unit e2e bench-smoke dryrun images clean
+
+ci: native lint codegen-verify unit e2e dryrun
+	@echo "ci: ALL PASSED"
+
+# docs/swagger.json must match the dataclass types (hack/verify-codegen.sh)
+codegen-verify:
+	$(PY) scripts/gen_openapi.py --verify
+
+native:
+	$(MAKE) -C native
+
+lint:
+	$(PY) scripts/lint.py
+
+unit:
+	$(PY) -m pytest tests/ -q
+
+e2e:
+	scripts/run-defaults.sh
+	scripts/run-cleanpodpolicy-all.sh
+	scripts/run-preemption.sh
+
+# driver-contract smoke: the multi-chip sharding dryrun on 8 virtual devices
+dryrun:
+	$(PY) __graft_entry__.py 8
+
+# headline + flagship benchmarks at CI-smoke shapes (slow; not part of `ci`)
+bench-smoke:
+	$(PY) bench.py
+	$(PY) bench_models.py --quick
+
+images:
+	scripts/build_image.sh
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

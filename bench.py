@@ -45,15 +45,19 @@ def main() -> int:
     import contextlib
     import io
 
-    from tpujob.workloads import data as datalib
-
     data_dir = os.environ.get("TPUJOB_MNIST_DIR") or "data"
     if datalib.resolve_dataset(data_dir, "auto") == "idx":
         gate_argv = ["--data-dir", data_dir, "--dataset", "idx", "--epochs", "1"]
     else:
-        # digits is tiny (~1.7k samples); multiple epochs ~ the reference's
-        # 10-epoch training run, still < 2 s
-        gate_argv = ["--dataset", "digits", "--epochs", "10"]
+        try:
+            import sklearn  # noqa: F401 - digits needs scikit-learn
+
+            # digits is tiny (~1.7k samples); multiple epochs ~ the
+            # reference's 10-epoch training run, still < 2 s
+            gate_argv = ["--dataset", "digits", "--epochs", "10"]
+        except ImportError:
+            gate_argv = ["--dataset", "synthetic", "--train-size", "8192",
+                         "--test-size", "2048", "--epochs", "1"]
     acc_args = mnist.build_parser().parse_args(
         gate_argv + ["--dir", "/tmp/tpujob_bench_logs"]
     )

@@ -12,7 +12,7 @@ import sys
 import time
 
 from e2e.cluster import E2ECluster
-from e2e.defaults import expected_pods, smoke_job
+from e2e.defaults import smoke_job
 from tpujob.api import constants as c
 
 

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Build both images (reference build_image.sh / scripts/build.sh):
+# the operator (root Dockerfile -> tpujob/operator, the image
+# manifests/base/deployment.yaml deploys) and the example workloads.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+docker build -t "${OPERATOR_IMAGE:-tpujob/operator:latest}" .
+docker build -f examples/Dockerfile -t "${EXAMPLES_IMAGE:-tpujob/examples:latest}" .

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Generate the TPUJob OpenAPI definitions from the dataclass types.
+
+The reference drives its Python SDK models from generated OpenAPI
+(``hack/python-sdk/main.go`` emits swagger.json from
+``openapi_generated.go``); here the typed dataclasses ARE the source of
+truth, and this tool derives ``docs/swagger.json`` from them by
+introspection — so the documented API surface can never drift from the
+code.  ``--verify`` re-generates and diffs against the committed file
+(the ``hack/verify-codegen.sh`` analog, wired into `make ci`).
+
+Usage:
+    python scripts/gen_openapi.py            # (re)write docs/swagger.json
+    python scripts/gen_openapi.py --verify   # exit 1 on drift
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import typing
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from tpujob.api import constants as c  # noqa: E402
+from tpujob.api import types as api_types  # noqa: E402
+from tpujob.kube import objects as kube_objects  # noqa: E402
+from tpujob.kube.objects import K8sObject  # noqa: E402
+
+OUT_PATH = ROOT / "docs" / "swagger.json"
+GROUP_PREFIX = f"{'.'.join(reversed(c.GROUP_NAME.split('.')))}.{c.VERSION}"  # dev.tpujob.v1
+
+# Roots of the definition graph; referenced types are pulled in transitively.
+ROOT_TYPES = [api_types.TPUJob, api_types.TPUJobList]
+
+
+def _camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _def_name(cls: type) -> str:
+    return f"{GROUP_PREFIX}.{cls.__name__}"
+
+
+def _schema_for(hint, pending: list):
+    """typing hint -> OpenAPI schema fragment (collecting K8sObject refs)."""
+    origin = typing.get_origin(hint)
+    args = typing.get_args(hint)
+    if origin is typing.Union:  # Optional[X]
+        non_none = [a for a in args if a is not type(None)]
+        if len(non_none) == 1:
+            return _schema_for(non_none[0], pending)
+        return {}  # untyped union: preserve as-is
+    if origin in (list, typing.List):
+        return {"type": "array",
+                "items": _schema_for(args[0], pending) if args else {}}
+    if origin in (dict, typing.Dict):
+        return {"type": "object",
+                "additionalProperties": _schema_for(args[1], pending) if args else {}}
+    if isinstance(hint, type) and issubclass(hint, K8sObject):
+        pending.append(hint)
+        return {"$ref": f"#/definitions/{_def_name(hint)}"}
+    if hint is int:
+        return {"type": "integer"}
+    if hint is float:
+        return {"type": "number"}
+    if hint is bool:
+        return {"type": "boolean"}
+    if hint is str:
+        return {"type": "string"}
+    return {}  # Any
+
+
+def _doc_first_line(cls: type) -> str:
+    doc = (cls.__doc__ or "").strip()
+    return doc.splitlines()[0] if doc else ""
+
+
+def generate() -> dict:
+    definitions = {}
+    pending = list(ROOT_TYPES)
+    while pending:
+        cls = pending.pop()
+        name = _def_name(cls)
+        if name in definitions:
+            continue
+        # typing first: its abstract names (e.g. typing.Container) must not
+        # shadow the real object model's classes
+        hints = typing.get_type_hints(
+            cls, vars(typing) | vars(kube_objects) | vars(api_types)
+        )
+        props = {}
+        for f in dataclasses.fields(cls):
+            if f.name == "extra":
+                continue
+            props[_camel(f.name)] = _schema_for(hints.get(f.name, typing.Any), pending)
+        definitions[name] = {
+            "type": "object",
+            "description": _doc_first_line(cls),
+            "properties": props,
+        }
+    return {
+        "swagger": "2.0",
+        "info": {"title": "tpujob", "version": c.VERSION},
+        "paths": {},
+        "definitions": dict(sorted(definitions.items())),
+    }
+
+
+def main() -> int:
+    verify = "--verify" in sys.argv
+    doc = json.dumps(generate(), indent=2, sort_keys=True) + "\n"
+    if verify:
+        current = OUT_PATH.read_text() if OUT_PATH.exists() else ""
+        if current != doc:
+            print(f"{OUT_PATH.relative_to(ROOT)} is out of date; "
+                  "run: python scripts/gen_openapi.py", file=sys.stderr)
+            return 1
+        print("openapi: up to date")
+        return 0
+    OUT_PATH.parent.mkdir(parents=True, exist_ok=True)
+    OUT_PATH.write_text(doc)
+    print(f"wrote {OUT_PATH.relative_to(ROOT)} "
+          f"({len(generate()['definitions'])} definitions)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

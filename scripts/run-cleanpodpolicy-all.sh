@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# CleanPodPolicy E2E (reference scripts/v1/run-cleanpodpolicy-all.sh):
+# job with cleanPodPolicy=All must have its pods deleted after success.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m e2e.cleanpolicy

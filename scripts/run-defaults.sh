@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Defaults E2E (reference scripts/v1/run-defaults.sh): create a
+# Master=1/Worker=3 smoke job, wait for success, verify pods + GC.
+# NUM_JOBS>1 runs the concurrent-jobs variant (defaults.go:198-248).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m e2e.defaults --num-jobs "${NUM_JOBS:-1}" --workers "${WORKERS:-3}"

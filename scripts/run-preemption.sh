@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Preemption-resume E2E (north-star row 5, no reference equivalent): a
+# checkpointing BERT worker is SIGKILLed mid-run; the operator recreates
+# the pod and the job completes from the checkpoint.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m e2e.preemption

@@ -11,7 +11,7 @@ from tpujob.api.types import TPUJob
 from tpujob.controller.job_base import ControllerConfig
 from tpujob.controller.reconciler import TPUJobController
 from tpujob.kube.client import ClientSet
-from tpujob.kube.control import gen_general_name, gen_labels
+from tpujob.kube.control import gen_general_name
 from tpujob.kube.memserver import InMemoryAPIServer
 
 

@@ -3,7 +3,7 @@ import copy
 
 from tpujob.api import constants as c
 from tpujob.api.types import TPUJob, TPUJobSpec
-from tpujob.kube.objects import Container, Pod
+from tpujob.kube.objects import Pod
 
 JOB_DICT = {
     "apiVersion": "tpujob.dev/v1",

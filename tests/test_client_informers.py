@@ -1,6 +1,5 @@
 """Typed clients, informers/listers, pod/service control."""
 import threading
-import time
 
 from tpujob.api.types import TPUJob
 from tpujob.kube.client import RESOURCE_PODS, RESOURCE_TPUJOBS, ClientSet

@@ -3,7 +3,6 @@
 Mirrors the reference's controller unit-test strategy (SURVEY.md §4 tier 2):
 the cluster is simulated state; reconcile is exercised as a state machine.
 """
-import time
 
 from tpujob.api import constants as c
 from tpujob.controller.job_base import ControllerConfig

@@ -1,0 +1,161 @@
+"""CRD schema <-> validation.py coherence.
+
+The reference closes this loop with codegen (hack/update-codegen.sh:63-73:
+the CRD schema is generated from the Go types).  Ours is hand-written, so
+this test pins the dangerous drift direction: a spec that
+``validate_tpujob_spec`` accepts must also pass the CRD's openAPIV3Schema
+(else `kubectl create` rejects manifests the SDK accepts), and specs the
+schema rejects must also be rejected by validation (else server-side
+enforcement is stricter than the controller believes).
+
+A K8s *structural* schema prunes unknown fields rather than rejecting them,
+so the mini-validator below ignores unknown properties — exactly the
+apiserver behavior.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+
+import pytest
+import yaml
+
+from jobtestutil import new_tpujob
+from tpujob.api.defaults import set_defaults_tpujob
+from tpujob.api.types import TPUJob
+from tpujob.api.validation import validate_tpujob_spec
+
+CRD_PATH = os.path.join(os.path.dirname(__file__), "..", "manifests", "base", "crd.yaml")
+EXAMPLES = sorted(
+    glob.glob(os.path.join(os.path.dirname(__file__), "..", "examples", "*", "*.yaml"))
+)
+
+
+def crd_schema():
+    with open(CRD_PATH) as f:
+        crd = yaml.safe_load(f)
+    (version,) = [v for v in crd["spec"]["versions"] if v["name"] == "v1"]
+    return version["schema"]["openAPIV3Schema"]
+
+
+def schema_errors(schema, value, path="$"):
+    """Minimal openAPIV3Schema checker: type/properties/enum/min/max/pattern."""
+    errs = []
+    t = schema.get("type")
+    if t == "object":
+        if not isinstance(value, dict):
+            return [f"{path}: expected object, got {type(value).__name__}"]
+        for key, sub in (schema.get("properties") or {}).items():
+            if key in value:
+                errs += schema_errors(sub, value[key], f"{path}.{key}")
+        for req in schema.get("required") or []:
+            if req not in value:
+                errs.append(f"{path}: missing required {req!r}")
+    elif t == "array":
+        if not isinstance(value, list):
+            return [f"{path}: expected array"]
+        items = schema.get("items")
+        if items:
+            for i, v in enumerate(value):
+                errs += schema_errors(items, v, f"{path}[{i}]")
+    elif t == "integer":
+        if isinstance(value, bool) or not isinstance(value, int):
+            return [f"{path}: expected integer, got {value!r}"]
+    elif t == "number":
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            return [f"{path}: expected number, got {value!r}"]
+    elif t == "string":
+        if not isinstance(value, str):
+            return [f"{path}: expected string, got {value!r}"]
+    elif t == "boolean":
+        if not isinstance(value, bool):
+            return [f"{path}: expected boolean, got {value!r}"]
+
+    if "enum" in schema and value not in schema["enum"]:
+        errs.append(f"{path}: {value!r} not in {schema['enum']}")
+    if "minimum" in schema and isinstance(value, (int, float)) and value < schema["minimum"]:
+        errs.append(f"{path}: {value} < minimum {schema['minimum']}")
+    if "maximum" in schema and isinstance(value, (int, float)) and value > schema["maximum"]:
+        errs.append(f"{path}: {value} > maximum {schema['maximum']}")
+    if "pattern" in schema and isinstance(value, str) and not re.search(schema["pattern"], value):
+        errs.append(f"{path}: {value!r} fails pattern {schema['pattern']}")
+    return errs
+
+
+def both_verdicts(job: TPUJob):
+    """(schema_ok, validation_ok) for one job."""
+    s_errs = schema_errors(crd_schema(), job.to_dict())
+    v_errs = validate_tpujob_spec(job.spec)
+    return not s_errs, not v_errs, s_errs, v_errs
+
+
+@pytest.mark.parametrize("path", EXAMPLES, ids=[os.path.basename(p) for p in EXAMPLES])
+def test_example_manifests_pass_crd_schema(path):
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    errs = schema_errors(crd_schema(), doc)
+    assert errs == [], errs
+
+
+def test_accepted_specs_pass_schema():
+    """Anything validation.py accepts must survive kubectl's schema check."""
+    fixtures = [
+        new_tpujob(),
+        new_tpujob(master=None, workers=2),
+        new_tpujob(accelerator="v4-32", workers=3),
+        new_tpujob(clean_pod_policy="All", backoff_limit=3, ttl=60,
+                   active_deadline=600, restart_policy="ExitCode"),
+        new_tpujob(accelerator="v4-32", workers=7, num_slices=2),
+    ]
+    for job in fixtures:
+        set_defaults_tpujob(job)
+        s_ok, v_ok, s_errs, v_errs = both_verdicts(job)
+        assert v_ok, v_errs
+        assert s_ok, f"validation accepts but CRD schema rejects: {s_errs}"
+
+
+def test_schema_rejections_also_rejected_by_validation():
+    """Server-side enforcement must not be stricter than the controller's."""
+
+    def mutate(fn):
+        job = new_tpujob()
+        set_defaults_tpujob(job)
+        d = job.to_dict()
+        fn(d)
+        return TPUJob.from_dict(d)
+
+    rejected = [
+        mutate(lambda d: d["spec"]["tpuReplicaSpecs"]["Master"].update(replicas=2)),
+        mutate(lambda d: d["spec"].update(runPolicy={"cleanPodPolicy": "Sometimes"})),
+        mutate(lambda d: d["spec"].update(runPolicy={"backoffLimit": -1})),
+        mutate(lambda d: d["spec"].update(runPolicy={"ttlSecondsAfterFinished": -5})),
+        mutate(lambda d: d["spec"].update(runPolicy={"activeDeadlineSeconds": -1})),
+    ]
+    for job in rejected:
+        s_ok, v_ok, s_errs, v_errs = both_verdicts(job)
+        assert not s_ok, f"schema should reject {job.to_dict()['spec']}"
+        assert not v_ok, (
+            f"CRD schema rejects ({s_errs}) but validation.py accepts — drift"
+        )
+
+
+def test_topology_pattern_matches_parser():
+    """The schema's topology regex and SliceTopology.resolve agree."""
+    from tpujob.api.topology import SliceTopology, TopologyError
+
+    pattern = crd_schema()["properties"]["spec"]["properties"]["tpuReplicaSpecs"][
+        "properties"]["Worker"]["properties"]["tpu"]["properties"]["topology"]["pattern"]
+    cases = [("v4-32", "2x2x4"), ("v5litepod-16", "4x4"), ("v4-32", "abc"),
+             ("v4-32", "2x"), ("v4-64", "2x4x4")]
+    for acc, topo in cases:
+        schema_ok = bool(re.search(pattern, topo))
+        try:
+            SliceTopology.resolve(acc, topo, None, 1)
+            parser_ok = True
+        except TopologyError:
+            parser_ok = False
+        # the schema may be looser than the parser (chip-count mismatches
+        # are semantic), but must never be stricter
+        if parser_ok:
+            assert schema_ok, f"parser accepts {topo!r} but schema rejects"

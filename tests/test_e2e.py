@@ -6,10 +6,9 @@ the reference can only probe with flaky real workloads.
 """
 import time
 
-import pytest
 
 from e2e.cluster import E2ECluster
-from e2e.defaults import expected_pods, run_concurrent, run_single, smoke_job
+from e2e.defaults import run_concurrent, run_single, smoke_job
 from e2e.cleanpolicy import run_cleanpolicy_all, run_cleanpolicy_running
 from e2e.kubelet import PodScript
 from tpujob.api import constants as c

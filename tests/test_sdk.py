@@ -154,7 +154,7 @@ class TestPodsAndLogs:
         with caplog.at_level(logging.WARNING, logger="tpujob.sdk"):
             logs = client.get_logs("test-job")
         assert logs == {}  # no controller ran, so no pods — but the warning fired
-        assert any("no pod_logs endpoint" in r.message for r in caplog.records)
+        assert any("no pod_logs endpoint" in r.getMessage() for r in caplog.records)
 
 
 class TestWatch:

@@ -7,7 +7,6 @@ and strategy-equivalence (TP/SP runs must match pure-DP numerics).
 import numpy as np
 import jax
 import jax.numpy as jnp
-import pytest
 from jax.sharding import PartitionSpec as P
 
 from tpujob.workloads import bert as bertlib

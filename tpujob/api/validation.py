@@ -118,6 +118,11 @@ def validate_tpujob_spec(spec: TPUJobSpec, strict_topology: bool = False) -> Lis
         and spec.run_policy.active_deadline_seconds < 0
     ):
         errs.append("TPUJobSpec is not valid: activeDeadlineSeconds must be >= 0")
+    if (
+        spec.run_policy.ttl_seconds_after_finished is not None
+        and spec.run_policy.ttl_seconds_after_finished < 0
+    ):
+        errs.append("TPUJobSpec is not valid: ttlSecondsAfterFinished must be >= 0")
     return errs
 
 

@@ -22,7 +22,6 @@ from tpujob.kube.errors import (
 from tpujob.kube.memserver import WatchEvent
 
 try:
-    import kubernetes as k8s
     from kubernetes import client as k8s_client
     from kubernetes import config as k8s_config
     from kubernetes import watch as k8s_watch

@@ -6,14 +6,14 @@ from __future__ import annotations
 
 import sys
 
-import tpujob
 from tpujob.server.app import OperatorApp
 from tpujob.server.options import parse_options
+from tpujob.version import version_string
 
 
 def main(argv=None) -> int:
     opt = parse_options(argv)
-    print(f"tpujob-operator {tpujob.__version__} (apiserver={opt.apiserver})", file=sys.stderr)
+    print(f"{version_string()} (apiserver={opt.apiserver})", file=sys.stderr)
     OperatorApp(opt).run(block=True)
     return 0
 

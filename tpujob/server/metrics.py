@@ -9,7 +9,7 @@ exposition format.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Tuple
+from typing import Dict
 
 
 class Counter:

@@ -32,6 +32,10 @@ class ServerOption:
 
 
 def add_flags(parser: argparse.ArgumentParser) -> None:
+    # --version prints version + git SHA and exits (version.go:27-40)
+    from tpujob.version import version_string
+
+    parser.add_argument("--version", action="version", version=version_string())
     parser.add_argument("--apiserver", default="memory",
                         help="tpujob API server URL, or 'memory' for the in-process simulator")
     parser.add_argument("--namespace", default="",

@@ -1,0 +1,41 @@
+"""Operator build metadata.
+
+Mirrors reference ``version/version.go:27-40`` (``PrintVersionAndExit``:
+version + git SHA on the binary).  The SHA is baked into the operator image
+via the ``TPUJOB_GIT_SHA`` env (Dockerfile build arg); from a git checkout
+it is read live; otherwise "unknown".
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+
+import tpujob
+
+
+def git_sha() -> str:
+    baked = os.environ.get("TPUJOB_GIT_SHA")
+    if baked:
+        return baked
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            capture_output=True, text=True, timeout=5,
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        # no git, no checkout, or a hung/slow git (TimeoutExpired) — the
+        # version string must never break operator startup
+        pass
+    return "unknown"
+
+
+def version_string() -> str:
+    from tpujob.runtime import native_version
+
+    return (
+        f"tpujob-operator {tpujob.__version__} "
+        f"(git {git_sha()}, native kernel {native_version})"
+    )

@@ -101,12 +101,21 @@ def digits_datasets(
     return x[tr], y[tr], x[te], y[te]
 
 
+_IDX_STEMS = (
+    "train-images-idx3-ubyte",
+    "train-labels-idx1-ubyte",
+    "t10k-images-idx3-ubyte",
+    "t10k-labels-idx1-ubyte",
+)
+
+
 def resolve_dataset(data_dir: Optional[str], dataset: str = "auto") -> str:
     """Which dataset ``mnist_datasets`` will serve: explicit choice, or
-    ``auto`` = IDX files when present under ``data_dir``, else synthetic."""
+    ``auto`` = a COMPLETE IDX set under ``data_dir`` (all four files — a
+    partial download must fall back, not crash), else synthetic."""
     if dataset in ("idx", "digits", "synthetic"):
         return dataset
-    if data_dir and _find_idx(data_dir, "train-images-idx3-ubyte"):
+    if data_dir and all(_find_idx(data_dir, stem) for stem in _IDX_STEMS):
         return "idx"
     return "synthetic"
 

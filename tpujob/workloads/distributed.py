@@ -20,7 +20,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Optional, Tuple
 
 log = logging.getLogger("tpujob.workloads")
 
@@ -104,7 +104,9 @@ def initialize(env: Optional[ProcessEnv] = None) -> ProcessEnv:
 
         if global_state.client is not None:  # already initialized
             return pe
-    except ImportError:
+    except (ImportError, AttributeError):
+        # private API: a jax upgrade may move the module or rename the
+        # attribute — fall through to the normal initialize path either way
         pass
     log.info(
         "jax.distributed.initialize coordinator=%s num_processes=%d process_id=%d",

@@ -20,12 +20,10 @@ from __future__ import annotations
 
 import argparse
 import time
-from functools import partial
 from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from flax import linen as nn
 
 from tpujob.workloads import data as datalib
