@@ -83,7 +83,12 @@ def test_exitcode_policy_retryable_recreates_pod():
 def test_exitcode_policy_permanent_fails_job():
     """ExitCode policy + permanent code (1): job goes Failed, no retry
     (train_util.go:18-53 classification)."""
-    scripts = [PodScript(match="worker-0", exit_codes=[1, 1, 1, 1, 1, 1])]
+    # master must outlive the worker's failure: if it exits 0 first, the job
+    # legitimately freezes Succeeded (master-completion, status.go:99-112)
+    # and the worker's permanent code can never flip it — a race, not a
+    # controller bug
+    scripts = [PodScript(match="worker-0", exit_codes=[1, 1, 1, 1, 1, 1]),
+               PodScript(match="master", run_seconds=2.0)]
     with E2ECluster(scripts=scripts) as cluster:
         sdk = cluster.sdk
         job = smoke_job("doomed", workers=1)

@@ -55,14 +55,26 @@ class TestData:
         assert datalib.resolve_dataset(None, "auto") == "synthetic"
         assert datalib.resolve_dataset(str(tmp_path), "auto") == "synthetic"
         assert datalib.resolve_dataset(None, "digits") == "digits"
-        # an IDX fixture under data_dir flips auto to idx
+        # a COMPLETE four-file IDX set under data_dir flips auto to idx;
+        # a partial set (interrupted download) must stay synthetic
         import gzip
         import struct
 
-        raw = tmp_path / "train-images-idx3-ubyte.gz"
-        with gzip.open(raw, "wb") as f:
-            f.write(struct.pack(">HBB", 0, 8, 3) + struct.pack(">III", 1, 28, 28)
-                    + bytes(28 * 28))
+        def write_idx(stem, rank3):
+            with gzip.open(tmp_path / f"{stem}.gz", "wb") as f:
+                if rank3:
+                    f.write(struct.pack(">HBB", 0, 8, 3)
+                            + struct.pack(">III", 1, 28, 28) + bytes(28 * 28))
+                else:
+                    f.write(struct.pack(">HBB", 0, 8, 1)
+                            + struct.pack(">I", 1) + bytes(1))
+
+        write_idx("train-images-idx3-ubyte", rank3=True)
+        assert datalib.resolve_dataset(str(tmp_path), "auto") == "synthetic"
+        write_idx("train-labels-idx1-ubyte", rank3=False)
+        write_idx("t10k-images-idx3-ubyte", rank3=True)
+        assert datalib.resolve_dataset(str(tmp_path), "auto") == "synthetic"
+        write_idx("t10k-labels-idx1-ubyte", rank3=False)
         assert datalib.resolve_dataset(str(tmp_path), "auto") == "idx"
 
 
