@@ -17,10 +17,10 @@ RUN make -C native TARGET=/src/libtpujob_native.so
 
 FROM python:3.12-slim
 
-# Runtime deps: the kubernetes client backs --apiserver=kube
-# (tpujob/kube/kubetransport.py); pyyaml parses manifests in the SDK.  The
-# control plane itself is stdlib-only.
-RUN pip install --no-cache-dir pyyaml kubernetes
+# Runtime deps: pyyaml parses kubeconfigs + manifests.  --apiserver=kube is
+# served by the self-contained REST transport (tpujob/kube/kubetransport.py)
+# — no generated client library; the control plane is otherwise stdlib-only.
+RUN pip install --no-cache-dir pyyaml
 
 COPY tpujob/ /app/tpujob/
 COPY --from=build-image /src/libtpujob_native.so /app/tpujob/runtime/libtpujob_native.so

@@ -23,16 +23,22 @@ class E2ECluster:
         scripts: Optional[List[PodScript]] = None,
         leader_election: bool = False,
         run_seconds: float = 0.05,
+        transport=None,
+        kubelet_clients=None,
     ):
+        """``transport`` swaps the operator's API-server transport (e.g. a
+        ``KubeApiTransport`` against the K8s-REST shim); ``kubelet_clients``
+        lets the simulated kubelet talk to the cluster store directly, the
+        way a real kubelet bypasses the operator's client path."""
         opt = ServerOption(
             monitoring_port=0,
             enable_leader_election=leader_election,
             lease_duration_s=1.0, renew_deadline_s=0.4, retry_period_s=0.1,
         )
-        self.app = OperatorApp(opt)
+        self.app = OperatorApp(opt, transport=transport)
         self.sdk = TPUJobClient(self.app.transport)
-        self.kubelet = KubeletSim(self.app.clients, run_seconds=run_seconds,
-                                  scripts=scripts)
+        self.kubelet = KubeletSim(kubelet_clients or self.app.clients,
+                                  run_seconds=run_seconds, scripts=scripts)
         self._thread: Optional[threading.Thread] = None
 
     def __enter__(self) -> "E2ECluster":

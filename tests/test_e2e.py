@@ -108,3 +108,25 @@ def test_bert_preemption_resume():
     from e2e.preemption import run_preemption_resume
 
     run_preemption_resume()
+
+
+def test_defaults_over_k8s_rest_transport():
+    """The defaults scenario with the operator wired through the real-cluster
+    transport (KubeApiTransport -> K8s-REST shim -> memserver), while the
+    simulated kubelet drives pods node-side.  End-to-end coverage of the
+    production client path: reconcile traffic, status patches, events, pod
+    logs and GC all ride real K8s REST URLs (defaults.go:116-189 role)."""
+    from tests.k8sshim import K8sRestShim
+    from tpujob.kube.client import ClientSet
+    from tpujob.kube.kubetransport import KubeApiTransport, KubeConfig
+    from e2e.defaults import run_single
+
+    shim = K8sRestShim(token="e2e-token").start()
+    try:
+        transport = KubeApiTransport(
+            config=KubeConfig(host=shim.url, token="e2e-token"))
+        with E2ECluster(transport=transport,
+                        kubelet_clients=ClientSet(shim.backend)) as cluster:
+            run_single(cluster, name="rest-defaults", workers=2, timeout=60)
+    finally:
+        shim.stop()

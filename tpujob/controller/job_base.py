@@ -68,7 +68,11 @@ class JobController:
     ):
         self.clients = clients
         self.config = config or ControllerConfig()
-        self.factory = factory or InformerFactory(clients.server)
+        # --namespace scopes every informer's list/watch, the way the
+        # reference scopes its informer factories (app/server.go:111-114)
+        self.factory = factory or InformerFactory(
+            clients.server, namespace=self.config.namespace
+        )
         self.recorder = recorder or EventRecorder(clients)
         self.pod_control = PodControl(clients, self.recorder)
         self.service_control = ServiceControl(clients, self.recorder)

@@ -184,10 +184,20 @@ class HTTPApiClient:
     def delete(self, resource: str, namespace: str, name: str) -> None:
         self._request("DELETE", f"/api/{resource}/{namespace or 'default'}/{name}")
 
-    def watch(self, resource: Optional[str] = None, send_initial: bool = False) -> HTTPWatch:
+    def watch(
+        self,
+        resource: Optional[str] = None,
+        send_initial: bool = False,
+        namespace: Optional[str] = None,
+    ) -> HTTPWatch:
         if resource is None:
             raise InvalidError("HTTP transport requires a per-resource watch")
-        suffix = "?initial=1" if send_initial else ""
+        params = []
+        if send_initial:
+            params.append("initial=1")
+        if namespace:
+            params.append(f"namespace={urllib.parse.quote(namespace)}")
+        suffix = ("?" + "&".join(params)) if params else ""
         return HTTPWatch(f"{self.base_url}/watch/{resource}{suffix}")
 
     def healthy(self) -> bool:

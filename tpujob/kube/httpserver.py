@@ -137,7 +137,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _serve_watch(self, resource: str, query) -> None:
         initial = (query.get("initial") or ["0"])[0] in ("1", "true")
-        watch = self.backend.watch(resource, send_initial=initial)
+        ns = (query.get("namespace") or [None])[0]
+        watch = self.backend.watch(resource, send_initial=initial, namespace=ns)
         try:
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")

@@ -58,9 +58,15 @@ UpdateHandler = Callable[[Dict[str, Any], Dict[str, Any]], None]
 class SharedInformer:
     """Watch-fed cache + handler dispatch for one resource type."""
 
-    def __init__(self, server: InMemoryAPIServer, resource: str):
+    def __init__(
+        self,
+        server: InMemoryAPIServer,
+        resource: str,
+        namespace: Optional[str] = None,
+    ):
         self.server = server
         self.resource = resource
+        self.namespace = namespace  # None = cluster-wide (corev1.NamespaceAll)
         self.store = Store()
         self._add_handlers: List[Handler] = []
         self._update_handlers: List[UpdateHandler] = []
@@ -90,8 +96,8 @@ class SharedInformer:
     def _establish(self) -> None:
         """Open the watch, then LIST (watch-first so no events are lost) and
         reconcile the local cache against the fresh list."""
-        self._watch = self.server.watch(self.resource)
-        initial = self.server.list(self.resource)
+        self._watch = self.server.watch(self.resource, namespace=self.namespace)
+        initial = self.server.list(self.resource, namespace=self.namespace)
         known = {Store._key(o) for o in initial}
         for stale in [o for o in self.store.list() if Store._key(o) not in known]:
             self.store.remove(stale)
@@ -189,13 +195,16 @@ class SharedInformer:
 class InformerFactory:
     """SharedInformerFactory equivalent: one informer per resource, shared."""
 
-    def __init__(self, server: InMemoryAPIServer):
+    def __init__(self, server: InMemoryAPIServer, namespace: Optional[str] = None):
         self.server = server
+        self.namespace = namespace  # None = all namespaces; else scoped factory
         self._informers: Dict[str, SharedInformer] = {}
 
     def informer(self, resource: str) -> SharedInformer:
         if resource not in self._informers:
-            self._informers[resource] = SharedInformer(self.server, resource)
+            self._informers[resource] = SharedInformer(
+                self.server, resource, namespace=self.namespace
+            )
         return self._informers[resource]
 
     def start(self, stop_event: threading.Event) -> None:

@@ -97,7 +97,8 @@ class InMemoryAPIServer:
     def __init__(self, enable_gc: bool = True):
         self._lock = threading.RLock()
         self._stores: Dict[str, _Store] = {}
-        self._watches: List[Tuple[Optional[str], Watch]] = []  # (resource | None=all, watch)
+        # (resource | None=all, namespace | None=all, watch)
+        self._watches: List[Tuple[Optional[str], Optional[str], Watch]] = []
         self._rv = 0
         self._enable_gc = enable_gc
         # hooks: callables invoked (event_type, resource, obj_dict) after commit
@@ -134,15 +135,16 @@ class InMemoryAPIServer:
 
     def _broadcast(self, ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
         ev = WatchEvent(ev_type, resource, copy.deepcopy(obj))
-        for res, w in list(self._watches):
-            if res is None or res == resource:
+        obj_ns = (obj.get("metadata") or {}).get("namespace") or "default"
+        for res, ns, w in list(self._watches):
+            if (res is None or res == resource) and (ns is None or ns == obj_ns):
                 w._put(ev)
         for hook in list(self.hooks):
             hook(ev_type, resource, copy.deepcopy(obj))
 
     def _remove_watch(self, watch: Watch) -> None:
         with self._lock:
-            self._watches = [(r, w) for (r, w) in self._watches if w is not watch]
+            self._watches = [t for t in self._watches if t[2] is not watch]
 
     # -- CRUD ---------------------------------------------------------------
 
@@ -259,15 +261,24 @@ class InMemoryAPIServer:
 
     # -- watch --------------------------------------------------------------
 
-    def watch(self, resource: Optional[str] = None, send_initial: bool = False) -> Watch:
+    def watch(
+        self,
+        resource: Optional[str] = None,
+        send_initial: bool = False,
+        namespace: Optional[str] = None,
+    ) -> Watch:
+        """Subscribe to changes; ``namespace`` scopes the stream the way a
+        namespaced list/watch URL scopes a real apiserver stream
+        (reference server.go:111-114 namespace-scoped informer factories)."""
         with self._lock:
             w = Watch(self)
             if send_initial:
                 resources = [resource] if resource else list(self._stores)
                 for res in resources:
-                    for obj in self._store(res).objects.values():
-                        w._put(WatchEvent(ADDED, res, copy.deepcopy(obj)))
-            self._watches.append((resource, w))
+                    for (ns, _), obj in self._store(res).objects.items():
+                        if namespace is None or ns == namespace:
+                            w._put(WatchEvent(ADDED, res, copy.deepcopy(obj)))
+            self._watches.append((resource, namespace, w))
             return w
 
 

@@ -27,19 +27,21 @@ def build_transport(opt: ServerOption):
     if opt.apiserver == "memory":
         return InMemoryAPIServer()
     if opt.apiserver == "kube":
-        # real-cluster transport: adapt the kubernetes python client to the
-        # ApiServer interface (gated: the client library may not be present)
-        try:
-            import kubernetes  # noqa: F401
-        except ImportError:
-            raise SystemExit(
-                "--apiserver=kube requires the 'kubernetes' python package; "
-                "install it in the operator image, or point --apiserver at a "
-                "tpujob-apiserver URL"
-            )
-        from tpujob.kube.kubetransport import KubeApiTransport  # noqa: PLC0415
+        # real-cluster transport: the self-contained K8s REST client
+        # (in-cluster serviceaccount config, kubeconfig fallback)
+        from tpujob.kube.kubetransport import (  # noqa: PLC0415
+            KubeApiTransport,
+            KubeConfig,
+            KubeConfigError,
+        )
 
-        return _maybe_rate_limit(KubeApiTransport(namespace=opt.namespace or None), opt)
+        try:
+            config = KubeConfig.load()
+        except KubeConfigError as e:
+            raise SystemExit(f"--apiserver=kube: no cluster config found: {e}")
+        return _maybe_rate_limit(
+            KubeApiTransport(config, namespace=opt.namespace or None), opt
+        )
     client = HTTPApiClient(opt.apiserver)
     if not client.healthy():
         raise SystemExit(f"cannot reach tpujob API server at {opt.apiserver}")

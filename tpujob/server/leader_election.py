@@ -7,6 +7,7 @@ gauge, and fatal loss-of-leadership.
 """
 from __future__ import annotations
 
+import calendar
 import logging
 import threading
 import time
@@ -19,6 +20,37 @@ from tpujob.server import metrics
 log = logging.getLogger("tpujob.leaderelection")
 
 RESOURCE_LEASES = "leases"
+
+
+def rfc3339micro(ts: float) -> str:
+    """coordination.k8s.io/v1 MicroTime wire format (renewTime/acquireTime)."""
+    frac = int(round((ts % 1.0) * 1e6))
+    if frac >= 1_000_000:  # rounding carried into the next second
+        ts, frac = ts + 1, 0
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(ts)) + f".{frac:06d}Z"
+
+
+def parse_lease_time(value) -> float:
+    """Epoch seconds from a MicroTime string (or a bare number, which older
+    lease records may carry)."""
+    if value in (None, ""):
+        return 0.0
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        pass
+    s = str(value).rstrip("Z")
+    micros = 0.0
+    if "." in s:
+        s, frac = s.split(".", 1)
+        try:
+            micros = float("0." + frac)
+        except ValueError:
+            micros = 0.0
+    try:
+        return calendar.timegm(time.strptime(s, "%Y-%m-%dT%H:%M:%S")) + micros
+    except ValueError:
+        return 0.0
 
 
 class LeaderElector:
@@ -59,12 +91,18 @@ class LeaderElector:
 
     def _try_acquire_or_renew_inner(self) -> bool:
         now = time.time()
+        # typed coordination.k8s.io/v1 Lease wire format: MicroTime strings
+        # and integer seconds, so the record round-trips through a real
+        # apiserver (client-go resourcelock.LeaseLock semantics)
         record = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
             "metadata": {"name": self.lock_name, "namespace": self.namespace},
             "spec": {
                 "holderIdentity": self.identity,
-                "leaseDurationSeconds": self.lease_duration,
-                "renewTime": now,
+                "leaseDurationSeconds": max(1, int(round(self.lease_duration))),
+                "acquireTime": rfc3339micro(now),
+                "renewTime": rfc3339micro(now),
             },
         }
         try:
@@ -77,9 +115,23 @@ class LeaderElector:
                 return False
         spec = current.get("spec") or {}
         holder = spec.get("holderIdentity")
-        renew = float(spec.get("renewTime") or 0)
-        expired = now - renew > float(spec.get("leaseDurationSeconds") or self.lease_duration)
+        renew = parse_lease_time(spec.get("renewTime"))
+        # expiry uses our configured duration when renewing our own lock;
+        # for another holder, honor the duration they advertised
+        advertised = spec.get("leaseDurationSeconds")
+        duration = (
+            self.lease_duration
+            if holder == self.identity or advertised in (None, "")
+            else float(advertised)
+        )
+        expired = now - renew > duration
         if holder == self.identity or expired or not holder:
+            if holder != self.identity:
+                transitions = int(spec.get("leaseTransitions") or 0)
+                record["spec"]["leaseTransitions"] = transitions + 1
+            else:
+                record["spec"]["acquireTime"] = spec.get("acquireTime") or rfc3339micro(now)
+                record["spec"]["leaseTransitions"] = int(spec.get("leaseTransitions") or 0)
             record["metadata"]["resourceVersion"] = (current.get("metadata") or {}).get(
                 "resourceVersion"
             )
