@@ -44,6 +44,23 @@ KIND_OF = {
     "leases": "Lease",
 }
 
+# What the apiserver initializes .status to at create, for every resource
+# served with a /status subresource: CRDs get nothing at all (no /status
+# path until the first status write), built-ins get a registry-initialized
+# status (pod: phase Pending).  Enforced ONLY here, not in InMemoryAPIServer:
+# the memserver doubles as the fixture substrate (tests inject pods with
+# chosen phases, the reference's fake-indexer pattern, SURVEY §4), so it
+# deliberately accepts client-supplied status; the shim is the fidelity tier.
+INITIAL_STATUS = {
+    "tpujobs": None,
+    "podgroups": None,
+    "pods": {"phase": "Pending"},
+    "services": {"loadBalancer": {}},
+}
+# .status writes through the main resource (POST/PUT/merge-PATCH) are
+# ignored by the apiserver for exactly these resources
+HAS_STATUS_SUBRESOURCE = frozenset(INITIAL_STATUS)
+
 _RFC3339_MICRO = re.compile(r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}(\.\d+)?Z$")
 
 
@@ -274,6 +291,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return
         if r.namespace:
             obj.setdefault("metadata", {}).setdefault("namespace", r.namespace)
+        if r.plural in HAS_STATUS_SUBRESOURCE:
+            obj.pop("status", None)  # client-supplied status is ignored
+            if INITIAL_STATUS.get(r.plural) is not None:
+                obj["status"] = dict(INITIAL_STATUS[r.plural])
         try:
             self._json(201, self.backend.create(r.plural, obj))
         except ApiError as e:
@@ -301,6 +322,13 @@ class _Handler(BaseHTTPRequestHandler):
             if r.sub == "status":
                 self._json(200, self.backend.update_status(r.plural, obj))
             elif r.sub is None:
+                if r.plural in HAS_STATUS_SUBRESOURCE:
+                    # main-resource PUT ignores .status: the stored status
+                    # survives, whatever the request body carried
+                    cur = self.backend.get(r.plural, r.namespace, r.name)
+                    obj.pop("status", None)
+                    if "status" in cur:
+                        obj["status"] = cur["status"]
                 self._json(200, self.backend.update(r.plural, obj))
             else:
                 self._fail(404, "NotFound", f"no subresource {r.sub}")
@@ -332,12 +360,22 @@ class _Handler(BaseHTTPRequestHandler):
             if r.sub == "status":
                 cur = self.backend.get(r.plural, r.namespace, r.name)
                 if ct == "application/json-patch+json":
-                    # only the op the apiserver-bound clients use
+                    # only the ops the apiserver-bound clients use
                     if (not isinstance(patch, list) or len(patch) != 1
-                            or patch[0].get("op") != "replace"
+                            or patch[0].get("op") not in ("add", "replace")
                             or patch[0].get("path") != "/status"):
                         self._fail(422, "Invalid",
                                    f"unsupported JSON-patch on /status: {patch!r}")
+                        return
+                    # RFC 6902: `replace` requires the target path to exist;
+                    # a fresh object has no .status (stripped at create), so
+                    # a real apiserver fails the patch — mirror that here
+                    if patch[0]["op"] == "replace" and "status" not in cur:
+                        self._fail(
+                            422, "Invalid",
+                            "jsonpatch replace operation does not apply: "
+                            "doc is missing path: /status",
+                        )
                         return
                     cur["status"] = patch[0].get("value") or {}
                 else:
@@ -352,6 +390,8 @@ class _Handler(BaseHTTPRequestHandler):
                 if ct == "application/json-patch+json":
                     self._fail(422, "Invalid", "JSON-patch only supported on /status")
                     return
+                if r.plural in HAS_STATUS_SUBRESOURCE:
+                    patch.pop("status", None)  # main-resource patch ignores it
                 self._json(200, self.backend.patch(r.plural, r.namespace, r.name, patch))
             else:
                 self._fail(404, "NotFound", f"no subresource {r.sub}")

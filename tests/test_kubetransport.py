@@ -100,6 +100,70 @@ def test_update_status_subresource(shim, transport):
     assert transport.get(c.PLURAL, "default", "j1")["spec"] == {"tpuReplicaSpecs": {}}
 
 
+def test_first_status_write_after_create(shim, transport):
+    """A freshly created CR has NO stored .status (the subresource strips it
+    at create), so the very first status write must not assume the path
+    exists — a JSON-patch `replace /status` fails RFC 6902 here (advisor
+    round-3 high; reference uses UpdateStatus PUT, client.go:42-96)."""
+    created = transport.create(
+        c.PLURAL,
+        {**_job("fresh"), "status": {"conditions": [{"type": "Bogus"}]}},
+    )
+    assert "status" not in created, "apiserver must strip .status at create"
+    out = transport.update_status(
+        c.PLURAL,
+        {"metadata": {"name": "fresh", "namespace": "default"},
+         "status": {"conditions": [{"type": "Created", "status": "True"}]}},
+    )
+    assert out["status"]["conditions"][0]["type"] == "Created"
+
+
+def test_main_resource_writes_ignore_status(shim, transport):
+    """PUT/merge-PATCH of the main resource must not touch .status when the
+    resource has a status subresource — a controller that round-trips status
+    through spec writes must fail here, not only on a real cluster."""
+    transport.create(c.PLURAL, _job("j-ign"))
+    transport.update_status(
+        c.PLURAL,
+        {"metadata": {"name": "j-ign", "namespace": "default"},
+         "status": {"replicaStatuses": {"Worker": {"active": 1}}}},
+    )
+    got = transport.get(c.PLURAL, "default", "j-ign")
+    got["status"] = {"replicaStatuses": {"Worker": {"active": 99}}}
+    updated = transport.update(c.PLURAL, got)
+    assert updated["status"]["replicaStatuses"]["Worker"] == {"active": 1}
+    transport.patch(c.PLURAL, "default", "j-ign",
+                    {"status": {"replicaStatuses": {"Worker": {"active": 7}}}})
+    final = transport.get(c.PLURAL, "default", "j-ign")["status"]
+    assert final["replicaStatuses"]["Worker"] == {"active": 1}
+
+
+def test_builtin_pod_status_initialized_at_create(shim, transport):
+    """Built-ins differ from CRDs: the apiserver initializes pod status
+    (phase Pending) at create, so /status EXISTS on a fresh pod."""
+    created = transport.create("pods", {
+        "metadata": {"name": "p-init", "namespace": "default"},
+        "spec": {"containers": [{"name": c.DEFAULT_CONTAINER_NAME}]},
+        "status": {"phase": "Running"},  # client-supplied: ignored
+    })
+    assert created["status"] == {"phase": "Pending"}
+
+
+def test_shim_rejects_replace_on_missing_status(shim, transport):
+    """Fidelity of the double itself: the shim must reject what a real
+    apiserver rejects, or the bug class it exists to catch slips through."""
+    from tpujob.kube.errors import InvalidError
+
+    transport.create(c.PLURAL, _job("fresh2"))
+    with pytest.raises(InvalidError):
+        transport._request(
+            "PATCH",
+            transport._item(c.PLURAL, "default", "fresh2", sub="status"),
+            [{"op": "replace", "path": "/status", "value": {}}],
+            content_type="application/json-patch+json",
+        )
+
+
 def test_update_status_clears_stale_fields(shim, transport):
     """Status updates must REPLACE the subresource: our omit-empty
     serialization drops zero-valued fields, so a merge-patch would leave

@@ -448,16 +448,22 @@ class KubeApiTransport:
         return self._request("PUT", self._item(resource, self._ns_of(obj), name), obj)
 
     def update_status(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
-        """JSON-patch REPLACE of the /status subresource — replace (not
-        merge) because our status serialization omits zero-valued fields, and
-        a merge-patch would leave stale server-side keys (e.g. ``active: 2``
-        surviving on a completed job).  No resourceVersion needed; works
-        uniformly for built-ins and custom resources."""
+        """JSON-patch the whole /status subresource in one op — whole-object
+        (not a merge-patch) because our status serialization omits
+        zero-valued fields, and a merge would leave stale server-side keys
+        (e.g. ``active: 2`` surviving on a completed job).  The op is ``add``,
+        not ``replace``: RFC 6902 ``replace`` requires the path to exist, and
+        a freshly created CR has NO stored ``.status`` until its first status
+        write (the subresource strips it at create) — so ``replace`` fails
+        the very first status update of every job against a real apiserver.
+        ``add`` on an existing object member replaces it (RFC 6902 §4.1), so
+        one op covers both cases.  No resourceVersion needed; works uniformly
+        for built-ins and custom resources."""
         name = (obj.get("metadata") or {}).get("name") or ""
         return self._request(
             "PATCH",
             self._item(resource, self._ns_of(obj), name, sub="status"),
-            [{"op": "replace", "path": "/status", "value": obj.get("status") or {}}],
+            [{"op": "add", "path": "/status", "value": obj.get("status") or {}}],
             content_type="application/json-patch+json",
         )
 
