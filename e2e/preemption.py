@@ -108,6 +108,10 @@ def run_preemption_resume(timeout: float = 180) -> None:
             # filtering removes it, status.go:226-272 semantics.)
             final = cluster.clients.pods.get("default", f"{JOB_NAME}-worker-0")
             assert first_uid and final.metadata.uid != first_uid
+            # the recreation is accounted in job status (invisible in the
+            # reference, whose counter only sees kubelet in-place restarts)
+            assert job.status.replica_statuses["Worker"].restarts == 1, (
+                job.status.to_dict())
 
     assert len(outputs) == 2, f"expected 2 container lifetimes, got {len(outputs)}"
     assert f"resumed from checkpoint step {CKPT_STEP}" in outputs[1], (

@@ -183,6 +183,88 @@ def test_exit_code_retryable_restarts():
     assert not h.check_condition(job, c.JOB_FAILED)
 
 
+def test_preemption_churn_counted_and_bounded():
+    """A worker dying 137 in a loop (TPU preemption churn, BASELINE.md row 5)
+    must be counted in replica status and fail the job at backoffLimit.
+    Recreated pods come back with restartCount 0, so the reference's
+    in-place counting (controller.go:520-556) never fires on this loop —
+    it would churn forever, invisibly."""
+    h = Harness()
+    h.submit(new_tpujob(restart_policy="ExitCode", backoff_limit=3))
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+
+    for i in range(2):
+        h.set_pod_phase("test-job", "Worker", 1, "Failed", exit_code=137)
+        h.sync()
+        job = h.get_job()
+        # the recreation is visible in status, job still alive
+        assert job.status.replica_statuses["Worker"].restarts == i + 1
+        assert not h.check_condition(job, c.JOB_FAILED)
+        pod = h.clients.pods.get("default", "test-job-worker-1")
+        assert pod.status.phase != "Failed"  # recreated fresh
+        # fresh pods carry restartCount 0: the reference's counter stays 0
+        assert all(cs.restart_count == 0 for cs in pod.status.container_statuses)
+
+    # third preemption reaches the limit: the job fails with the count
+    # visible, and the final failed pod is PRESERVED (not deleted first) so
+    # its logs/events remain inspectable under cleanPodPolicy None
+    final_uid = h.clients.pods.get("default", "test-job-worker-1").metadata.uid
+    h.set_pod_phase("test-job", "Worker", 1, "Failed", exit_code=137)
+    h.sync()
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_FAILED)
+    assert "backoff limit" in [x for x in job.status.conditions if x.type == c.JOB_FAILED][0].message
+    assert job.status.replica_statuses["Worker"].restarts == 3
+    kept = h.clients.pods.get("default", "test-job-worker-1")
+    assert kept.metadata.uid == final_uid and kept.status.phase == "Failed"
+
+
+def test_restart_count_rebased_on_status_conflict():
+    """A sync working from a stale JOB cache (its status write 409s) must
+    not swallow the recreation it just executed: the increment is rebased
+    onto the fresh object, client-go RetryOnConflict style."""
+    h = Harness()
+    h.submit(new_tpujob(restart_policy="ExitCode"))
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    # another writer bumps status server-side; the job informer does NOT see it
+    fresh = h.get_job()
+    fresh.status.replica_statuses["Worker"].restarts = 5
+    h.clients.tpujobs.update_status(fresh)
+    # a preemption lands; refresh ONLY the pod informer, keeping the job stale
+    h.set_pod_phase("test-job", "Worker", 1, "Failed", exit_code=137)
+    h.controller.factory.informer("pods").sync_once()
+    h.controller.sync_handler("default/test-job")
+    got = h.get_job()
+    # 5 (fresh server-side) + 1 (this sync's recreation), not 0+1 or 5
+    assert got.status.replica_statuses["Worker"].restarts == 6
+
+
+def test_stuck_terminating_pod_not_recounted():
+    """A preempted pod stuck Terminating (finalizer / dead node) past the
+    expectations TTL must not be re-deleted and re-counted every sync —
+    that would inflate restarts to backoffLimit with zero real restarts.
+    The job stays in Restarting, not Failed, while the pod drains."""
+    h = Harness()
+    h.submit(new_tpujob(restart_policy="ExitCode", backoff_limit=3))
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    h.set_pod_phase("test-job", "Worker", 1, "Failed", exit_code=137)
+    pod = h.clients.pods.get("default", "test-job-worker-1")
+    pod.metadata.deletion_timestamp = "2026-01-01T00:00:00Z"
+    h.clients.pods.update(pod)
+    for _ in range(5):
+        h.sync()
+    job = h.get_job()
+    assert job.status.replica_statuses["Worker"].restarts == 0
+    assert h.check_condition(job, c.JOB_RESTARTING)
+    assert not h.check_condition(job, c.JOB_FAILED)
+
+
 def test_backoff_limit_exceeded():
     h = Harness()
     h.submit(new_tpujob(backoff_limit=2, restart_policy="OnFailure", clean_pod_policy="All"))

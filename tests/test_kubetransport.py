@@ -118,6 +118,34 @@ def test_first_status_write_after_create(shim, transport):
     assert out["status"]["conditions"][0]["type"] == "Created"
 
 
+def test_update_status_stale_rv_conflicts(shim, transport):
+    """A status write carrying a stale resourceVersion must 409, not clobber
+    — the guard against a stale-cache sync resetting cumulative status
+    (restarts counter) through the whole-object status write."""
+    transport.create(c.PLURAL, _job("j-rv"))
+    first = transport.update_status(
+        c.PLURAL,
+        {"metadata": {"name": "j-rv", "namespace": "default"},
+         "status": {"replicaStatuses": {"Worker": {"restarts": 1}}}},
+    )
+    stale_rv = first["metadata"]["resourceVersion"]
+    # another writer bumps the object
+    transport.update_status(
+        c.PLURAL,
+        {"metadata": {"name": "j-rv", "namespace": "default"},
+         "status": {"replicaStatuses": {"Worker": {"restarts": 2}}}},
+    )
+    with pytest.raises(ConflictError):
+        transport.update_status(
+            c.PLURAL,
+            {"metadata": {"name": "j-rv", "namespace": "default",
+                          "resourceVersion": stale_rv},
+             "status": {"replicaStatuses": {"Worker": {}}}},
+        )
+    kept = transport.get(c.PLURAL, "default", "j-rv")
+    assert kept["status"]["replicaStatuses"]["Worker"]["restarts"] == 2
+
+
 def test_main_resource_writes_ignore_status(shim, transport):
     """PUT/merge-PATCH of the main resource must not touch .status when the
     resource has a status subresource — a controller that round-trips status

@@ -71,6 +71,22 @@ def test_update_status_subresource_only_touches_status():
     assert out["spec"] == {"x": 1}  # spec change via status subresource ignored
 
 
+def test_update_status_conflict_on_stale_rv():
+    """Status writes honor optimistic concurrency like the main resource:
+    a stale-cache sync must 409 instead of clobbering newer status."""
+    s = InMemoryAPIServer()
+    created = s.create("tpujobs", {"metadata": {"name": "j"}, "spec": {}})
+    newer = s.update_status(
+        "tpujobs", {"metadata": {"name": "j"}, "status": {"n": 1}})
+    stale = {"metadata": dict(created["metadata"]), "status": {"n": 0}}
+    with pytest.raises(ConflictError):
+        s.update_status("tpujobs", stale)
+    assert s.get("tpujobs", "default", "j")["status"] == {"n": 1}
+    # rv carried by the fresh object is accepted
+    s.update_status("tpujobs", {"metadata": dict(newer["metadata"]), "status": {"n": 2}})
+    assert s.get("tpujobs", "default", "j")["status"] == {"n": 2}
+
+
 def test_patch_merges_recursively():
     s = InMemoryAPIServer()
     s.create("tpujobs", {"metadata": {"name": "j", "labels": {"a": "1"}}, "spec": {"k": {"x": 1, "y": 2}}})

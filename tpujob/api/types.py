@@ -135,11 +135,21 @@ class JobCondition(K8sObject):
 
 @dataclass
 class ReplicaStatus(K8sObject):
-    """Mirrors kubeflow/common ReplicaStatus (types.go:47-58)."""
+    """Per-replica-type counters plus cumulative controller-driven restarts.
+
+    Mirrors kubeflow/common ReplicaStatus (types.go:47-58); ``restarts``
+    counts restart decisions under the ExitCode restart policy (pod
+    recreations; the limit-tripping one leaves the failed pod in place as
+    debugging evidence).  The reference
+    counts only kubelet in-place restarts toward backoff
+    (controller.go:520-556) and recreations are invisible — but on TPU,
+    preemption (exit 137/143 → recreated pod with restartCount 0) is the
+    common case, so it must be bounded and visible in status."""
 
     active: int = 0
     succeeded: int = 0
     failed: int = 0
+    restarts: int = 0
     extra: Dict[str, Any] = field(default_factory=dict)
 
 

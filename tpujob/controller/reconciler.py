@@ -20,7 +20,7 @@ from typing import Dict, List, Optional, Tuple
 
 from tpujob.api import constants as c
 from tpujob.api.defaults import set_defaults_tpujob
-from tpujob.api.types import TPUJob
+from tpujob.api.types import ReplicaStatus, TPUJob
 from tpujob.api.validation import validate_tpujob_spec
 from tpujob.controller import status as st
 from tpujob.controller import tpu_env
@@ -35,7 +35,7 @@ from tpujob.controller.joblogger import (
 from tpujob.controller.job_base import JobController, expectation_key
 from tpujob.kube.client import RESOURCE_TPUJOBS
 from tpujob.kube.control import gen_general_name, gen_labels, gen_pod_group_name
-from tpujob.kube.errors import NotFoundError
+from tpujob.kube.errors import ConflictError, NotFoundError
 from tpujob.kube.objects import (
     Container,
     ObjectMeta,
@@ -89,6 +89,13 @@ class TPUJobController(JobController):
         # injectable handlers for tests (controller.go:81-89)
         self.update_status_handler = self._update_job_status
         self.delete_job_handler = self._delete_job
+        # restart increments made by the CURRENT sync, keyed by job key:
+        # consumed by _update_job_status to rebase the cumulative counter
+        # onto the fresh object when the status write hits 409 (a stale
+        # informer cache must not swallow a counted recreation).  Safe
+        # across worker threads: the workqueue never runs one key twice
+        # concurrently, and keys don't share entries.
+        self._restart_deltas: Dict[str, Dict[str, int]] = {}
 
     # ------------------------------------------------------------------
     # job event handlers (job.go:35-149)
@@ -125,6 +132,8 @@ class TPUJobController(JobController):
     def _on_job_delete(self, obj: Dict) -> None:
         metrics.jobs_deleted.inc()
         key = self.job_key_of(obj)
+        self._restart_deltas.pop(key, None)  # no leak; no carry-over to a
+        # future job recreated under the same namespace/name
         for rtype in (c.REPLICA_TYPE_MASTER, c.REPLICA_TYPE_WORKER):
             self.expectations.delete(expectation_key(key, rtype, "pods"))
             self.expectations.delete(expectation_key(key, rtype, "services"))
@@ -185,6 +194,19 @@ class TPUJobController(JobController):
     def reconcile_tpujobs(self, job: TPUJob) -> bool:
         key = job.key
         old_status = job.status.deepcopy()
+        # Deltas re-stashed by a failed status write count recreations the
+        # cached status doesn't know about yet: fold them in up front (after
+        # the old_status snapshot, so the fold alone registers as a change
+        # to write) and put them back on the ledger — they stay unpersisted
+        # until a status write lands.  At-least-once accounting: bounded
+        # churn prefers the rare overcount of a lost-response write over
+        # silently undercounting.
+        carried = self._restart_deltas.pop(key, None) or {}
+        if carried:
+            for rtype, d in carried.items():
+                rs = job.status.replica_statuses.setdefault(rtype, ReplicaStatus())
+                rs.restarts += d
+            self._restart_deltas[key] = dict(carried)
         pods = self.get_pods_for_job(job)
         services = self.get_services_for_job(job)
 
@@ -202,8 +224,7 @@ class TPUJobController(JobController):
         exceeded, reason = self._past_backoff_limit(job, pods)
         if exceeded:
             return self._fail_job(job, old_status, pods, services,
-                                  f"TPUJob {job.metadata.name} has failed because it has "
-                                  f"reached the specified backoff limit ({reason})")
+                                  self._backoff_message(job, reason))
         if self._past_active_deadline(job):
             return self._fail_job(job, old_status, pods, services,
                                   f"TPUJob {job.metadata.name} has failed because it was "
@@ -221,13 +242,26 @@ class TPUJobController(JobController):
         coord_rtype = tpu_env.coordinator_replica(job)
         for rtype, rspec in job.spec.tpu_replica_specs.items():
             typed_pods = self.filter_by_replica_type(pods, rtype)
-            restarting = self._reconcile_pods(job, typed_pods, rtype, rspec)
+            restarting = self._reconcile_pods(job, typed_pods, rtype, rspec, pods)
             if rtype == coord_rtype:
                 # coordinator-only headless service (controller.go:474-477;
                 # worker-0 coordinates master-less jobs)
                 typed_svcs = self.filter_by_replica_type(services, rtype)
                 self._reconcile_services(job, typed_svcs, rtype, rspec)
             self._update_status_single(job, rtype, rspec, restarting)
+
+        # re-check the backoff limit with the counts updated THIS sync:
+        # the entry check reads the informer-cached status, which can trail
+        # the restart just counted — without this, event-ordering (pod
+        # DELETED seen before the job's status MODIFIED) lets one extra
+        # pod incarnation launch beyond the configured limit.  Guarded on
+        # is_finished: a job whose completion-bearing replica succeeded
+        # this very sync must not also be flipped to Failed.
+        if not st.is_finished(job.status):
+            exceeded, reason = self._past_backoff_limit(job, pods)
+            if exceeded:
+                return self._fail_job(job, old_status, pods, services,
+                                      self._backoff_message(job, reason))
 
         if job.status != old_status:
             self.update_status_handler(job)
@@ -237,7 +271,8 @@ class TPUJobController(JobController):
     # pods (pod.go:49-232)
     # ------------------------------------------------------------------
 
-    def _reconcile_pods(self, job: TPUJob, pods: List[Pod], rtype: str, rspec) -> bool:
+    def _reconcile_pods(self, job: TPUJob, pods: List[Pod], rtype: str, rspec,
+                        all_pods: Optional[List[Pod]] = None) -> bool:
         replicas = rspec.replicas if rspec.replicas is not None else 1
         st.initialize_replica_statuses(job.status, rtype)
         slices = self.get_slices(pods, replicas)
@@ -256,15 +291,57 @@ class TPUJobController(JobController):
             if pod.status.phase == "Failed" and rspec.restart_policy == c.RESTART_POLICY_EXIT_CODE:
                 code = self._managed_exit_code(pod)
                 if code is not None and is_retryable_exit_code(code):
-                    logger_for_pod(log, pod, job).info(
-                        "exited with retryable code %d; restarting", code)
-                    self.expectations.expect(
-                        expectation_key(job.key, rtype, "pods"), adds=0, dels=1
-                    )
-                    self.pod_control.delete_pod(
-                        pod.metadata.namespace, pod.metadata.name, job
-                    )
                     restarting = True
+                    # deletion_timestamp guard: a pod stuck Terminating past
+                    # the expectations TTL (finalizer, dead node) must stay
+                    # in Restarting without being re-deleted and re-counted
+                    # every sync — that would spuriously trip backoffLimit
+                    if not pod.metadata.deletion_timestamp:
+                        # count the restart decision in status: a recreated
+                        # pod has restartCount 0, so without this a
+                        # preemption loop is invisible and unbounded (vs
+                        # controller.go:520-556 which only sees kubelet
+                        # in-place restarts)
+                        job.status.replica_statuses[rtype].restarts += 1
+                        exceeded, _ = self._past_backoff_limit(
+                            job, all_pods if all_pods is not None else pods)
+                        if exceeded:
+                            # this restart trips the limit: keep the final
+                            # failed pod in place (its logs/events are the
+                            # debugging evidence; cleanPodPolicy decides its
+                            # fate at failure time) — the post-loop check
+                            # fails the job this same sync.  NOT put on the
+                            # delta ledger: the evidence pod survives, so a
+                            # 409'd fail-write re-derives this count from
+                            # the fresh cache instead of a rebase
+                            # double-applying it every lagged sync
+                            logger_for_pod(log, pod, job).info(
+                                "retryable exit %d reaches the backoff "
+                                "limit; failing job", code)
+                        else:
+                            logger_for_pod(log, pod, job).info(
+                                "exited with retryable code %d; restarting",
+                                code)
+                            ekey = expectation_key(job.key, rtype, "pods")
+                            self.expectations.expect(ekey, adds=0, dels=1)
+                            try:
+                                self.pod_control.delete_pod(
+                                    pod.metadata.namespace,
+                                    pod.metadata.name, job,
+                                )
+                            except Exception:
+                                # the restart did not happen: roll back the
+                                # count and the expectation so the retry
+                                # sync re-derives exactly one restart from
+                                # the still-present Failed pod
+                                job.status.replica_statuses[rtype].restarts -= 1
+                                self.expectations.observe_del(ekey)
+                                raise
+                            # ledger entry only after the delete executed:
+                            # the delta survives a failed STATUS write, and
+                            # the delete is what destroys the evidence pod
+                            deltas = self._restart_deltas.setdefault(job.key, {})
+                            deltas[rtype] = deltas.get(rtype, 0) + 1
                     # fall through: the failure still counts this sync, so the
                     # status machine emits Restarting (reference pod.go:91-109
                     # deletes async and the pod is still counted)
@@ -430,15 +507,21 @@ class TPUJobController(JobController):
                 return
         if rs.failed > 0:
             if restarting:
-                self.recorder.event(job, "Warning", st.REASON_JOB_RESTARTING,
-                                    f"TPUJob {job.metadata.name} is restarting because "
-                                    f"{rs.failed} {rtype} replica(s) failed.")
+                # event + metric only on the TRANSITION into Restarting: a
+                # pod stuck Terminating keeps restarting=True across many
+                # syncs and must not spam events / inflate jobs_restarted
+                newly_restarting = not st.has_condition(job.status, c.JOB_RESTARTING)
+                if newly_restarting:
+                    self.recorder.event(job, "Warning", st.REASON_JOB_RESTARTING,
+                                        f"TPUJob {job.metadata.name} is restarting because "
+                                        f"{rs.failed} {rtype} replica(s) failed.")
                 st.update_job_conditions(
                     job.status, c.JOB_RESTARTING, st.REASON_JOB_RESTARTING,
                     f"TPUJob {job.metadata.name} is restarting because "
                     f"{rs.failed} {rtype} replica(s) failed.",
                 )
-                metrics.jobs_restarted.inc()
+                if newly_restarting:
+                    metrics.jobs_restarted.inc()
             else:
                 self.recorder.event(job, "Warning", st.REASON_JOB_FAILED,
                                     f"TPUJob {job.metadata.name} has failed because "
@@ -462,14 +545,27 @@ class TPUJobController(JobController):
             return False, ""
         restarts = 0
         for rtype, rspec in job.spec.tpu_replica_specs.items():
-            if rspec.restart_policy not in (c.RESTART_POLICY_ON_FAILURE, c.RESTART_POLICY_ALWAYS):
-                continue  # only in-place-restart policies count (controller.go:527-533)
-            for pod in self.filter_by_replica_type(pods, rtype):
-                for cs in pod.status.container_statuses:
-                    restarts += cs.restart_count
+            if rspec.restart_policy in (c.RESTART_POLICY_ON_FAILURE, c.RESTART_POLICY_ALWAYS):
+                # kubelet in-place restarts (controller.go:527-533)
+                for pod in self.filter_by_replica_type(pods, rtype):
+                    for cs in pod.status.container_statuses:
+                        restarts += cs.restart_count
+            elif rspec.restart_policy == c.RESTART_POLICY_EXIT_CODE:
+                # controller-driven recreations, accumulated in status —
+                # bounds the TPU-preemption churn loop the reference
+                # cannot see (it only counts restartCount, which is 0 on
+                # every recreated pod)
+                rs = job.status.replica_statuses.get(rtype)
+                if rs is not None:
+                    restarts += rs.restarts
         if restarts >= limit:
             return True, f"total restart count {restarts} >= backoffLimit {limit}"
         return False, ""
+
+    @staticmethod
+    def _backoff_message(job: TPUJob, reason: str) -> str:
+        return (f"TPUJob {job.metadata.name} has failed because it has "
+                f"reached the specified backoff limit ({reason})")
 
     def _past_active_deadline(self, job: TPUJob) -> bool:
         ads = job.spec.run_policy.active_deadline_seconds
@@ -568,10 +664,69 @@ class TPUJobController(JobController):
 
     def _update_job_status(self, job: TPUJob) -> None:
         job.status.last_reconcile_time = st.now_iso()
+        deltas = self._restart_deltas.pop(job.key, None)
         try:
             self.clients.tpujobs.update_status(job)
+            return
         except NotFoundError:
-            pass
+            return
+        except ConflictError:
+            # stale informer cache (409 via the RV the status write carries):
+            # do NOT clobber the newer status — but the restart increments
+            # of THIS sync count real pod deletions that already executed,
+            # so rebase them onto the fresh object before requeueing
+            # (client-go RetryOnConflict discipline); everything else is
+            # recomputed from pods on the requeued sync anyway
+            logger_for_job(log, job).info(
+                "status write conflicted (stale cache); requeueing")
+        except Exception:
+            # transient transport failure: the recreations of this sync are
+            # already executed — re-stash their deltas so the next sync
+            # folds them in instead of silently undercounting
+            self._restash_deltas(job, deltas)
+            raise
+        if deltas:
+            try:
+                for _ in range(3):
+                    try:
+                        fresh = self.clients.tpujobs.get(
+                            job.metadata.namespace or "default", job.metadata.name)
+                    except NotFoundError:
+                        deltas = None  # job gone: nothing left to count
+                        return
+                    for rtype, d in deltas.items():
+                        rs = fresh.status.replica_statuses.setdefault(rtype, ReplicaStatus())
+                        rs.restarts += d
+                    try:
+                        self.clients.tpujobs.update_status(fresh)
+                        deltas = None
+                        break
+                    except NotFoundError:
+                        deltas = None
+                        return
+                    except ConflictError:
+                        continue
+            finally:
+                # rebase exhausted or died mid-flight (transient transport
+                # error): keep the ledger for the next sync
+                self._restash_deltas(job, deltas)
+        # rate-limited, not immediate: the cache stays stale for the whole
+        # watch-latency window after the conflicting write, so an immediate
+        # requeue would spin PUT-409 against the apiserver (client-go
+        # RetryOnConflict backs off the same way)
+        self.queue.add_rate_limited(job.key)
+
+    def _restash_deltas(self, job: TPUJob, deltas: Optional[Dict[str, int]]) -> None:
+        """Put unpersisted restart deltas back on the ledger — unless the job
+        is gone from the informer cache: racing _on_job_delete's cleanup
+        would leave a phantom entry that poisons a future job recreated
+        under the same namespace/name."""
+        if not deltas:
+            return
+        if self.job_informer.store.get(
+                job.metadata.namespace or "default", job.metadata.name) is None:
+            return
+        self._restart_deltas[job.key] = deltas
 
     def _delete_job(self, job: TPUJob) -> None:
         self.clients.tpujobs.delete(job.metadata.namespace or "default", job.metadata.name)

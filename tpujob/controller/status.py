@@ -103,8 +103,15 @@ def update_job_conditions(status: JobStatus, cond_type: str, reason: str, messag
 
 
 def initialize_replica_statuses(status: JobStatus, rtype: str) -> None:
-    """status.go:162-168: reset the replica status for a type each sync."""
-    status.replica_statuses[rtype] = ReplicaStatus()
+    """status.go:162-168: reset the replica status for a type each sync.
+
+    Phase counters (active/succeeded/failed) are recomputed from pods every
+    sync, but ``restarts`` is cumulative history — a recreated pod carries no
+    trace of its predecessors — so it survives the reset."""
+    prev = status.replica_statuses.get(rtype)
+    status.replica_statuses[rtype] = ReplicaStatus(
+        restarts=prev.restarts if prev is not None else 0
+    )
 
 
 def update_replica_statuses(status: JobStatus, rtype: str, pod: Pod) -> None:

@@ -457,12 +457,28 @@ class KubeApiTransport:
         write (the subresource strips it at create) — so ``replace`` fails
         the very first status update of every job against a real apiserver.
         ``add`` on an existing object member replaces it (RFC 6902 §4.1), so
-        one op covers both cases.  No resourceVersion needed; works uniformly
-        for built-ins and custom resources."""
+        one op covers both cases.
+
+        When the caller's object carries a resourceVersion (the normal
+        controller path: the job came from the informer cache), the write is
+        a PUT of the subresource instead — optimistic concurrency, exactly
+        the reference's UpdateStatus (client.go:42-96) — so a sync working
+        from a stale cache gets 409 Conflict and requeues rather than
+        silently clobbering a newer status (e.g. resetting the cumulative
+        restarts counter).  Without an RV (the malformed-CR write-back,
+        job.go:60-111) the patch is unconditional."""
         name = (obj.get("metadata") or {}).get("name") or ""
+        ns = self._ns_of(obj)
+        rv = (obj.get("metadata") or {}).get("resourceVersion")
+        if rv:
+            body = self._with_gvk(resource, {
+                "metadata": {"name": name, "namespace": ns, "resourceVersion": rv},
+                "status": obj.get("status") or {},
+            })
+            return self._request("PUT", self._item(resource, ns, name, sub="status"), body)
         return self._request(
             "PATCH",
-            self._item(resource, self._ns_of(obj), name, sub="status"),
+            self._item(resource, ns, name, sub="status"),
             [{"op": "add", "path": "/status", "value": obj.get("status") or {}}],
             content_type="application/json-patch+json",
         )

@@ -210,12 +210,24 @@ class InMemoryAPIServer:
             return copy.deepcopy(obj)
 
     def update_status(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
-        """Status-subresource update: only .status is taken from `obj`."""
+        """Status-subresource update: only .status is taken from `obj`.
+
+        Optimistic concurrency like the main resource: a caller-supplied
+        resourceVersion that is stale raises Conflict, so a sync working
+        from a stale informer cache cannot silently clobber a newer status
+        (e.g. reset the cumulative ``restarts`` counter).  No RV provided =
+        unconditional write (the malformed-CR write-back path)."""
         with self._lock:
             key = self._key(obj)
             current = self._store(resource).objects.get(key)
             if current is None:
                 raise NotFoundError(f"{resource} {key[0]}/{key[1]} not found")
+            rv = (obj.get("metadata") or {}).get("resourceVersion")
+            cur_rv = (current.get("metadata") or {}).get("resourceVersion")
+            if rv and rv != cur_rv:
+                raise ConflictError(
+                    f"{resource} {key[0]}/{key[1]}: resourceVersion {rv} != {cur_rv}"
+                )
             merged = copy.deepcopy(current)
             merged["status"] = copy.deepcopy(obj.get("status") or {})
             merged["metadata"]["resourceVersion"] = self._next_rv()
