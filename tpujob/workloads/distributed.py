@@ -158,6 +158,38 @@ def _factor_axes(
     return sizes
 
 
+def hybrid_mesh_shapes(
+    names: Tuple[str, ...], shape: Tuple[int, ...], num_slices: int
+) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """Pure ICI/DCN split for a multislice mesh: the slowest axis absorbs
+    the slice boundary so only it crosses the DCN (SURVEY.md §2.5 ICI/DCN
+    accounting).  Returns ``(ici_shape, dcn_shape)`` with elementwise
+    ``ici * dcn == shape``; raises if the slowest axis cannot be split.
+    """
+    if num_slices <= 1:
+        raise ValueError(f"hybrid mesh needs num_slices > 1, got {num_slices}")
+    first = shape[0]
+    if first % num_slices != 0:
+        raise ValueError(
+            f"multislice mesh: slowest axis {names[0]!r}={first} must be "
+            f"divisible by num_slices={num_slices}, or per-layer "
+            f"collectives would cross the DCN"
+        )
+    dcn = [1] * len(shape)
+    dcn[0] = num_slices
+    ici = list(shape)
+    ici[0] = first // num_slices
+    return tuple(ici), tuple(dcn)
+
+
+def devices_have_slice_index(devices) -> bool:
+    """True when the device objects carry multislice placement info (real
+    TPU devices in a multislice deployment).  Virtual CPU devices don't —
+    make_mesh then falls back to a plain mesh so shardings still compile in
+    tests/dryruns."""
+    return bool(devices) and hasattr(devices[0], "slice_index")
+
+
 def make_mesh(
     axes: Optional[Dict[str, int]] = None,
     *,
@@ -187,27 +219,12 @@ def make_mesh(
     shape = [sizes[a] for a in names]
 
     pe = env or process_env()
-    if pe.num_slices > 1 and n % pe.num_slices == 0:
-        # multislice: the slowest axis must absorb the slice boundary so
-        # only it crosses the DCN.  Virtual (CPU) devices carry no
-        # slice_index — fall back to a plain mesh there so the sharding
-        # still compiles in tests/dryruns.
-        first = sizes[names[0]]
-        if hasattr(devices[0], "slice_index"):
-            if first % pe.num_slices != 0:
-                raise ValueError(
-                    f"multislice mesh: slowest axis {names[0]!r}={first} must be "
-                    f"divisible by num_slices={pe.num_slices}, or per-layer "
-                    f"collectives would cross the DCN"
-                )
-            dcn = [1] * len(shape)
-            dcn[0] = pe.num_slices
-            ici = list(shape)
-            ici[0] = first // pe.num_slices
-            dmesh = mesh_utils.create_hybrid_device_mesh(
-                ici, dcn, devices=devices, allow_split_physical_axes=True
-            )
-            return Mesh(dmesh, axis_names=tuple(names))
+    if pe.num_slices > 1 and n % pe.num_slices == 0 and devices_have_slice_index(devices):
+        ici, dcn = hybrid_mesh_shapes(tuple(names), tuple(shape), pe.num_slices)
+        dmesh = mesh_utils.create_hybrid_device_mesh(
+            list(ici), list(dcn), devices=devices, allow_split_physical_axes=True
+        )
+        return Mesh(dmesh, axis_names=tuple(names))
     dmesh = mesh_utils.create_device_mesh(shape, devices=devices)
     return Mesh(dmesh, axis_names=tuple(names))
 
