@@ -57,6 +57,9 @@ class KubeletSim:
         self._consumed: Dict[str, int] = {}  # script match -> codes used
         self._attempts: Dict[str, int] = {}  # pod name -> exec attempts
         self._exec_threads: List[threading.Thread] = []
+        # guards the dicts/list above: exec threads (_run_exec -> _spawn_exec)
+        # mutate them concurrently with the poll loop (round-2 advisor low)
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -72,7 +75,9 @@ class KubeletSim:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2)
-        for t in self._exec_threads:
+        with self._lock:
+            threads = list(self._exec_threads)
+        for t in threads:
             t.join(timeout=30)
 
     # -- behavior -----------------------------------------------------------
@@ -84,11 +89,12 @@ class KubeletSim:
         return None
 
     def _next_exit_code(self, script: PodScript) -> int:
-        used = self._consumed.get(script.match, 0)
-        if used < len(script.exit_codes):
-            self._consumed[script.match] = used + 1
-            return script.exit_codes[used]
-        return 0
+        with self._lock:
+            used = self._consumed.get(script.match, 0)
+            if used < len(script.exit_codes):
+                self._consumed[script.match] = used + 1
+                return script.exit_codes[used]
+            return 0
 
     def _set_status(self, pod: Pod, phase: str, exit_code: Optional[int],
                     restart_count: int) -> None:
@@ -122,13 +128,21 @@ class KubeletSim:
         The attempt counter is per pod NAME: recreations of the same pod
         (and in-place container restarts) advance it; sibling replicas
         matching the same script each start at attempt 0."""
-        attempt = self._attempts.get(pod.metadata.name, 0)
-        self._attempts[pod.metadata.name] = attempt + 1
+        with self._lock:
+            attempt = self._attempts.get(pod.metadata.name, 0)
+            self._attempts[pod.metadata.name] = attempt + 1
         t = threading.Thread(
             target=self._run_exec, args=(pod, script, attempt),
             daemon=True, name=f"kubelet-exec-{pod.metadata.name}",
         )
-        self._exec_threads.append(t)
+        with self._lock:
+            # prune finished lifetimes so a long churn run stays bounded;
+            # ident is None = appended by a concurrent spawner but not yet
+            # started — must be kept, is_alive() is False for it too
+            self._exec_threads = [
+                x for x in self._exec_threads if x.ident is None or x.is_alive()
+            ]
+            self._exec_threads.append(t)
         t.start()
 
     def _run_exec(self, pod: Pod, script: PodScript, attempt: int) -> None:

@@ -98,6 +98,28 @@ def test_env_injection_multislice():
     assert host == coordinator_dns(h.get_job())
 
 
+def test_multislice_coordinator_service_declares_megascale_port():
+    """The DCN coordinator port is a named ServicePort on the headless
+    rendezvous service, matching the injected MEGASCALE_COORDINATOR_ADDRESS
+    (tpu_env.py contract; round-2 advisor low: the comment claimed it was
+    exposed, the service didn't declare it)."""
+    from tpujob.controller.tpu_env import MEGASCALE_PORT
+
+    h = Harness()
+    h.submit(new_tpujob(accelerator="v4-16", workers=3, num_slices=2))
+    h.sync()
+    svc = h.clients.services.get("default", "test-job-master-0")
+    ports = {p.name: p.port for p in svc.spec.ports}
+    assert ports.get("megascale") == MEGASCALE_PORT
+
+    # single-slice jobs don't declare it
+    h2 = Harness()
+    h2.submit(new_tpujob(name="single", accelerator="v4-32", workers=3))
+    h2.sync()
+    svc2 = h2.clients.services.get("default", "single-master-0")
+    assert "megascale" not in {p.name for p in svc2.spec.ports}
+
+
 def test_worker_init_container_dns_gate():
     h = Harness()
     h.submit(new_tpujob())

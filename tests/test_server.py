@@ -180,6 +180,79 @@ def test_options_parsing():
     assert parse_options([]).gang_scheduler_name == "volcano"
 
 
+def test_version_flag_is_lazy(monkeypatch):
+    """Building the parser must not shell out to git (version_string runs a
+    subprocess); only an actual --version invocation may."""
+    import tpujob.version as v
+
+    def boom():
+        raise AssertionError("version_string called during parser build")
+
+    monkeypatch.setattr(v, "version_string", boom)
+    opt = parse_options(["--threadiness", "2"])  # builds parser, no --version
+    assert opt.threadiness == 2
+    monkeypatch.setattr(v, "version_string", lambda: "tpujob v1 abc123")
+    with pytest.raises(SystemExit):
+        parse_options(["--version"])
+
+
+def test_lease_namespace_resolution(monkeypatch):
+    """The lease lands in the operator's OWN namespace (reference
+    server.go:72-76), never a hardcoded default: flag > downward-API env >
+    transport serviceaccount namespace > 'default'."""
+    monkeypatch.delenv("OPERATOR_NAMESPACE", raising=False)
+    app = OperatorApp(ServerOption(monitoring_port=0))
+    assert app.lease_namespace() == "default"
+
+    monkeypatch.setenv("OPERATOR_NAMESPACE", "opns")
+    assert app.lease_namespace() == "opns"
+
+    app2 = OperatorApp(ServerOption(monitoring_port=0,
+                                    leader_election_namespace="lockns"))
+    assert app2.lease_namespace() == "lockns"
+
+    class FakeTransport:
+        class config:  # noqa: N801 - mimic KubeConfig attribute
+            namespace = "sans"
+
+    monkeypatch.delenv("OPERATOR_NAMESPACE", raising=False)
+    app3 = OperatorApp(ServerOption(monitoring_port=0))
+    app3.transport = FakeTransport()  # in-cluster-configured transport
+    assert app3.lease_namespace() == "sans"
+
+
+def test_lease_time_parse_offsets_and_fail_closed():
+    from tpujob.server.leader_election import parse_lease_time, rfc3339micro
+
+    t = parse_lease_time("2026-07-30T01:02:03.000004Z")
+    assert t is not None
+    assert parse_lease_time("2026-07-30T01:02:03.000004+00:00") == t
+    assert parse_lease_time(rfc3339micro(t)) == pytest.approx(t, abs=1e-5)
+    # unparseable / absent renew times are None, which electors treat as
+    # NOT expired (stealing from a live leader is split-brain)
+    assert parse_lease_time("not-a-time") is None
+    assert parse_lease_time("") is None
+    assert parse_lease_time(None) is None
+
+
+def test_garbage_renew_time_not_stolen():
+    """A held lease with an unparseable renewTime must NOT be stolen."""
+    from tpujob.kube.memserver import InMemoryAPIServer
+
+    server = InMemoryAPIServer()
+    server.create("leases", {
+        "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+        "metadata": {"name": "tpujob-operator", "namespace": "default"},
+        "spec": {"holderIdentity": "alive-leader", "leaseDurationSeconds": 1,
+                 "renewTime": "garbage"},
+    })
+    e = LeaderElector(server, identity="challenger", lease_duration=1,
+                      renew_deadline=0.2, retry_period=0.05)
+    assert not e._try_acquire_or_renew()
+    lease = server.get("leases", "default", "tpujob-operator")
+    assert lease["spec"]["holderIdentity"] == "alive-leader"
+
+
 def test_operator_app_end_to_end():
     """Full app wiring: leader election -> controller -> job lifecycle."""
     opt = ServerOption(monitoring_port=0, lease_duration_s=1.0,

@@ -1,0 +1,30 @@
+"""tpujob.util tests (reference pkg/util/util_test.go)."""
+import json
+import random
+import re
+
+from tpujob.util import pformat, rand_string
+
+
+def test_pformat_dict_round_trips():
+    out = pformat({"b": 2, "a": [1, {"x": None}]})
+    assert json.loads(out) == {"b": 2, "a": [1, {"x": None}]}
+    assert out.startswith("{\n")  # indented, log-friendly
+
+
+def test_pformat_typed_object_and_unserializable():
+    from tpujob.api.types import ReplicaStatus
+
+    assert json.loads(pformat(ReplicaStatus(active=2))) == {"active": 2}
+    assert "object" in pformat(object())  # repr fallback, never raises
+
+
+def test_rand_string_dns_safe():
+    rng = random.Random(42)
+    for n in (1, 8, 63):
+        s = rand_string(n, rng)
+        assert len(s) == n
+        assert re.fullmatch(r"[a-z][a-z0-9]*", s)
+    assert rand_string(0) == ""
+    # deterministic under a seeded rng, random across calls otherwise
+    assert rand_string(8, random.Random(7)) == rand_string(8, random.Random(7))

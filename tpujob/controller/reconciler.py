@@ -450,6 +450,13 @@ class TPUJobController(JobController):
         labels = gen_labels(job.metadata.name)
         labels[c.LABEL_REPLICA_TYPE] = rtype.lower()
         labels[c.LABEL_REPLICA_INDEX] = str(index)
+        ports = [ServicePort(name=c.DEFAULT_PORT_NAME, port=port)]
+        if tpu_env.is_multislice(job):
+            # multislice: the DCN coordinator rides the same headless
+            # service — declare its port by name so the injected
+            # MEGASCALE_COORDINATOR_ADDRESS (host:MEGASCALE_PORT) matches
+            # a named ServicePort (tpu_env.py contract)
+            ports.append(ServicePort(name="megascale", port=tpu_env.MEGASCALE_PORT))
         service = Service(
             metadata=ObjectMeta(
                 name=gen_general_name(job.metadata.name, rtype, index),
@@ -459,7 +466,7 @@ class TPUJobController(JobController):
             spec=ServiceSpec(
                 cluster_ip="None",  # headless: DNS resolves to the pod IP
                 selector=dict(labels),
-                ports=[ServicePort(name=c.DEFAULT_PORT_NAME, port=port)],
+                ports=ports,
             ),
         )
         self.expectations.expect(expectation_key(key, rtype, "services"), adds=1, dels=0)

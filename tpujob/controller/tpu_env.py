@@ -58,6 +58,21 @@ def coordinator_dns(job: TPUJob) -> str:
     return f"{coordinator_service_name(job.metadata.name, coordinator_replica(job))}.{ns}"
 
 
+def is_multislice(job: TPUJob) -> bool:
+    """True when ANY replica's slice spec resolves to num_slices > 1 — the
+    same any-spec resolution set_cluster_spec uses, so the service-port
+    declaration can never diverge from the MEGASCALE_* env injection."""
+    for rspec in job.spec.tpu_replica_specs.values():
+        tpu = rspec.tpu
+        if tpu is not None and tpu.accelerator:
+            try:
+                if tpu.resolve().num_slices > 1:
+                    return True
+            except (TypeError, ValueError):
+                continue
+    return False
+
+
 def pod_name_of_process(job_name: str, pid: int, has_master: bool) -> str:
     if has_master and pid == 0:
         return gen_general_name(job_name, c.REPLICA_TYPE_MASTER, 0)

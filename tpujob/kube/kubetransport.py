@@ -28,6 +28,7 @@ import queue
 import ssl
 import tempfile
 import threading
+import time
 import urllib.parse
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -85,6 +86,12 @@ class KubeConfig:
     client_key: str = ""
     verify: bool = True
     namespace: str = "default"  # default namespace for created objects
+    # when set, the bearer token is periodically re-read from this file:
+    # modern clusters mount bound, time-limited serviceaccount tokens
+    # (~1h) that the kubelet rotates on disk, so caching the startup token
+    # for the process lifetime earns 401s after expiry (client-go re-reads
+    # the same way; round-3 advisor medium)
+    token_path: str = ""
     _tempfiles: List[str] = field(default_factory=list, repr=False)
 
     @classmethod
@@ -108,6 +115,7 @@ class KubeConfig:
         return cls(
             host=f"https://{host}:{port}",
             token=token,
+            token_path=token_path,
             ca_cert=ca if os.path.exists(ca) else "",
             namespace=ns,
         )
@@ -292,6 +300,13 @@ class KubeApiTransport:
         self.hooks: List = []  # parity with InMemoryAPIServer surface
         self._local = threading.local()  # per-thread keep-alive connection
         self._ssl_ctx = self._build_ssl() if self._scheme == "https" else None
+        # -inf when no token was preloaded: the first request then reads the
+        # file immediately instead of going out unauthenticated for the
+        # first refresh interval
+        self._token_read_at = (
+            time.monotonic() if self.config.token else -float("inf")
+        )
+        self._token_lock = threading.Lock()
 
     # -- connection plumbing -------------------------------------------------
 
@@ -313,10 +328,30 @@ class KubeApiTransport:
             )
         return http.client.HTTPConnection(self._host, self._port, timeout=timeout)
 
+    _TOKEN_REFRESH_S = 60.0
+
+    def _bearer_token(self) -> str:
+        """The current bearer token, re-read from the serviceaccount mount
+        at most once per refresh interval (bound tokens rotate on disk)."""
+        path = self.config.token_path
+        if path:
+            with self._token_lock:
+                if time.monotonic() - self._token_read_at >= self._TOKEN_REFRESH_S:
+                    self._token_read_at = time.monotonic()
+                    try:
+                        with open(path) as f:
+                            fresh = f.read().strip()
+                        if fresh:
+                            self.config.token = fresh
+                    except OSError as e:
+                        log.warning("serviceaccount token re-read failed: %s", e)
+        return self.config.token
+
     def _headers(self, content_type: str = "application/json") -> Dict[str, str]:
         h = {"Content-Type": content_type, "Accept": "application/json"}
-        if self.config.token:
-            h["Authorization"] = f"Bearer {self.config.token}"
+        token = self._bearer_token()
+        if token:
+            h["Authorization"] = f"Bearer {token}"
         return h
 
     def _conn(self) -> http.client.HTTPConnection:

@@ -120,6 +120,7 @@ class OperatorApp:
             elector = LeaderElector(
                 self.transport,
                 lock_name=self.opt.leader_election_id,
+                namespace=self.lease_namespace(),
                 lease_duration=self.opt.lease_duration_s,
                 renew_deadline=self.opt.renew_deadline_s,
                 retry_period=self.opt.retry_period_s,
@@ -140,6 +141,24 @@ class OperatorApp:
                     pass
             finally:
                 self.shutdown()
+
+    def lease_namespace(self) -> str:
+        """The namespace holding the leader-election Lease: the operator's
+        OWN namespace, like the reference derives from KUBEFLOW_NAMESPACE
+        (server.go:72-76,146-152).  A hardcoded 'default' would make two
+        operators in different namespaces fight over one lease — and a
+        namespace-restricted deploy couldn't write it at all."""
+        import os
+
+        if self.opt.leader_election_namespace:
+            return self.opt.leader_election_namespace
+        env_ns = os.environ.get("OPERATOR_NAMESPACE", "")
+        if env_ns:
+            return env_ns
+        # in-cluster: the serviceaccount-mounted namespace on the transport
+        cfg = getattr(self.transport, "config", None)
+        cfg_ns = getattr(cfg, "namespace", "") if cfg is not None else ""
+        return cfg_ns or "default"
 
     def shutdown(self) -> None:
         self.stop_event.set()

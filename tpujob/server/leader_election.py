@@ -7,7 +7,6 @@ gauge, and fatal loss-of-leadership.
 """
 from __future__ import annotations
 
-import calendar
 import logging
 import threading
 import time
@@ -30,27 +29,32 @@ def rfc3339micro(ts: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(ts)) + f".{frac:06d}Z"
 
 
-def parse_lease_time(value) -> float:
+def parse_lease_time(value) -> Optional[float]:
     """Epoch seconds from a MicroTime string (or a bare number, which older
-    lease records may carry)."""
+    lease records may carry); ``None`` when absent or unparseable.
+
+    Handles RFC3339 offsets ('+00:00' as well as 'Z'): another client's
+    serializer may emit either.  Callers must FAIL CLOSED on None — treating
+    garbage as epoch 0 would make a live leader's lease look expired and
+    let a standby steal leadership (round-3 advisor finding)."""
     if value in (None, ""):
-        return 0.0
+        return None
     try:
         return float(value)
     except (TypeError, ValueError):
         pass
-    s = str(value).rstrip("Z")
-    micros = 0.0
-    if "." in s:
-        s, frac = s.split(".", 1)
-        try:
-            micros = float("0." + frac)
-        except ValueError:
-            micros = 0.0
+    from datetime import datetime, timezone
+
+    s = str(value)
+    if s.endswith("Z"):
+        s = s[:-1] + "+00:00"
     try:
-        return calendar.timegm(time.strptime(s, "%Y-%m-%dT%H:%M:%S")) + micros
+        dt = datetime.fromisoformat(s)
     except ValueError:
-        return 0.0
+        return None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.timestamp()
 
 
 class LeaderElector:
@@ -124,7 +128,10 @@ class LeaderElector:
             if holder == self.identity or advertised in (None, "")
             else float(advertised)
         )
-        expired = now - renew > duration
+        # fail closed: a held lease whose renewTime we cannot parse is
+        # treated as live — stealing from a healthy leader (split-brain)
+        # is far worse than waiting for it to release or rewrite the lease
+        expired = renew is not None and now - renew > duration
         if holder == self.identity or expired or not holder:
             if holder != self.identity:
                 transitions = int(spec.get("leaseTransitions") or 0)

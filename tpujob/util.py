@@ -1,0 +1,38 @@
+"""Small shared helpers.
+
+Mirrors the reference's ``pkg/util/util.go:33-74``: ``Pformat`` (JSON
+pretty-printer for log/debug output) and ``RandString`` (DNS-safe random
+suffix generator for object names).
+"""
+from __future__ import annotations
+
+import json
+import random
+import string
+from typing import Any
+
+# DNS-1123: lowercase alphanumerics only (names must also start with a
+# letter, which the first-char choice guarantees)
+_LETTERS = string.ascii_lowercase
+_ALNUM = string.ascii_lowercase + string.digits
+
+
+def pformat(value: Any) -> str:
+    """Pretty-print a value as indented JSON for human-readable logs
+    (util.go:33-46).  Falls back to ``repr`` for non-JSON-serializable
+    input instead of raising inside a log statement."""
+    if hasattr(value, "to_dict"):
+        value = value.to_dict()
+    try:
+        return json.dumps(value, indent=2, sort_keys=True, default=repr)
+    except (TypeError, ValueError):
+        return repr(value)
+
+
+def rand_string(n: int, rng: random.Random | None = None) -> str:
+    """A DNS-1123-safe random string: first char a lowercase letter, rest
+    lowercase alphanumeric (util.go:49-74)."""
+    if n <= 0:
+        return ""
+    r = rng or random
+    return r.choice(_LETTERS) + "".join(r.choice(_ALNUM) for _ in range(n - 1))
