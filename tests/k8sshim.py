@@ -21,7 +21,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlsplit
 
-from tpujob.kube.errors import ApiError
+import time
+
+from tpujob.kube.errors import ApiError, GoneError
 from tpujob.kube.memserver import InMemoryAPIServer
 
 # (group, version) each plural must be served under — independent of the
@@ -233,7 +235,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if r.name is None:
                 if (qs.get("watch") or ["false"])[0] in ("true", "1"):
-                    self._serve_watch(r)
+                    self._serve_watch(r, qs)
                 else:
                     sel = _parse_selector(qs)
                     items = self.backend.list(r.plural, r.namespace, sel)
@@ -414,8 +416,48 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- watch streaming -----------------------------------------------------
 
-    def _serve_watch(self, r: _Route) -> None:
-        watch = self.backend.watch(r.plural, namespace=r.namespace)
+    def _serve_watch(self, r: _Route, qs: Dict[str, List[str]]) -> None:
+        """K8s watch semantics, faithfully:
+
+        - no ``resourceVersion`` (or "0"): synthetic ADDED events for the
+          current state, then live events (the "Get State and Start at Most
+          Recent" contract clients rely on for send_initial)
+        - ``resourceVersion=N``: replay events after N, then live — or a
+          200 response whose first event is ERROR with a 410 Status when N
+          was compacted away (that is how a real apiserver reports it)
+        - ``timeoutSeconds``: server closes a healthy stream at the
+          deadline; clients must treat it as a normal reconnect point
+        """
+        rv = (qs.get("resourceVersion") or [None])[0]
+        timeout_s = (qs.get("timeoutSeconds") or [None])[0]
+        deadline = (
+            time.monotonic() + float(timeout_s) if timeout_s is not None else None
+        )
+        try:
+            if rv is None or rv == "0":
+                watch = self.backend.watch(
+                    r.plural, namespace=r.namespace, send_initial=True)
+            else:
+                watch = self.backend.watch(
+                    r.plural, namespace=r.namespace, resource_version=rv)
+        except GoneError as e:
+            # a real apiserver answers 200 and puts the 410 Status in the
+            # first watch event, NOT in the HTTP status line
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            err = json.dumps({
+                "type": "ERROR",
+                "object": _status_body(410, "Expired", str(e)),
+            }).encode() + b"\n"
+            try:
+                self.wfile.write(f"{len(err):x}\r\n".encode() + err + b"\r\n")
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+            self.close_connection = True
+            return
         with self.server.streams_lock:  # type: ignore[attr-defined]
             self.server.streams.append(watch)  # type: ignore[attr-defined]
         try:
@@ -424,6 +466,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
             while not self.server.stopping.is_set():  # type: ignore[attr-defined]
+                if deadline is not None and time.monotonic() >= deadline:
+                    break  # server-side watch timeout: clean end of stream
                 ev = watch.poll(timeout=0.1)
                 if ev is None:
                     if watch.closed:

