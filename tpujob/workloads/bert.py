@@ -174,6 +174,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="size of the sequence (ring attention) axis")
     p.add_argument("--no-remat", dest="remat", action="store_false", default=True)
     p.add_argument("--log-interval", type=int, default=20)
+    train_lib.add_profile_flags(p)
     p.add_argument("--checkpoint-interval", type=int, default=0,
                    help="steps between checkpoints; 0 disables")
     p.add_argument("--dir", default="logs")
@@ -268,15 +269,20 @@ def run(args, mesh=None) -> Dict[str, Any]:
     # AOT compile instead of warmup steps: no optimizer updates happen
     # outside the counted loop, so a resumed run is step-exact
     compiled = train_step.lower(state, batch).compile()
+    profiler = train_lib.profiler_from_args(args, pe)
     t0 = time.perf_counter()
     loss = None
-    for i in range(start_step, args.steps):
-        state, loss = compiled(state, batch)
-        if i % args.log_interval == 0:
-            writer.add_scalar("loss", float(loss), i)
-        if ckpt and args.checkpoint_interval and (i + 1) % args.checkpoint_interval == 0:
-            ckpt.save(i + 1, state)
-    jax.block_until_ready(loss)
+    try:
+        for i in range(start_step, args.steps):
+            profiler.step(i - start_step, block_on=loss)
+            state, loss = compiled(state, batch)
+            if i % args.log_interval == 0:
+                writer.add_scalar("loss", float(loss), i)
+            if ckpt and args.checkpoint_interval and (i + 1) % args.checkpoint_interval == 0:
+                ckpt.save(i + 1, state)
+        jax.block_until_ready(loss)
+    finally:
+        profiler.close(block_on=loss)
     wall = time.perf_counter() - t0
     steps_run = args.steps - start_step
     sps = steps_run * args.batch_size / wall

@@ -63,19 +63,26 @@ def eval_metrics(params: Any, batch):
     return loss_sum, correct
 
 
-def train_epoch(args, state, train_step, mesh, train_x, train_y, epoch, writer, pe):
+def train_epoch(args, state, train_step, mesh, train_x, train_y, epoch, writer, pe,
+                profiler=None):
     n = len(train_x) - len(train_x) % args.batch_size
     steps_per_epoch = n // args.batch_size
     # every host iterates the same global batch order (same seed) and feeds
     # only its own rows — the DistributedSampler split, TPU-style
     lo, sz = dist.local_batch_slice(args.batch_size, pe)
-    last_loss = None
+    last_loss, prev_loss = None, None
     for batch_idx, (bx, by) in enumerate(
         datalib.batches(train_x, train_y, args.batch_size, seed=args.seed + epoch)
     ):
+        if profiler is not None:
+            # block_on the previous step's DEVICE output: dispatch is
+            # async and the trace must cover actual execution
+            profiler.step((epoch - 1) * steps_per_epoch + batch_idx,
+                          block_on=prev_loss)
         state, loss = train_step(
             state, train_lib.put_batch((bx[lo : lo + sz], by[lo : lo + sz]), mesh)
         )
+        prev_loss = loss
         if batch_idx % args.log_interval == 0:
             loss_v = float(loss)
             print(
@@ -138,6 +145,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "digits = real offline UCI handwritten digits")
     p.add_argument("--train-size", type=int, default=60000)
     p.add_argument("--test-size", type=int, default=10000)
+    train_lib.add_profile_flags(p)
     return p
 
 
@@ -170,14 +178,19 @@ def run(args, mesh=None) -> Dict[str, Any]:
     eval_step = train_lib.make_eval_step(eval_metrics, mesh)
 
     accuracy, last_loss = 0.0, None
+    profiler = train_lib.profiler_from_args(args, pe)
     t0 = time.perf_counter()
-    for epoch in range(1, args.epochs + 1):
-        state, last_loss = train_epoch(
-            args, state, train_step, mesh, train_x, train_y, epoch, writer, pe
-        )
-        accuracy = test_epoch(
-            args, state, eval_step, mesh, test_x, test_y, epoch, writer, pe
-        )
+    try:
+        for epoch in range(1, args.epochs + 1):
+            state, last_loss = train_epoch(
+                args, state, train_step, mesh, train_x, train_y, epoch, writer, pe,
+                profiler=profiler,
+            )
+            accuracy = test_epoch(
+                args, state, eval_step, mesh, test_x, test_y, epoch, writer, pe
+            )
+    finally:
+        profiler.close()
     wall = time.perf_counter() - t0
 
     if args.save_model:

@@ -126,6 +126,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-bf16", dest="bf16", action="store_false")
     p.add_argument("--log-interval", type=int, default=20)
     p.add_argument("--dir", default="logs")
+    train_lib.add_profile_flags(p)
     return p
 
 
@@ -158,12 +159,17 @@ def run(args, mesh=None) -> Dict[str, Any]:
         state, loss = train_step(state, batch)
     if loss is not None:
         jax.block_until_ready(loss)
+    profiler = train_lib.profiler_from_args(args, pe)
     t0 = time.perf_counter()
-    for i in range(args.steps):
-        state, loss = train_step(state, batch)
-        if i % args.log_interval == 0:
-            writer.add_scalar("loss", float(loss), i)
-    jax.block_until_ready(loss)
+    try:
+        for i in range(args.steps):
+            profiler.step(i, block_on=loss)
+            state, loss = train_step(state, batch)
+            if i % args.log_interval == 0:
+                writer.add_scalar("loss", float(loss), i)
+        jax.block_until_ready(loss)
+    finally:
+        profiler.close(block_on=loss)
     wall = time.perf_counter() - t0
     sps = args.steps * args.batch_size / wall
     writer.close()

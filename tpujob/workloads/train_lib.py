@@ -58,6 +58,93 @@ class SummaryWriter:
             self._f = None
 
 
+class Profiler:
+    """``--profile-dir`` hook: wraps N steady-state steps in
+    ``jax.profiler.start_trace``/``stop_trace``.
+
+    The TPU-first observability story the reference lacks: its only
+    profiling is workload-side tensorboardX scalars plus cAdvisor container
+    dashboards (``docs/monitoring/README.md:17-46``,
+    ``examples/mnist/mnist.py:6,108``).  A JAX trace captures the XLA/TPU
+    timeline (MXU utilization, HBM transfers, collective overlap) viewable
+    in TensorBoard's profile plugin or Perfetto.
+
+    Skips the first ``start_step`` steps (compilation/warmup would drown
+    the steady state); only process 0 traces by default.  Call ``step(i)``
+    at each loop iteration top and ``close()`` after the loop.
+    """
+
+    def __init__(
+        self,
+        profile_dir: Optional[str],
+        start_step: int = 2,
+        num_steps: int = 3,
+        enabled: Optional[bool] = None,
+    ):
+        if enabled is None:
+            enabled = dist.process_env().process_id == 0
+        self.profile_dir = profile_dir
+        self.start_step = start_step
+        self.stop_step = start_step + max(1, num_steps)
+        self.enabled = bool(profile_dir) and enabled
+        self._active = False
+
+    def step(self, step: int, block_on=None) -> None:
+        """Call at each loop iteration top; ``block_on`` is the previous
+        step's output — JAX dispatch is async, so the trace must wait for
+        the traced steps to actually execute on device before stopping, or
+        it captures host-side dispatch with an empty device timeline."""
+        if not self.enabled:
+            return
+        if not self._active and self.start_step <= step < self.stop_step:
+            jax.profiler.start_trace(self.profile_dir)
+            self._active = True
+        elif self._active and step >= self.stop_step:
+            self._finish(block_on)
+
+    def close(self, block_on=None) -> None:
+        """Stop an in-flight trace (short runs that never reach stop_step,
+        or an exception inside the window — call from finally: the profiler
+        is process-global, and a leaked trace poisons the next run)."""
+        if self._active:
+            self._finish(block_on)
+
+    def _finish(self, block_on=None) -> None:
+        self._active = False
+        self.enabled = False  # one trace window per run
+        try:
+            if block_on is not None:
+                jax.block_until_ready(block_on)
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # never let trace teardown kill training
+                import logging
+
+                logging.getLogger("tpujob.workloads").warning(
+                    "profiler stop_trace failed: %s", e)
+
+
+def add_profile_flags(parser) -> None:
+    """The shared --profile-* surface for every workload CLI."""
+    parser.add_argument("--profile-dir", default=None,
+                        help="write a jax.profiler trace of steady-state "
+                             "steps here (TensorBoard profile plugin format)")
+    parser.add_argument("--profile-start-step", type=int, default=2,
+                        help="first step of the trace window (skips compile)")
+    parser.add_argument("--profile-steps", type=int, default=3,
+                        help="number of steps to trace")
+
+
+def profiler_from_args(args, pe) -> Profiler:
+    return Profiler(
+        getattr(args, "profile_dir", None),
+        start_step=getattr(args, "profile_start_step", 2),
+        num_steps=getattr(args, "profile_steps", 3),
+        enabled=pe.process_id == 0,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Train state + step
 # ---------------------------------------------------------------------------
