@@ -281,9 +281,10 @@ def run(args, mesh=None) -> Dict[str, Any]:
             if ckpt and args.checkpoint_interval and (i + 1) % args.checkpoint_interval == 0:
                 ckpt.save(i + 1, state)
         jax.block_until_ready(loss)
+        # timed region ends before trace serialization in the finally
+        wall = time.perf_counter() - t0
     finally:
         profiler.close(block_on=loss)
-    wall = time.perf_counter() - t0
     steps_run = args.steps - start_step
     sps = steps_run * args.batch_size / wall
     tps = sps * args.seq_len

@@ -91,7 +91,9 @@ def train_epoch(args, state, train_step, mesh, train_x, train_y, epoch, writer, 
                     100.0 * batch_idx / steps_per_epoch, loss_v,
                 )
             )
-            writer.add_scalar("loss", loss_v, epoch * steps_per_epoch + batch_idx)
+            # 0-based global step, consistent with the profiler's indexing
+            writer.add_scalar("loss", loss_v,
+                              (epoch - 1) * steps_per_epoch + batch_idx)
             last_loss = loss_v
     return state, last_loss
 
@@ -189,9 +191,10 @@ def run(args, mesh=None) -> Dict[str, Any]:
             accuracy = test_epoch(
                 args, state, eval_step, mesh, test_x, test_y, epoch, writer, pe
             )
+        # timed region ends before trace serialization in the finally
+        wall = time.perf_counter() - t0
     finally:
-        profiler.close()
-    wall = time.perf_counter() - t0
+        profiler.close(block_on=state)
 
     if args.save_model:
         # collective: every process participates in the orbax save (each

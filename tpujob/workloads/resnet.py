@@ -168,9 +168,10 @@ def run(args, mesh=None) -> Dict[str, Any]:
             if i % args.log_interval == 0:
                 writer.add_scalar("loss", float(loss), i)
         jax.block_until_ready(loss)
+        # timed region ends before trace serialization in the finally
+        wall = time.perf_counter() - t0
     finally:
         profiler.close(block_on=loss)
-    wall = time.perf_counter() - t0
     sps = args.steps * args.batch_size / wall
     writer.close()
     if pe.process_id == 0:

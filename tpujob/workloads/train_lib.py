@@ -71,7 +71,11 @@ class Profiler:
 
     Skips the first ``start_step`` steps (compilation/warmup would drown
     the steady state); only process 0 traces by default.  Call ``step(i)``
-    at each loop iteration top and ``close()`` after the loop.
+    at each loop iteration top and ``close()`` after the loop.  In
+    epoch-style loops a window larger than one epoch keeps tracing until
+    the step count is reached in the next epoch, so whatever runs between
+    (eval, checkpointing) appears in the trace — by design, that IS the
+    steady state of such a loop.
     """
 
     def __init__(
