@@ -98,13 +98,19 @@ def main() -> int:
     # recorded rounds swung 1.78M / 1.60M / 2.04M (-10%/+28%) with no
     # variance reported, so a 20% regression was invisible.
     WINDOW_S, MIN_WINDOWS, MIN_TOTAL_S = 1.0, 5, 5.0
+    # Multi-host: wall-clock-bounded loops would dispatch DIFFERENT step
+    # counts per process and desynchronize the collective streams (hang or
+    # mispair all-reduces), so every process runs the same fixed step count
+    # per window.  Single-host keeps the adaptive wall-clock window.
+    fixed_steps = 500 if pe.num_processes > 1 else None
     windows = []  # (steps, seconds)
     t0 = time.perf_counter()
     while (time.perf_counter() - t0 < MIN_TOTAL_S
            or len(windows) < MIN_WINDOWS):
         w0 = time.perf_counter()
         w_steps = 0
-        while time.perf_counter() - w0 < WINDOW_S or w_steps < 5:
+        while (w_steps < fixed_steps if fixed_steps
+               else (time.perf_counter() - w0 < WINDOW_S or w_steps < 5)):
             state, loss = step(state, b)
             w_steps += 1
         jax.block_until_ready(loss)  # drain inside the window

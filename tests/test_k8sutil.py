@@ -1,0 +1,27 @@
+"""k8sutil helper tests (reference k8sutil.go:95-123 semantics)."""
+from tpujob.kube.k8sutil import filter_active_pods, filter_pod_count, is_pod_active
+from tpujob.kube.objects import Pod
+
+
+def pod(phase: str, deleting: bool = False) -> Pod:
+    p = Pod.from_dict({"metadata": {"name": f"p-{phase.lower()}"},
+                       "status": {"phase": phase}})
+    if deleting:
+        p.metadata.deletion_timestamp = "2026-01-01T00:00:00Z"
+    return p
+
+
+def test_active_excludes_terminal_and_terminating():
+    assert is_pod_active(pod("Running"))
+    assert is_pod_active(pod("Pending"))
+    assert not is_pod_active(pod("Succeeded"))
+    assert not is_pod_active(pod("Failed"))
+    assert not is_pod_active(pod("Running", deleting=True))
+
+
+def test_filters():
+    pods = [pod("Running"), pod("Pending"), pod("Failed"),
+            pod("Running", deleting=True)]
+    assert [p.status.phase for p in filter_active_pods(pods)] == ["Running", "Pending"]
+    assert filter_pod_count(pods, "Running") == 2
+    assert filter_pod_count(pods, "Succeeded") == 0

@@ -604,7 +604,13 @@ class TPUJobController(JobController):
         if policy == c.CLEAN_POD_POLICY_NONE:
             return
         for pod in pods:
-            if policy == c.CLEAN_POD_POLICY_RUNNING and pod.status.phase not in ("Running", "Pending"):
+            # Running policy deletes only phase==Running pods (job.go:165 —
+            # exact reference semantics: terminal AND Pending/Unknown pods
+            # stay for debugging).  Beyond the reference: a pod already
+            # carrying a deletionTimestamp is not re-deleted.
+            if policy == c.CLEAN_POD_POLICY_RUNNING and (
+                pod.status.phase != "Running" or pod.metadata.deletion_timestamp
+            ):
                 continue
             try:
                 self.pod_control.delete_pod(pod.metadata.namespace, pod.metadata.name, job)

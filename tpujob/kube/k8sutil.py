@@ -1,0 +1,35 @@
+"""Pod filtering helpers.
+
+Mirrors the reference's vendored k8s helpers
+(``vendor/github.com/kubeflow/tf-operator/pkg/util/k8sutil/k8sutil.go:95-123``):
+``FilterActivePods`` / ``FilterPodCount``.  In the reference these back the
+generic job-controller library; here the controller's own policies inline
+their exact reference conditions (cleanup matches job.go:165 verbatim,
+status counting matches status.go:172-182), so these helpers are the
+reference-parity surface for SDK users and tests — one shared definition
+of "active" rather than a production dependency.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from tpujob.kube.objects import Pod
+
+
+def is_pod_active(pod: Pod) -> bool:
+    """Active = not terminal and not already being deleted (k8sutil.go:103-110:
+    a pod with a deletionTimestamp is on its way out and must not be
+    re-deleted or counted as running capacity)."""
+    return (
+        pod.status.phase not in ("Succeeded", "Failed")
+        and not pod.metadata.deletion_timestamp
+    )
+
+
+def filter_active_pods(pods: List[Pod]) -> List[Pod]:
+    return [p for p in pods if is_pod_active(p)]
+
+
+def filter_pod_count(pods: List[Pod], phase: str) -> int:
+    """How many pods sit in ``phase`` (k8sutil.go:113-123)."""
+    return sum(1 for p in pods if p.status.phase == phase)
