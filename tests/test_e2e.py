@@ -110,6 +110,43 @@ def test_bert_preemption_resume():
     run_preemption_resume()
 
 
+def test_preemption_over_k8s_rest_transport():
+    """The ExitCode preemption path on the production client path: a worker
+    is SIGKILLed (137), the controller — wired through KubeApiTransport →
+    K8s-REST shim — deletes and recreates the pod, the job succeeds, and
+    the restart is accounted in status THROUGH the real REST status-
+    subresource writes (RV-conditioned PUT; the round-4 accounting)."""
+    from tests.k8sshim import K8sRestShim
+    from tpujob.kube.client import ClientSet
+    from tpujob.kube.kubetransport import KubeApiTransport, KubeConfig
+
+    scripts = [PodScript(match="worker-0", exit_codes=[137]),
+               PodScript(match="master", run_seconds=1.5)]
+    shim = K8sRestShim(token="e2e-token").start()
+    try:
+        transport = KubeApiTransport(
+            config=KubeConfig(host=shim.url, token="e2e-token"))
+        with E2ECluster(transport=transport,
+                        kubelet_clients=ClientSet(shim.backend),
+                        scripts=scripts) as cluster:
+            sdk = cluster.sdk
+            job = smoke_job("rest-preempt", workers=2)
+            for spec in job.spec.tpu_replica_specs.values():
+                spec.restart_policy = "ExitCode"
+            sdk.create(job)
+            got = sdk.wait_for_job("rest-preempt", timeout_seconds=60,
+                                   polling_interval=0.05)
+            assert any(cond.type == c.JOB_SUCCEEDED and cond.status == "True"
+                       for cond in got.status.conditions)
+            assert got.status.replica_statuses["Worker"].restarts == 1, (
+                got.status.to_dict())
+            # the count is what a kubectl get -o yaml user sees on the wire
+            raw = transport.get(c.PLURAL, "default", "rest-preempt")
+            assert raw["status"]["replicaStatuses"]["Worker"]["restarts"] == 1
+    finally:
+        shim.stop()
+
+
 def test_defaults_over_k8s_rest_transport():
     """The defaults scenario with the operator wired through the real-cluster
     transport (KubeApiTransport -> K8s-REST shim -> memserver), while the
