@@ -57,6 +57,46 @@ def test_http_watch_stream(http_api):
     watch.stop()
 
 
+def test_http_watch_resume_from_rv(http_api):
+    """The tpujob HTTP dialect supports resume-from-RV with a leading
+    BOOKMARK carrying the opening RV, like the K8s transport."""
+    client = HTTPApiClient(http_api.address)
+    w = client.watch("pods")
+    # the leading BOOKMARK is consumed synchronously: a valid resume point
+    # exists the moment watch() returns (informers read it immediately)
+    assert w.last_rv is not None
+    opening_rv = w.last_rv
+    w.stop()
+    # events land while disconnected...
+    client.create("pods", {"metadata": {"name": "missed-1"}})
+    client.create("pods", {"metadata": {"name": "missed-2"}})
+    # ...and replay on resume, in order, without a relist
+    w2 = client.watch("pods", resource_version=opening_rv)
+    evs = [w2.poll(timeout=2), w2.poll(timeout=2)]
+    assert [(e.type, e.object["metadata"]["name"]) for e in evs] == [
+        ("ADDED", "missed-1"), ("ADDED", "missed-2")]
+    w2.stop()
+
+
+def test_http_watch_compacted_rv_raises_gone():
+    """A compacted resume point answers 410 -> GoneError at watch(), so the
+    informer falls back to relist."""
+    from tpujob.kube.errors import GoneError
+    from tpujob.kube.memserver import InMemoryAPIServer
+
+    server = APIServerHTTP(backend=InMemoryAPIServer(history_size=2)).start()
+    try:
+        client = HTTPApiClient(server.address)
+        first = client.create("pods", {"metadata": {"name": "old"}})
+        for i in range(4):
+            client.create("pods", {"metadata": {"name": f"x{i}"}})
+        with pytest.raises(GoneError):
+            client.watch("pods",
+                         resource_version=first["metadata"]["resourceVersion"])
+    finally:
+        server.stop()
+
+
 def test_controller_over_http_transport(http_api):
     """The full reconcile loop across a real network boundary."""
     from tpujob.controller.reconciler import TPUJobController
@@ -139,7 +179,12 @@ def test_leader_failover_on_lease_expiry():
                        on_started_leading=lambda: leaders.append("op-2"))
     t1 = threading.Thread(target=e1.run, args=(stop1,), daemon=True)
     t1.start()
-    time.sleep(0.1)
+    # wait until op-1 actually leads before fielding a challenger — a fixed
+    # sleep let op-2 win the initial acquire under full-suite load (flake)
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and not e1.is_leader:
+        time.sleep(0.02)
+    assert e1.is_leader
     t2 = threading.Thread(target=e2.run, args=(stop2,), daemon=True)
     t2.start()
     time.sleep(0.1)

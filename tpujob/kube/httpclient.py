@@ -22,6 +22,7 @@ from tpujob.kube.errors import (
     AlreadyExistsError,
     ApiError,
     ConflictError,
+    GoneError,
     InvalidError,
     NotFoundError,
 )
@@ -39,6 +40,8 @@ def _raise_for(status: int, payload: Dict[str, Any]) -> None:
         raise ConflictError(message)
     if reason == "Invalid" or status == 422:
         raise InvalidError(message)
+    if reason in ("Expired", "Gone") or status == 410:
+        raise GoneError(message)
     raise ApiError(message or f"HTTP {status}")
 
 
@@ -49,13 +52,49 @@ class HTTPWatch:
     re-establish the watch instead of spinning on a frozen one.
     """
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, initial_rv: Optional[str] = None):
         self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
         self._stopped = threading.Event()
         self.closed = False
-        self._resp = urllib.request.urlopen(url)  # noqa: S310 (local trusted)
+        # always False for this dialect: a compacted resume point is a 410
+        # at CONNECT time (GoneError below), never a mid-stream event
+        self.gone = False
+        self.last_rv: Optional[str] = initial_rv
+        try:
+            self._resp = urllib.request.urlopen(url)  # noqa: S310 (local trusted)
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except ValueError:
+                payload = {}
+            _raise_for(e.code, payload)  # GoneError for a compacted resume point
+            raise  # _raise_for always raises; keep type-checkers honest
+        # consume the leading BOOKMARK synchronously so last_rv is a valid
+        # resume point the moment watch() returns (informers read it right
+        # away); any real first line is pushed to the queue instead
+        self._read_opening_bookmark()
         self._thread = threading.Thread(target=self._pump, daemon=True)
         self._thread.start()
+
+    def _read_opening_bookmark(self) -> None:
+        try:
+            while True:
+                raw = self._resp.readline()
+                if not raw:
+                    return  # stream ended before any line; pump flips closed
+                line = raw.strip()
+                if not line or line.startswith(b":"):
+                    continue  # keepalive
+                d = json.loads(line)
+                rv = ((d.get("object") or {}).get("metadata") or {}).get(
+                    "resourceVersion")
+                if rv:
+                    self.last_rv = str(rv)
+                if d["type"] != "BOOKMARK":
+                    self._q.put(WatchEvent(d["type"], "", d["object"]))
+                return
+        except Exception as e:
+            log.warning("watch stream: opening read failed: %s", e)
 
     def _pump(self) -> None:
         try:
@@ -70,6 +109,12 @@ class HTTPWatch:
                 except ValueError:
                     log.warning("watch stream: malformed line %r; closing", line[:200])
                     break
+                rv = ((d.get("object") or {}).get("metadata") or {}).get(
+                    "resourceVersion")
+                if rv:
+                    self.last_rv = str(rv)
+                if d["type"] == "BOOKMARK":
+                    continue  # carries the opening RV only, not an object
                 self._q.put(WatchEvent(d["type"], "", d["object"]))
         except Exception as e:
             if not self._stopped.is_set():
@@ -184,11 +229,16 @@ class HTTPApiClient:
     def delete(self, resource: str, namespace: str, name: str) -> None:
         self._request("DELETE", f"/api/{resource}/{namespace or 'default'}/{name}")
 
+    # watch() accepts resource_version with 410-Gone semantics, so
+    # informers resume after stream death instead of relisting
+    supports_resume = True
+
     def watch(
         self,
         resource: Optional[str] = None,
         send_initial: bool = False,
         namespace: Optional[str] = None,
+        resource_version: Optional[str] = None,
     ) -> HTTPWatch:
         if resource is None:
             raise InvalidError("HTTP transport requires a per-resource watch")
@@ -197,8 +247,12 @@ class HTTPApiClient:
             params.append("initial=1")
         if namespace:
             params.append(f"namespace={urllib.parse.quote(namespace)}")
+        if resource_version is not None:
+            params.append(
+                "resourceVersion=" + urllib.parse.quote(str(resource_version)))
         suffix = ("?" + "&".join(params)) if params else ""
-        return HTTPWatch(f"{self.base_url}/watch/{resource}{suffix}")
+        return HTTPWatch(f"{self.base_url}/watch/{resource}{suffix}",
+                         initial_rv=resource_version)
 
     def healthy(self) -> bool:
         try:

@@ -138,12 +138,28 @@ class _Handler(BaseHTTPRequestHandler):
     def _serve_watch(self, resource: str, query) -> None:
         initial = (query.get("initial") or ["0"])[0] in ("1", "true")
         ns = (query.get("namespace") or [None])[0]
-        watch = self.backend.watch(resource, send_initial=initial, namespace=ns)
+        rv = (query.get("resourceVersion") or [None])[0]
+        # resume-from-RV: replays events after rv, or raises GoneError
+        # (410 response via do_GET's error path) when compacted — the
+        # informer then relists
+        watch = self.backend.watch(
+            resource, send_initial=initial, namespace=ns, resource_version=rv
+        )
         try:
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
+            # leading bookmark: the RV this stream OPENED at (before any
+            # replay was queued), so a fresh watch has a valid resume point
+            # before any event — advertising a replayed-to RV would lose
+            # the replayed events if the connection died mid-delivery
+            bookmark = (json.dumps({
+                "type": "BOOKMARK",
+                "object": {"metadata": {"resourceVersion": watch.opening_rv}},
+            }) + "\n").encode()
+            self.wfile.write(f"{len(bookmark):x}\r\n".encode() + bookmark + b"\r\n")
+            self.wfile.flush()
             while not getattr(self.server, "_stopping", threading.Event()).is_set():
                 ev = watch.poll(timeout=0.2)
                 if ev is None:

@@ -70,9 +70,11 @@ class Watch:
         self._stopped = False
         self.closed = False  # True once the stream can deliver no more events
         self.gone = False  # parity with the REST watch surface
-        # newest RV delivered on the stream (opening RV until the first
+        # newest RV queued on the stream (opening RV until the first
         # event) — same semantics as _RestWatch.last_rv
         self.last_rv: Optional[str] = None
+        # RV the subscription opened at, before any replay was queued
+        self.opening_rv: Optional[str] = None
 
     def _put(self, ev: WatchEvent) -> None:
         if not self._stopped:
@@ -314,13 +316,22 @@ class InMemoryAPIServer:
         bounded history window, like an apiserver whose etcd compacted the
         revision — the caller must relist."""
         with self._lock:
+            if resource_version is not None and str(resource_version) == "0":
+                # K8s semantics: RV "0" = "any version" — serve the current
+                # state as synthetic ADDED events, then live
+                resource_version, send_initial = None, True
             w = Watch(self)
-            w.last_rv = (
+            # the stream's opening RV: the point the subscriber is synced to
+            # BEFORE any replay — the only safe resume point to advertise
+            # (last_rv advances as replayed events are queued, but queued
+            # is not delivered)
+            w.opening_rv = (
                 str(resource_version)
-                if resource_version is not None and str(resource_version) != "0"
+                if resource_version is not None
                 else str(self._rv)
             )
-            if resource_version is not None and str(resource_version) != "0":
+            w.last_rv = w.opening_rv
+            if resource_version is not None:
                 try:
                     since = int(resource_version)
                 except (TypeError, ValueError):
