@@ -299,6 +299,26 @@ def test_backoff_limit_exceeded():
     assert h.pod_names() == []  # CleanPodPolicy All
 
 
+def test_terminal_state_frozen_against_late_failures():
+    """A Succeeded job is terminal: later pod failures must not flip it
+    (controller.go:362-389 terminal early-return + status.go:226-272)."""
+    h = Harness()
+    h.submit(new_tpujob())
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    h.set_pod_phase("test-job", "Master", 0, "Succeeded")
+    h.sync()
+    assert h.check_condition(h.get_job(), c.JOB_SUCCEEDED)
+    # a worker dies after completion (e.g. node reclaimed)
+    h.set_pod_phase("test-job", "Worker", 1, "Failed", exit_code=1)
+    h.sync()
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_SUCCEEDED)
+    assert not h.check_condition(job, c.JOB_FAILED)
+    assert job.status.completion_time
+
+
 def test_active_deadline_exceeded():
     h = Harness()
     h.submit(new_tpujob(active_deadline=0))
