@@ -17,7 +17,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -85,42 +84,28 @@ def main() -> int:
     x, y = x[lo : lo + sz], y[lo : lo + sz]
     b = train_lib.put_batch(((x - datalib.MEAN) / datalib.STD, y), mesh)
 
+    from tpujob.workloads.benchlib import measure_windows
+
     state, loss = step(state, b)  # compile
     jax.block_until_ready(loss)
-    # Steady state for >= 5 s in >= 5 WINDOWS of ~1 s each.  Each window
-    # dispatches asynchronously and then drains (block_until_ready) with
-    # the drain INSIDE the window's wall time, so a window is an honest
-    # end-to-end throughput sample.  Windows, not per-step or small-chunk
-    # syncing: a device sync over the tunneled connection costs ~100 ms —
-    # three orders of magnitude more than a step — so fine-grained syncing
-    # measures the tunnel, not the TPU.  The across-window stddev is what
-    # makes a real regression distinguishable from run-to-run noise —
-    # recorded rounds swung 1.78M / 1.60M / 2.04M (-10%/+28%) with no
-    # variance reported, so a 20% regression was invisible.
-    WINDOW_S, MIN_WINDOWS, MIN_TOTAL_S = 1.0, 5, 5.0
-    # Multi-host: wall-clock-bounded loops would dispatch DIFFERENT step
-    # counts per process and desynchronize the collective streams (hang or
-    # mispair all-reduces), so every process runs the same fixed step count
-    # per window.  Single-host keeps the adaptive wall-clock window.
-    fixed_steps = 500 if pe.num_processes > 1 else None
-    windows = []  # (steps, seconds)
-    t0 = time.perf_counter()
-    while (time.perf_counter() - t0 < MIN_TOTAL_S
-           or len(windows) < MIN_WINDOWS):
-        w0 = time.perf_counter()
-        w_steps = 0
-        while (w_steps < fixed_steps if fixed_steps
-               else (time.perf_counter() - w0 < WINDOW_S or w_steps < 5)):
-            state, loss = step(state, b)
-            w_steps += 1
-        jax.block_until_ready(loss)  # drain inside the window
-        windows.append((w_steps, time.perf_counter() - w0))
-    wall = time.perf_counter() - t0
-    steps = sum(w for w, _ in windows)
 
-    step_ms = [s / w * 1e3 for w, s in windows]
-    mean_ms = sum(step_ms) / len(step_ms)
-    std_ms = (sum((m - mean_ms) ** 2 for m in step_ms) / (len(step_ms) - 1)) ** 0.5
+    def run_one():
+        nonlocal state, loss
+        state, loss = step(state, b)
+        return loss
+
+    # Steady state for >= 5 s in >= 5 windows of ~1 s (method + rationale:
+    # tpujob/workloads/benchlib.py).  The stddev is what makes a real
+    # regression distinguishable from run-to-run noise — recorded rounds
+    # swung 1.78M / 1.60M / 2.04M (-10%/+28%) with no variance reported,
+    # so a 20% regression was invisible.  Multi-host runs use a fixed step
+    # count per window to keep the collective streams aligned.
+    stats = measure_windows(
+        run_one, window_s=1.0, min_windows=5, min_total_s=5.0,
+        fixed_steps=500 if pe.num_processes > 1 else None,
+    )
+    steps, wall = stats.steps, stats.wall_s
+    mean_ms, std_ms = stats.mean_s * 1e3, stats.std_s * 1e3
     sps_per_chip = steps * batch / wall / n_chips
     print(json.dumps({
         "metric": "mnist_train_samples_per_sec_per_chip",

@@ -23,7 +23,6 @@ import json
 import os
 import subprocess
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
@@ -63,19 +62,36 @@ def compiled_flops(compiled, fallback: float | None) -> float | None:
 
 
 def time_compiled(compiled, state, batch, seconds: float, min_steps: int = 5):
-    """Steady-state wall time per step (state donated through the loop)."""
+    """Steady-state wall time per step (state donated through the loop).
+
+    Shares bench.py's windowed measurement (tpujob/workloads/benchlib.py):
+    windows of >= 1 s so the ~100 ms tunnel drain amortizes, total step
+    floor spread across windows, stddev across windows.  Returns
+    (mean_sec_per_step, total_steps, std_sec_per_step)."""
     import jax
+
+    from tpujob.workloads.benchlib import measure_windows
 
     state, loss = compiled(state, batch)  # ensure no lazy work remains
     jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    steps = 0
-    while time.perf_counter() - t0 < seconds or steps < min_steps:
+
+    def run_one():
+        nonlocal state, loss
         state, loss = compiled(state, batch)
-        steps += 1
-    jax.block_until_ready(loss)
-    wall = time.perf_counter() - t0
-    return wall / steps, steps
+        return loss
+
+    # ~1 s windows when the budget allows (amortizes the ~100 ms tunnel
+    # drain); sub-2 s budgets (--quick smoke) split into 2 shorter windows
+    # — their stddev is drain-inflated, which the steps/std fields expose
+    n_windows = max(2, int(seconds))
+    stats = measure_windows(
+        run_one,
+        window_s=seconds / n_windows,
+        min_windows=n_windows,
+        min_total_s=seconds,
+        min_steps_per_window=max(1, -(-min_steps // n_windows)),
+    )
+    return stats.mean_s, stats.steps, stats.std_s
 
 
 def bench_resnet50(quick: bool) -> dict:
@@ -107,7 +123,7 @@ def bench_resnet50(quick: bool) -> dict:
     b = train_lib.put_batch((x, y), mesh)
     compiled = step.lower(state, b).compile()
 
-    sec_per_step, steps = time_compiled(compiled, state, b, 1.0 if quick else 4.0)
+    sec_per_step, steps, std = time_compiled(compiled, state, b, 1.0 if quick else 4.0)
     sps = batch / sec_per_step
     # fwd ≈ 4.09 GFLOP / 224px image (MAC=2 convention); train ≈ 3x fwd
     flops = compiled_flops(compiled, 3 * 4.09e9 * batch)
@@ -118,7 +134,9 @@ def bench_resnet50(quick: bool) -> dict:
         "unit": "samples/s/chip",
         "global_batch": batch,
         "chips": n_chips,
+        "steps": steps,
         "step_ms": round(sec_per_step * 1e3, 2),
+        "step_ms_std": round(std * 1e3, 3),
         "platform": jax.devices()[0].device_kind,
     }
     if flops:
@@ -169,7 +187,7 @@ def bench_bert_large(quick: bool) -> dict:
     compiled = step.lower(state, b).compile()
 
     n_params = sum(x.size for x in jax.tree.leaves(params))
-    sec_per_step, steps = time_compiled(compiled, state, b, 1.0 if quick else 4.0)
+    sec_per_step, steps, std = time_compiled(compiled, state, b, 1.0 if quick else 4.0)
     sps = batch / sec_per_step
     tps = sps * seq
     # 6 * params * tokens (fwd+bwd dense transformer estimate); remat adds
@@ -185,7 +203,9 @@ def bench_bert_large(quick: bool) -> dict:
         "seq_len": seq,
         "params_m": round(n_params / 1e6, 1),
         "chips": n_chips,
+        "steps": steps,
         "step_ms": round(sec_per_step * 1e3, 2),
+        "step_ms_std": round(std * 1e3, 3),
         "platform": jax.devices()[0].device_kind,
     }
     if flops:
@@ -242,7 +262,7 @@ def _scaling_child(quick: bool) -> dict:
         ids, mask = bertlib.mask_batch(ids, 0)
         b = train_lib.put_batch((ids, mask), mesh)
         compiled = step.lower(state, b).compile()
-        sec, _ = time_compiled(compiled, state, b, 1.0 if quick else 3.0)
+        sec, _, _ = time_compiled(compiled, state, b, 1.0 if quick else 3.0)
         times[n] = sec
     return {
         "metric": "dp_sharding_overhead_8dev_vs_1dev",

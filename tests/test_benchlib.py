@@ -1,0 +1,37 @@
+"""benchlib windowed-measurement tests (shared by bench.py/bench_models.py)."""
+from tpujob.workloads.benchlib import measure_windows
+
+
+def test_fixed_steps_exact_counts():
+    """fixed_steps runs exactly N steps per window — the multi-host
+    determinism contract (unequal counts desynchronize collectives)."""
+    calls = []
+
+    def run_one():
+        calls.append(1)
+        return None
+
+    # min_total_s deliberately huge: with fixed_steps the window COUNT is
+    # deterministic too (exactly min_windows), or multi-host processes
+    # could run different window counts and desynchronize collectives
+    stats = measure_windows(run_one, fixed_steps=7, min_windows=3,
+                            min_total_s=3600.0)
+    assert stats.steps == len(calls) == 21
+    assert len(stats.per_window_s) == 3
+    assert stats.wall_s > 0 and stats.mean_s > 0
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        measure_windows(run_one, fixed_steps=0)
+
+
+def test_min_bounds_and_stats():
+    stats = measure_windows(lambda: None, window_s=0.01, min_windows=5,
+                            min_total_s=0.05, min_steps_per_window=2)
+    assert len(stats.per_window_s) >= 5
+    assert stats.steps >= 10  # >= 2 steps per window
+    # sample stats centered on the per-window mean
+    mean = sum(stats.per_window_s) / len(stats.per_window_s)
+    assert abs(stats.mean_s - mean) < 1e-12
+    assert stats.std_s >= 0.0

@@ -1,0 +1,92 @@
+"""Shared steady-state measurement for the benchmarks.
+
+One implementation of the windowed dispatch/drain timing used by both
+``bench.py`` (the driver headline metric) and ``bench_models.py`` (the
+flagship models), so the two can never silently measure differently.
+
+Method: N async windows, each dispatching steps without syncing and then
+draining (``jax.block_until_ready``) INSIDE its own wall time — a window is
+an honest end-to-end throughput sample.  Windows, not per-step or
+small-chunk syncing: one device sync over the tunneled connection costs
+~100 ms, orders of magnitude more than a step, so fine-grained syncing
+measures the tunnel, not the TPU.  The across-window stddev is what makes
+a real regression distinguishable from the shared device's 10-30%
+run-to-run noise.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+
+@dataclass
+class WindowStats:
+    steps: int          # total steps across all windows
+    wall_s: float       # total measured wall time (drains included)
+    mean_s: float       # sample mean of per-window seconds-per-step
+    std_s: float        # sample stddev of per-window seconds-per-step
+    per_window_s: List[float]  # seconds-per-step of each window
+
+    @property
+    def throughput_steps_per_s(self) -> float:
+        return self.steps / self.wall_s
+
+
+def measure_windows(
+    run_step: Callable[[], object],
+    *,
+    window_s: float = 1.0,
+    min_windows: int = 5,
+    min_total_s: float = 5.0,
+    min_steps_per_window: int = 5,
+    fixed_steps: Optional[int] = None,
+) -> WindowStats:
+    """Time ``run_step`` (dispatch one async step, return something to
+    drain on) in windows of ~``window_s`` seconds.
+
+    ``fixed_steps``: run exactly that many steps per window, and exactly
+    ``min_windows`` windows (``min_total_s`` is ignored) — REQUIRED for
+    multi-process benchmarks, where ANY wall-clock-bounded loop (step
+    count or window count) dispatches unequal collective counts per
+    process and desynchronizes the streams (mispaired or hanging
+    all-reduces).
+    """
+    import jax
+
+    if fixed_steps is not None and fixed_steps <= 0:
+        raise ValueError(f"fixed_steps must be positive, got {fixed_steps}")
+
+    windows: List[tuple] = []  # (steps, seconds)
+    t0 = time.perf_counter()
+    while (
+        len(windows) < min_windows
+        if fixed_steps is not None  # deterministic window count
+        else (time.perf_counter() - t0 < min_total_s
+              or len(windows) < min_windows)
+    ):
+        w0 = time.perf_counter()
+        w_steps = 0
+        drain = None
+        while (w_steps < fixed_steps if fixed_steps is not None
+               else (time.perf_counter() - w0 < window_s
+                     or w_steps < min_steps_per_window)):
+            drain = run_step()
+            w_steps += 1
+        jax.block_until_ready(drain)  # drain inside the window
+        windows.append((w_steps, time.perf_counter() - w0))
+    wall = time.perf_counter() - t0
+
+    per_step = [s / w for w, s in windows]
+    mean = sum(per_step) / len(per_step)
+    std = (
+        (sum((s - mean) ** 2 for s in per_step) / (len(per_step) - 1)) ** 0.5
+        if len(per_step) > 1 else 0.0
+    )
+    return WindowStats(
+        steps=sum(w for w, _ in windows),
+        wall_s=wall,
+        mean_s=mean,
+        std_s=std,
+        per_window_s=per_step,
+    )
