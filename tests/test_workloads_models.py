@@ -59,6 +59,46 @@ class TestRingAttention:
         assert np.isfinite(np.asarray(g)).all()
 
 
+class TestUlyssesAttention:
+    def _qkv(self, b=2, s=32, h=8, d=8, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        shape = (b, s, h, d)
+        return tuple(jax.random.normal(k, shape) for k in ks)
+
+    def test_matches_full_attention(self):
+        q, k, v = self._qkv()
+        mesh = dist.make_mesh({"sequence": 8}, env=cpu_env())
+        out = parallel.ulysses_attention(q, k, v, mesh)
+        full = parallel.full_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(full),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_matches_full_attention_causal(self):
+        q, k, v = self._qkv(seed=3)
+        mesh = dist.make_mesh({"sequence": 8}, env=cpu_env())
+        out = parallel.ulysses_attention(q, k, v, mesh, causal=True)
+        full = parallel.full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(full),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_matches_ring(self):
+        """The two SP implementations are interchangeable numerics."""
+        q, k, v = self._qkv(seed=7)
+        mesh = dist.make_mesh({"data": 2, "sequence": 4}, env=cpu_env())
+        uly = parallel.ulysses_attention(q, k, v, mesh)
+        ring = parallel.ring_attention(q, k, v, mesh)
+        np.testing.assert_allclose(np.asarray(uly), np.asarray(ring),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_heads_must_divide(self):
+        import pytest
+
+        q, k, v = self._qkv(h=4)  # 4 heads on an 8-way sequence axis
+        mesh = dist.make_mesh({"sequence": 8}, env=cpu_env())
+        with pytest.raises(ValueError, match="divisible"):
+            parallel.ulysses_attention(q, k, v, mesh)
+
+
 class TestPartitionRules:
     def test_spec_tree_by_regex(self):
         params = {"layer_0": {"attn": {"query": {"kernel": jnp.zeros((4, 4)),
@@ -110,6 +150,20 @@ class TestBert:
         r_dp = bertlib.run(tiny_bert_args(tmp_path, steps=2))
         r_sp = bertlib.run(tiny_bert_args(tmp_path, steps=2, sequence_parallel=4))
         assert abs(r_dp["final_loss"] - r_sp["final_loss"]) < 1e-3
+
+    def test_ulysses_attention_path_matches(self, tmp_path):
+        r_dp = bertlib.run(tiny_bert_args(tmp_path, steps=2))
+        r_uly = bertlib.run(tiny_bert_args(tmp_path, steps=2,
+                                           sequence_parallel=4,
+                                           sp_mode="ulysses"))
+        assert abs(r_dp["final_loss"] - r_uly["final_loss"]) < 1e-3
+
+    def test_ulysses_rejects_tensor_parallel(self, tmp_path):
+        import pytest
+
+        with pytest.raises(ValueError, match="ulysses"):
+            bertlib.run(tiny_bert_args(tmp_path, steps=1, sequence_parallel=2,
+                                       tensor_parallel=2, sp_mode="ulysses"))
 
     def test_profile_dir_writes_trace(self, tmp_path):
         """--profile-dir wraps steady-state steps in jax.profiler traces; a

@@ -171,7 +171,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tensor-parallel", type=int, default=1,
                    help="size of the tensor axis")
     p.add_argument("--sequence-parallel", type=int, default=1,
-                   help="size of the sequence (ring attention) axis")
+                   help="size of the sequence-parallel axis")
+    p.add_argument("--sp-mode", choices=["ring", "ulysses"], default="ring",
+                   help="sequence-parallel attention: ring (ppermute K/V, "
+                        "composes with TP, O(S/n) memory) or ulysses "
+                        "(all-to-all, 2 collectives, full S per device)")
     p.add_argument("--no-remat", dest="remat", action="store_false", default=True)
     p.add_argument("--log-interval", type=int, default=20)
     train_lib.add_profile_flags(p)
@@ -193,10 +197,20 @@ def make_mesh_for(args, pe):
 def build_model(args, mesh) -> Bert:
     attention_fn = None
     if "sequence" in mesh.axis_names and mesh.shape["sequence"] > 1:
-        attention_fn = lambda q, k, v: parallel.ring_attention(
-            q, k, v, mesh, axis="sequence",
-            head_axis="tensor" if "tensor" in mesh.axis_names else None,
-        )
+        if getattr(args, "sp_mode", "ring") == "ulysses":
+            if "tensor" in mesh.axis_names:
+                raise ValueError(
+                    "--sp-mode=ulysses does not compose with "
+                    "--tensor-parallel (the all_to_all consumes the head "
+                    "dim); use --sp-mode=ring for SP x TP")
+            attention_fn = lambda q, k, v: parallel.ulysses_attention(
+                q, k, v, mesh, axis="sequence",
+            )
+        else:
+            attention_fn = lambda q, k, v: parallel.ring_attention(
+                q, k, v, mesh, axis="sequence",
+                head_axis="tensor" if "tensor" in mesh.axis_names else None,
+            )
     return Bert(
         vocab=args.vocab, hidden=args.hidden, layers=args.layers,
         heads=args.heads, intermediate=args.intermediate, max_seq=args.seq_len,

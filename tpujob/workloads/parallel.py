@@ -8,11 +8,17 @@ slice scale:
 - **Tensor parallelism**: rule-based parameter partition specs; XLA/GSPMD
   inserts the per-layer collectives from the annotations (no hand-written
   all-reduces).
-- **Sequence/context parallelism**: ring attention — K/V blocks rotate
-  around the ICI ring via ``ppermute`` while each device keeps a
-  flash-attention-style running softmax over its Q shard, so attention over
-  a sequence of length S costs O(S/n) memory per device and overlaps
-  compute with neighbour exchange.  This is the long-context story.
+- **Sequence/context parallelism**, two interchangeable implementations
+  (the long-context story):
+
+  * ring attention — K/V blocks rotate around the ICI ring via
+    ``ppermute`` while each device keeps a flash-attention-style running
+    softmax over its Q shard: O(S/n) memory per device, compute overlapped
+    with neighbour exchange, composes with a tensor-parallel head split.
+  * Ulysses (all-to-all) — one ``all_to_all`` re-shards sequence → heads,
+    dense attention runs locally over the full sequence, a second
+    ``all_to_all`` restores the layout: 2 collectives instead of n hops,
+    best at moderate S with heads ≥ the axis size.
 
 All collective layout follows the mesh built by
 ``tpujob.workloads.distributed.make_mesh`` (data slowest / tensor+sequence
@@ -102,6 +108,16 @@ def _block_attention(q, k, v, bias, m_prev, l_prev, o_prev, scale):
     return m_new, l_new, o_new
 
 
+def _sp_batch_axis(mesh, batch_size: int) -> Optional[str]:
+    """Mesh axis for the batch dim inside a sequence-parallel manual region:
+    keep it split over 'data' (an unsharded first dim would force an
+    all-gather of the whole batch), but skip when the static batch doesn't
+    divide it — e.g. batch-1 traces during model.init."""
+    if "data" in mesh.axis_names and batch_size % mesh.shape["data"] == 0:
+        return "data"
+    return None
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -163,16 +179,62 @@ def ring_attention(
         out = o / l[..., None]
         return out.transpose(0, 2, 1, 3)  # [b, sq, h, d]
 
-    # batch stays split over the data axis inside the manual region (an
-    # unsharded first dim would force an all-gather of the whole batch);
-    # skipped when the static batch doesn't divide it (e.g. batch-1 traces
-    # during model.init)
-    batch_axis = (
-        "data"
-        if "data" in mesh.axis_names and q.shape[0] % mesh.shape["data"] == 0
-        else None
-    )
-    spec = P(batch_axis, axis, head_axis, None)
+    spec = P(_sp_batch_axis(mesh, q.shape[0]), axis, head_axis, None)
+    return shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    axis: str = "sequence",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
+
+    Same contract as :func:`ring_attention` — inputs [batch, seq, heads,
+    head_dim] with seq sharded over ``axis``, exact results — but a
+    different collective shape: ONE ``all_to_all`` re-shards sequence →
+    heads (each device then holds the FULL sequence for ``heads/n`` heads),
+    dense attention runs locally with ordinary global-position masking, and
+    a second ``all_to_all`` restores the sequence sharding.
+
+    Trade-off vs the ring: 2 collectives per attention instead of n
+    ``ppermute`` hops (lower latency at moderate S), but the full sequence
+    must fit per device and heads must divide by the axis size — when S/n
+    is the memory bound or heads are scarce, the ring wins.  Does not
+    compose with a tensor-parallel head split (the head dim is already
+    consumed by the all_to_all); use the ring for SP×TP.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = mesh.shape[axis]
+    heads = q.shape[2]
+    if heads % n != 0:
+        raise ValueError(
+            f"ulysses attention needs heads ({heads}) divisible by the "
+            f"{axis!r} axis size ({n}); use ring_attention otherwise"
+        )
+
+    def local(qb, kb, vb):
+        # one collective for all three tensors: stack to [3, b, s/n, h, d]
+        # and all_to_all seq -> heads (axes shifted +1 by the stack dim)
+        qkv = jax.lax.all_to_all(
+            jnp.stack((qb, kb, vb)), axis, split_axis=3, concat_axis=2,
+            tiled=True,
+        )  # [3, b, s, h/n, d]
+        out = full_attention(qkv[0], qkv[1], qkv[2], causal=causal, scale=scale)
+        # [b, s, h/n, d] -> [b, s/n, h, d]
+        return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    spec = P(_sp_batch_axis(mesh, q.shape[0]), axis, None, None)
     return shard_map(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
