@@ -5,6 +5,7 @@ TPU-first extensions: ring attention exactness, rule-based TP partitioning,
 and strategy-equivalence (TP/SP runs must match pure-DP numerics).
 """
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -91,8 +92,6 @@ class TestUlyssesAttention:
                                    rtol=2e-5, atol=2e-5)
 
     def test_heads_must_divide(self):
-        import pytest
-
         q, k, v = self._qkv(h=4)  # 4 heads on an 8-way sequence axis
         mesh = dist.make_mesh({"sequence": 8}, env=cpu_env())
         with pytest.raises(ValueError, match="divisible"):
@@ -159,8 +158,6 @@ class TestBert:
         assert abs(r_dp["final_loss"] - r_uly["final_loss"]) < 1e-3
 
     def test_ulysses_rejects_tensor_parallel(self, tmp_path):
-        import pytest
-
         with pytest.raises(ValueError, match="ulysses"):
             bertlib.run(tiny_bert_args(tmp_path, steps=1, sequence_parallel=2,
                                        tensor_parallel=2, sp_mode="ulysses"))
