@@ -98,6 +98,81 @@ class TestUlyssesAttention:
             parallel.ulysses_attention(q, k, v, mesh)
 
 
+class TestFlashAttention:
+    """Pallas kernel parity, interpret mode (the compiled Mosaic path runs
+    on real TPU; numerics are identical by construction)."""
+
+    def _qkv(self, b=2, s=256, h=4, d=64, seed=0, dtype=jnp.float32):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        return tuple(jax.random.normal(k, (b, s, h, d), dtype) for k in ks)
+
+    def test_matches_full_attention(self):
+        from tpujob.workloads.flash import flash_attention
+
+        q, k, v = self._qkv()
+        out = flash_attention(q, k, v)
+        ref = parallel.full_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_matches_full_attention_causal(self):
+        from tpujob.workloads.flash import flash_attention
+
+        q, k, v = self._qkv(seed=5)
+        out = flash_attention(q, k, v, causal=True)
+        ref = parallel.full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bf16_fp32_accumulation(self):
+        from tpujob.workloads.flash import flash_attention
+
+        q, k, v = self._qkv(seed=2, dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v)
+        ref = parallel.full_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                                   np.asarray(ref, dtype=np.float32),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_untileable_seq_falls_back_dense(self):
+        import tpujob.workloads.flash as flashmod
+
+        q, k, v = self._qkv(s=100)  # 100 % 128 != 0 -> dense path
+        # prove the fallback is actually taken: the kernel must not run
+        def boom(*a, **kw):
+            raise AssertionError("pallas path must not run for s=100")
+
+        orig = flashmod._flash
+        flashmod._flash = boom
+        try:
+            out = flashmod.flash_attention(q, k, v)
+        finally:
+            flashmod._flash = orig
+        ref = parallel.full_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_composes_with_ulysses(self):
+        from tpujob.workloads.flash import flash_attention
+
+        q, k, v = self._qkv(b=2, s=256, h=8)
+        mesh = dist.make_mesh({"data": -1, "sequence": 2}, env=cpu_env())
+        out = parallel.ulysses_attention(q, k, v, mesh,
+                                         attention_impl=flash_attention)
+        ref = parallel.full_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_dense(self):
+        from tpujob.workloads.flash import flash_attention
+
+        q, k, v = self._qkv(s=128)
+        g_flash = jax.grad(lambda q: flash_attention(q, k, v).sum())(q)
+        g_dense = jax.grad(lambda q: parallel.full_attention(q, k, v).sum())(q)
+        np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_dense),
+                                   rtol=2e-5, atol=2e-5)
+
+
 class TestPartitionRules:
     def test_spec_tree_by_regex(self):
         params = {"layer_0": {"attn": {"query": {"kernel": jnp.zeros((4, 4)),
@@ -156,6 +231,19 @@ class TestBert:
                                            sequence_parallel=4,
                                            sp_mode="ulysses"))
         assert abs(r_dp["final_loss"] - r_uly["final_loss"]) < 1e-3
+
+    def test_flash_attention_path_matches(self, tmp_path):
+        """The Pallas local kernel is a drop-in: loss parity with dense.
+        seq_len=128 so the kernel actually runs (64 would fall back)."""
+        r_dense = bertlib.run(tiny_bert_args(tmp_path, steps=2, seq_len=128))
+        r_flash = bertlib.run(tiny_bert_args(tmp_path, steps=2, seq_len=128,
+                                             attention="flash"))
+        assert abs(r_dense["final_loss"] - r_flash["final_loss"]) < 1e-3
+
+    def test_flash_rejects_ring_sp(self, tmp_path):
+        with pytest.raises(ValueError, match="flash"):
+            bertlib.run(tiny_bert_args(tmp_path, steps=1, sequence_parallel=2,
+                                       attention="flash"))
 
     def test_ulysses_rejects_tensor_parallel(self, tmp_path):
         with pytest.raises(ValueError, match="ulysses"):

@@ -176,6 +176,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence-parallel attention: ring (ppermute K/V, "
                         "composes with TP, O(S/n) memory) or ulysses "
                         "(all-to-all, 2 collectives, full S per device)")
+    p.add_argument("--attention", choices=["dense", "flash"], default="dense",
+                   help="local attention kernel: dense (XLA) or flash "
+                        "(Pallas, VMEM-resident softmax; non-SP path)")
     p.add_argument("--no-remat", dest="remat", action="store_false", default=True)
     p.add_argument("--log-interval", type=int, default=20)
     train_lib.add_profile_flags(p)
@@ -196,6 +199,9 @@ def make_mesh_for(args, pe):
 
 def build_model(args, mesh) -> Bert:
     attention_fn = None
+    use_flash = getattr(args, "attention", "dense") == "flash"
+    if use_flash:
+        from tpujob.workloads import flash
     if "sequence" in mesh.axis_names and mesh.shape["sequence"] > 1:
         if getattr(args, "sp_mode", "ring") == "ulysses":
             if "tensor" in mesh.axis_names:
@@ -203,14 +209,24 @@ def build_model(args, mesh) -> Bert:
                     "--sp-mode=ulysses does not compose with "
                     "--tensor-parallel (the all_to_all consumes the head "
                     "dim); use --sp-mode=ring for SP x TP")
+            impl = flash.flash_attention if use_flash else None
             attention_fn = lambda q, k, v: parallel.ulysses_attention(
-                q, k, v, mesh, axis="sequence",
+                q, k, v, mesh, axis="sequence", attention_impl=impl,
             )
         else:
+            if use_flash:
+                # never drop a requested kernel silently: the ring's
+                # per-hop block update is its own fused flash-style loop
+                raise ValueError(
+                    "--attention=flash pairs with --sp-mode=ulysses or no "
+                    "sequence parallelism; the ring path already runs a "
+                    "fused flash-style block loop")
             attention_fn = lambda q, k, v: parallel.ring_attention(
                 q, k, v, mesh, axis="sequence",
                 head_axis="tensor" if "tensor" in mesh.axis_names else None,
             )
+    elif use_flash:
+        attention_fn = lambda q, k, v: flash.flash_attention(q, k, v)
     return Bert(
         vocab=args.vocab, hidden=args.hidden, layers=args.layers,
         heads=args.heads, intermediate=args.intermediate, max_seq=args.seq_len,

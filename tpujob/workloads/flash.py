@@ -1,0 +1,186 @@
+"""Pallas flash attention — the hot-op kernel for the TPU compute path.
+
+XLA already fuses elementwise chains into matmuls, but dense attention
+still materializes the [seq, seq] score matrix in HBM.  This kernel keeps
+the whole softmax in VMEM: the grid walks (batch*heads, q_blocks,
+k_blocks), VMEM scratch carries the running (m, l, acc) flash statistics
+across the innermost k dimension (TPU grids iterate the last axis
+sequentially, so scratch persists), and only the final O(S·d) output is
+written back.  MXU-shaped blocks (128 lanes), fp32 accumulation under
+bf16 inputs.
+
+Exactness: same running-softmax algebra as ``parallel.ring_attention``'s
+block update — results match dense attention to numerical precision, which
+the tests assert in interpret mode (CPU).  Composes with Ulysses sequence
+parallelism (it slots in as the device-local attention via
+``ulysses_attention(attention_impl=...)``); the ring scheme needs no local
+kernel swap — its per-hop block update IS a fused flash-style loop
+already.
+
+Falls back to ``parallel.full_attention`` when the shapes don't tile
+(sequence not divisible by the block size) so callers never have to
+special-case.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30  # finite: a fully-masked row must not NaN the running max
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: a K block strictly in the future of every Q row contributes
+    # nothing — skip its matmuls entirely (the ki==0 block is never fully
+    # masked, so _init above always runs)
+    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        # running softmax: m/l replicated across the 128-lane dim so the
+        # scratch keeps MXU/VPU-native tiling
+        m_prev = m_ref[:, :1]                      # [block_q, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                     # [block_q, block_k]
+        l_new = l_ref[:, :1] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+
+    def fold(x):  # [b, s, h, d] -> [b*h, s, d]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    grid = (b * h, sq // block_q, sk // block_k)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu_vmem((block_q, 128), jnp.float32),  # running max m
+            pltpu_vmem((block_q, 128), jnp.float32),  # running sum l
+            pltpu_vmem((block_q, d), jnp.float32),    # output accumulator
+        ],
+        interpret=interpret,
+    )(fold(q), fold(k), fold(v))
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    # backward recomputes the dense attention and differentiates it — the
+    # memory win applies to the forward/inference path; a Pallas backward
+    # kernel is the follow-up (this matches what XLA's dense path does
+    # during training anyway, so training sees no regression vs dense)
+    from tpujob.workloads.parallel import full_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: full_attention(q, k, v, causal=causal, scale=scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention over [batch, seq, heads, head_dim] inputs.
+
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU (tests,
+    CPU meshes) and the compiled Mosaic kernel on TPU.  Shapes that don't
+    tile (seq % block != 0) fall back to dense attention.  Differentiable
+    via a recompute backward (see ``_flash_bwd``).
+    """
+    from tpujob.workloads.parallel import full_attention
+
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    sq, sk = q.shape[1], k.shape[1]
+    # blocks stay MXU-shaped: a sequence that doesn't tile into full
+    # 128-row blocks takes the dense path rather than handing Mosaic an
+    # unaligned block (sub-128 sequences are cheap densely anyway)
+    if sq % block_q or sk % block_k:
+        return full_attention(q, k, v, causal=causal, scale=scale)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, float(scale), block_q, block_k, interpret)
+
+
+def pltpu_vmem(shape, dtype):
+    """VMEM scratch shape — via the TPU pallas module when present, plain
+    interpreter scratch otherwise (keeps CPU-only environments importable)."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pltpu.VMEM(shape, dtype)
+    except ImportError:  # pragma: no cover - non-TPU pallas builds
+        return pl.MemorySpace.ANY(shape, dtype)  # type: ignore[attr-defined]

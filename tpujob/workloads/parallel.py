@@ -195,6 +195,7 @@ def ulysses_attention(
     axis: str = "sequence",
     causal: bool = False,
     scale: Optional[float] = None,
+    attention_impl=None,
 ) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
 
@@ -211,6 +212,10 @@ def ulysses_attention(
     is the memory bound or heads are scarce, the ring wins.  Does not
     compose with a tensor-parallel head split (the head dim is already
     consumed by the all_to_all); use the ring for SP×TP.
+
+    ``attention_impl``: the device-local attention over the re-sharded
+    [b, S, h/n, d] tensors — defaults to dense ``full_attention``; pass
+    ``flash.flash_attention`` to keep the local softmax in VMEM.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -229,7 +234,8 @@ def ulysses_attention(
             jnp.stack((qb, kb, vb)), axis, split_axis=3, concat_axis=2,
             tiled=True,
         )  # [3, b, s, h/n, d]
-        out = full_attention(qkv[0], qkv[1], qkv[2], causal=causal, scale=scale)
+        impl = attention_impl or full_attention
+        out = impl(qkv[0], qkv[1], qkv[2], causal=causal, scale=scale)
         # [b, s, h/n, d] -> [b, s/n, h, d]
         return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
                                   tiled=True)
