@@ -240,6 +240,13 @@ class TestBert:
                                              attention="flash"))
         assert abs(r_dense["final_loss"] - r_flash["final_loss"]) < 1e-3
 
+    def test_flash_rejects_tensor_parallel(self, tmp_path):
+        """No GSPMD rule exists for the Mosaic call: flash+TP must be an
+        eager error, not a silently replicated kernel on real TPU."""
+        with pytest.raises(ValueError, match="flash"):
+            bertlib.run(tiny_bert_args(tmp_path, steps=1, seq_len=128,
+                                       tensor_parallel=4, attention="flash"))
+
     def test_flash_rejects_ring_sp(self, tmp_path):
         with pytest.raises(ValueError, match="flash"):
             bertlib.run(tiny_bert_args(tmp_path, steps=1, sequence_parallel=2,

@@ -329,6 +329,15 @@ class TPUJobController(JobController):
                                     pod.metadata.namespace,
                                     pod.metadata.name, job,
                                 )
+                            except NotFoundError:
+                                # already gone (raced with node GC or a
+                                # concurrent sync's delete): the intended
+                                # outcome happened, so KEEP the count — but
+                                # clear our expectation, whose DELETED
+                                # event may have been observed before we
+                                # registered it (it would otherwise gate
+                                # syncs until the TTL)
+                                self.expectations.observe_del(ekey)
                             except Exception:
                                 # the restart did not happen: roll back the
                                 # count and the expectation so the retry

@@ -226,6 +226,15 @@ def build_model(args, mesh) -> Bert:
                 head_axis="tensor" if "tensor" in mesh.axis_names else None,
             )
     elif use_flash:
+        if "tensor" in mesh.axis_names and mesh.shape["tensor"] > 1:
+            # the Mosaic custom call carries no GSPMD partitioning rule: on
+            # real TPU a tensor-sharded head dim would be all-gathered and
+            # the kernel replicated, silently defeating TP on the hottest
+            # op — reject instead (interpret-mode tests would mask it)
+            raise ValueError(
+                "--attention=flash does not compose with --tensor-parallel "
+                "(no GSPMD rule for the Pallas call); use dense attention "
+                "with TP, or flash without TP")
         attention_fn = lambda q, k, v: flash.flash_attention(q, k, v)
     return Bert(
         vocab=args.vocab, hidden=args.hidden, layers=args.layers,
@@ -311,8 +320,10 @@ def run(args, mesh=None) -> Dict[str, Any]:
             if ckpt and args.checkpoint_interval and (i + 1) % args.checkpoint_interval == 0:
                 ckpt.save(i + 1, state)
         jax.block_until_ready(loss)
-        # timed region ends before trace serialization in the finally
-        wall = time.perf_counter() - t0
+        # honest throughput under --profile-dir: exclude trace drain +
+        # serialization time, whether the window closed mid-loop
+        # (profiler.overhead_s) or in the finally below
+        wall = time.perf_counter() - t0 - profiler.overhead_s
     finally:
         profiler.close(block_on=loss)
     steps_run = args.steps - start_step

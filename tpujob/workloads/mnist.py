@@ -191,8 +191,9 @@ def run(args, mesh=None) -> Dict[str, Any]:
             accuracy = test_epoch(
                 args, state, eval_step, mesh, test_x, test_y, epoch, writer, pe
             )
-        # timed region ends before trace serialization in the finally
-        wall = time.perf_counter() - t0
+        # honest wall time under --profile-dir: exclude trace drain +
+        # serialization even when the window closed mid-loop
+        wall = time.perf_counter() - t0 - profiler.overhead_s
     finally:
         profiler.close(block_on=state)
 

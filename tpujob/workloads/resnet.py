@@ -168,8 +168,9 @@ def run(args, mesh=None) -> Dict[str, Any]:
             if i % args.log_interval == 0:
                 writer.add_scalar("loss", float(loss), i)
         jax.block_until_ready(loss)
-        # timed region ends before trace serialization in the finally
-        wall = time.perf_counter() - t0
+        # honest throughput under --profile-dir: exclude trace drain +
+        # serialization time even when the window closed mid-loop
+        wall = time.perf_counter() - t0 - profiler.overhead_s
     finally:
         profiler.close(block_on=loss)
     sps = args.steps * args.batch_size / wall

@@ -92,6 +92,10 @@ class Profiler:
         self.stop_step = start_step + max(1, num_steps)
         self.enabled = bool(profile_dir) and enabled
         self._active = False
+        # wall time spent draining + serializing the trace: callers
+        # subtract it from their timed region so profiled runs report
+        # honest throughput even when the window closes mid-loop
+        self.overhead_s = 0.0
 
     def step(self, step: int, block_on=None) -> None:
         """Call at each loop iteration top; ``block_on`` is the previous
@@ -116,6 +120,7 @@ class Profiler:
     def _finish(self, block_on=None) -> None:
         self._active = False
         self.enabled = False  # one trace window per run
+        t0 = time.perf_counter()
         try:
             if block_on is not None:
                 jax.block_until_ready(block_on)
@@ -127,6 +132,7 @@ class Profiler:
 
                 logging.getLogger("tpujob.workloads").warning(
                     "profiler stop_trace failed: %s", e)
+            self.overhead_s += time.perf_counter() - t0
 
 
 def add_profile_flags(parser) -> None:
