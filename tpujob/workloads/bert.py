@@ -25,6 +25,7 @@ Entrypoint:
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -51,7 +52,51 @@ PARTITION_RULES = (
     (r"mlp_wi/bias", P("tensor")),
     (r"mlp_wo/kernel", P("tensor", None)),
     (r"token_embed/embedding", P("tensor", None)),
+    # MoE: experts split over the expert axis, each expert's FFN optionally
+    # Megatron-split over tensor; the router stays replicated (it is tiny
+    # and every token needs it)
+    (r"moe/wi", P("expert", None, "tensor")),
+    (r"moe/wo", P("expert", "tensor", None)),
+    (r"moe/router", P()),
 )
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Static MoE hyperparameters threaded through the module tree (frozen
+    so flax module attributes stay hashable)."""
+
+    experts: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    mesh: Any = None
+
+
+class MoEMlp(nn.Module):
+    """Sparse MoE FFN block (the `ep` strategy — see `parallel.moe_ffn`)."""
+
+    hidden: int
+    intermediate: int
+    cfg: MoEConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        e = self.cfg.experts
+        router = self.param("router", nn.initializers.normal(0.02),
+                            (self.hidden, e), jnp.float32)
+        wi = self.param("wi", nn.initializers.lecun_normal(),
+                        (e, self.hidden, self.intermediate))
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (e, self.intermediate, self.hidden))
+        y, metrics = parallel.moe_ffn(
+            x, router, wi.astype(self.dtype), wo.astype(self.dtype),
+            self.cfg.mesh, k=self.cfg.k,
+            capacity_factor=self.cfg.capacity_factor,
+        )
+        self.sow("moe_metrics", "load_balance", metrics["load_balance"])
+        self.sow("moe_metrics", "router_z", metrics["router_z"])
+        return y
 
 
 class Attention(nn.Module):
@@ -82,15 +127,20 @@ class Block(nn.Module):
     intermediate: int
     dtype: Any = jnp.float32
     attention_fn: Optional[Callable] = None
+    moe: Optional[MoEConfig] = None
 
     @nn.compact
     def __call__(self, x):
         a = Attention(self.hidden, self.heads, self.dtype,
                       self.attention_fn, name="attn")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x + a)
-        h = nn.Dense(self.intermediate, dtype=self.dtype, name="mlp_wi")(x)
-        h = nn.gelu(h)
-        h = nn.Dense(self.hidden, dtype=self.dtype, name="mlp_wo")(h)
+        if self.moe is not None:
+            h = MoEMlp(self.hidden, self.intermediate, self.moe,
+                       self.dtype, name="moe")(x)
+        else:
+            h = nn.Dense(self.intermediate, dtype=self.dtype, name="mlp_wi")(x)
+            h = nn.gelu(h)
+            h = nn.Dense(self.hidden, dtype=self.dtype, name="mlp_wo")(h)
         return nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x + h)
 
 
@@ -105,6 +155,7 @@ class Bert(nn.Module):
     max_seq: int = 512
     dtype: Any = jnp.float32
     attention_fn: Optional[Callable] = None
+    moe: Optional[MoEConfig] = None
     remat: bool = True
 
     @nn.compact
@@ -128,22 +179,40 @@ class Bert(nn.Module):
             block_cls = nn.remat(Block)
         for i in range(self.layers):
             x = block_cls(self.hidden, self.heads, self.intermediate,
-                          self.dtype, self.attention_fn, name=f"layer_{i}")(x)
+                          self.dtype, self.attention_fn, self.moe,
+                          name=f"layer_{i}")(x)
         # tied MLM head: logits through the embedding transpose
         return embed.attend(x.astype(jnp.float32))[..., : self.vocab]
 
 
-def mlm_loss(model: Bert):
+def _mean_sown(tree, name) -> Any:
+    """Mean of every sown leaf whose key path contains ``name`` (one value
+    per MoE layer; the mean keeps loss coefficients depth-independent)."""
+    vals = [leaf for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+            if any(getattr(p, "key", None) == name for p in path)]
+    return sum(vals) / len(vals) if vals else jnp.zeros(())
+
+
+def mlm_loss(model: Bert, aux_coef: float = 0.01, z_coef: float = 1e-3):
     """Masked-LM: mask 15% of positions deterministically per step-seed,
-    predict the original ids."""
+    predict the original ids.  MoE models add the load-balance aux loss and
+    router z-loss collected from the ``moe_metrics`` collection."""
 
     def loss_fn(params, batch):
         ids, mask = batch  # mask: 1.0 where position is masked/predicted
         masked_ids = jnp.where(mask > 0, jnp.int32(103), ids)  # [MASK]=103
-        logits = model.apply(params, masked_ids)
+        if model.moe is not None:
+            logits, sown = model.apply(params, masked_ids,
+                                       mutable=["moe_metrics"])
+        else:
+            logits, sown = model.apply(params, masked_ids), {}
         logp = jax.nn.log_softmax(logits)
         tok_ll = jnp.take_along_axis(logp, ids[..., None], axis=-1)[..., 0]
-        return -(tok_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        loss = -(tok_ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        if sown:
+            loss = (loss + aux_coef * _mean_sown(sown, "load_balance")
+                    + z_coef * _mean_sown(sown, "router_z"))
+        return loss
 
     return loss_fn
 
@@ -179,6 +248,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attention", choices=["dense", "flash"], default="dense",
                    help="local attention kernel: dense (XLA) or flash "
                         "(Pallas, VMEM-resident softmax; non-SP path)")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="replace each FFN with a sparse MoE of this many "
+                        "experts (0 = dense)")
+    p.add_argument("--moe-k", type=int, default=2,
+                   help="experts routed per token")
+    p.add_argument("--moe-capacity-factor", type=float, default=1.25,
+                   help="per-expert buffer slack over perfect balance")
+    p.add_argument("--expert-parallel", type=int, default=1,
+                   help="size of the expert mesh axis (experts sharded "
+                        "across it; GSPMD derives the all-to-alls)")
     p.add_argument("--no-remat", dest="remat", action="store_false", default=True)
     p.add_argument("--log-interval", type=int, default=20)
     train_lib.add_profile_flags(p)
@@ -188,12 +267,34 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def moe_config_from(args, mesh=None) -> Optional[MoEConfig]:
+    """Validate the MoE flag surface and build the config (None = dense).
+    The one home for these rules — called both before mesh construction
+    (so a 1-device run reports the actionable error, not an opaque
+    axis-divisibility one) and from build_model for external-mesh callers."""
+    n_experts = getattr(args, "moe_experts", 0)
+    ep = getattr(args, "expert_parallel", 1)
+    if n_experts <= 0:
+        if ep > 1:
+            raise ValueError("--expert-parallel needs --moe-experts > 0")
+        return None
+    if ep > 1 and n_experts % ep != 0:
+        raise ValueError(
+            f"--moe-experts {n_experts} must divide evenly over "
+            f"--expert-parallel {ep}")
+    return MoEConfig(experts=n_experts, k=args.moe_k,
+                     capacity_factor=args.moe_capacity_factor, mesh=mesh)
+
+
 def make_mesh_for(args, pe):
+    moe_config_from(args)  # flag coherence before mesh construction
     axes = {"data": -1}
     if args.tensor_parallel > 1:
         axes["tensor"] = args.tensor_parallel
     if args.sequence_parallel > 1:
         axes["sequence"] = args.sequence_parallel
+    if getattr(args, "expert_parallel", 1) > 1:
+        axes["expert"] = args.expert_parallel
     return dist.make_mesh(axes, env=pe)
 
 
@@ -236,11 +337,12 @@ def build_model(args, mesh) -> Bert:
                 "(no GSPMD rule for the Pallas call); use dense attention "
                 "with TP, or flash without TP")
         attention_fn = lambda q, k, v: flash.flash_attention(q, k, v)
+    moe = moe_config_from(args, mesh)
     return Bert(
         vocab=args.vocab, hidden=args.hidden, layers=args.layers,
         heads=args.heads, intermediate=args.intermediate, max_seq=args.seq_len,
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
-        attention_fn=attention_fn, remat=args.remat,
+        attention_fn=attention_fn, moe=moe, remat=args.remat,
     )
 
 
@@ -254,7 +356,9 @@ def run(args, mesh=None) -> Dict[str, Any]:
 
     rng = jax.random.PRNGKey(args.seed)
     sample = jnp.zeros((1, args.seq_len), jnp.int32)
-    params = model.init(rng, sample)
+    # keep only trainable params: init also returns the sown moe_metrics
+    # collection for MoE models, which is per-call output, not state
+    params = {"params": model.init(rng, sample)["params"]}
     params = parallel.shard_params(params, mesh, PARTITION_RULES)
     # moments initialized from sharded params inherit their layout; bare
     # scalars (adam count, step) must be committed replicated explicitly or

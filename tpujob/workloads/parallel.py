@@ -26,6 +26,7 @@ on ICI neighbours).
 """
 from __future__ import annotations
 
+import math
 import re
 from typing import Any, Optional, Sequence, Tuple
 
@@ -245,6 +246,107 @@ def ulysses_attention(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Expert parallelism (sparse Mixture-of-Experts FFN)
+# ---------------------------------------------------------------------------
+
+
+def moe_capacity(tokens_per_group: int, num_experts: int, k: int,
+                 capacity_factor: float) -> int:
+    """Static per-expert buffer length for one routing group.
+
+    ``capacity_factor`` 1.0 fits a perfectly balanced router; the usual
+    1.25-2.0 slack absorbs imbalance before tokens drop.  Static because
+    every shape under jit must be — overflowing assignments are dropped
+    (their combine weight is zero, so the residual stream carries the
+    token through unchanged, the standard Switch/GShard behavior).
+    """
+    return max(1, math.ceil(tokens_per_group * k * capacity_factor / num_experts))
+
+
+def moe_ffn(
+    x: jax.Array,
+    router_kernel: jax.Array,
+    wi: jax.Array,
+    wo: jax.Array,
+    mesh=None,
+    *,
+    axis: str = "expert",
+    k: int = 2,
+    capacity_factor: float = 1.25,
+    activation=jax.nn.gelu,
+):
+    """Sparse MoE feed-forward with top-k routing and expert parallelism.
+
+    ``x``: [batch, seq, d_model]; ``router_kernel``: [d_model, E];
+    ``wi``: [E, d_model, d_ff]; ``wo``: [E, d_ff, d_model].
+    Returns ``(y, metrics)`` with y shaped like x and ``metrics`` carrying
+    ``load_balance`` (Switch-style aux loss, 1.0 when perfectly balanced)
+    and ``router_z`` (logit-magnitude regularizer).
+
+    TPU-first dispatch (the GShard/GSPMD idiom): routing builds dense
+    dispatch/combine masks per batch-row group and two einsums move tokens
+    to [E, capacity] expert buffers — no gather/scatter, so XLA tiles
+    everything onto the MXU.  Expert weights arrive sharded ``P(axis, ...)``
+    (see ``bert.PARTITION_RULES``); a sharding constraint on the dispatched
+    activations pins the expert dim to the same axis, and GSPMD derives the
+    all-to-alls between the data and expert layouts.  The reference has no
+    MoE at all (SURVEY.md §2.5 — DP only); this is the ``ep`` in the
+    framework's dp×tp×sp×ep story.
+    """
+    b, s, d = x.shape
+    num_experts = wi.shape[0]
+    if k > num_experts:
+        raise ValueError(f"top-k k={k} exceeds num_experts={num_experts}")
+    cap = moe_capacity(s, num_experts, k, capacity_factor)
+
+    # Router in fp32: tiny matmul, and exp/softmax on bf16 logits is where
+    # MoE training classically diverges.
+    logits = jnp.einsum(
+        "bsd,de->bse", x.astype(jnp.float32), router_kernel.astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate, expert_idx = jax.lax.top_k(probs, k)  # [b, s, k]
+    gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+
+    # Position of each assignment in its expert's buffer, first choices
+    # before second choices (priority order = k-major), per group (=row).
+    oh = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)  # [b,s,k,E]
+    oh_prio = oh.transpose(0, 2, 1, 3).reshape(b, k * s, num_experts)
+    pos = jnp.cumsum(oh_prio, axis=1) - 1.0  # [b, k*s, E]
+    pos = jnp.sum(pos * oh_prio, axis=-1)  # [b, k*s] slot of each assignment
+    keep = (pos < cap).astype(jnp.float32)
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                          dtype=jnp.float32) * keep[..., None]
+    # [b, k*s, E, cap] -> [b, s, k, E, cap]
+    dispatch = (oh_prio[..., None] * slot[..., None, :]).reshape(
+        b, k, s, num_experts, cap).transpose(0, 2, 1, 3, 4)
+    combine = (dispatch * gate[..., None, None]).sum(2).astype(x.dtype)
+    dispatch = dispatch.sum(2).astype(x.dtype)  # [b, s, E, cap]
+
+    expert_in = jnp.einsum("bsec,bsd->becd", dispatch, x)
+    if mesh is not None and axis in mesh.axis_names:
+        # batch entry dropped when b doesn't divide the data axis (e.g. the
+        # batch-1 trace during model.init)
+        ep_spec = sanitize_spec(P(_sp_batch_axis(mesh, b), axis, None, None), mesh)
+        constrain = lambda a: jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, ep_spec))
+        expert_in = constrain(expert_in)
+    else:
+        constrain = lambda a: a
+    h = activation(jnp.einsum("becd,edf->becf", expert_in, wi))
+    out = constrain(jnp.einsum("becf,efd->becd", h, wo))
+    y = jnp.einsum("bsec,becd->bsd", combine, out)
+
+    # Switch aux loss: E * sum_e(frac_assigned_e * mean_prob_e); 1.0 when
+    # balanced.  router_z keeps logits small (numerical safety valve).
+    density = oh.sum(axis=(0, 1, 2)) / (b * s * k)
+    mean_prob = probs.mean(axis=(0, 1))
+    load_balance = num_experts * jnp.sum(density * mean_prob)
+    router_z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    return y, {"load_balance": load_balance, "router_z": router_z}
 
 
 def full_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):

@@ -120,11 +120,13 @@ class Profiler:
     def _finish(self, block_on=None) -> None:
         self._active = False
         self.enabled = False  # one trace window per run
-        t0 = time.perf_counter()
         try:
+            # the drain waits for counted training compute — NOT overhead
+            # (classifying it as overhead would inflate profiled steps/sec)
             if block_on is not None:
                 jax.block_until_ready(block_on)
         finally:
+            t0 = time.perf_counter()
             try:
                 jax.profiler.stop_trace()
             except Exception as e:  # never let trace teardown kill training
