@@ -145,7 +145,13 @@ class Block(nn.Module):
 
 
 class Bert(nn.Module):
-    """BERT encoder with a tied masked-LM head."""
+    """BERT encoder with a tied masked-LM head.
+
+    setup-style (not ``@nn.compact``) so the pipeline-parallel path can run
+    the ``embed`` and ``head`` stages separately around the pipelined trunk
+    (``pipeline_apply``); param paths are identical to the original compact
+    form (token_embed, pos_embed, ln_embed, layer_{i}/...).
+    """
 
     vocab: int = 30522
     hidden: int = 1024  # BERT-large
@@ -158,31 +164,66 @@ class Bert(nn.Module):
     moe: Optional[MoEConfig] = None
     remat: bool = True
 
-    @nn.compact
-    def __call__(self, ids):
+    def setup(self):
         # vocab padded to a multiple of 128 so the vocab-sharded embedding
         # divides any tensor-parallel degree (the Megatron padding trick);
         # logits are sliced back to the true vocab before the loss
         vocab_padded = -(-self.vocab // 128) * 128
-        embed = nn.Embed(vocab_padded, self.hidden, dtype=self.dtype,
-                         name="token_embed")
-        x = embed(ids)
-        pos = self.param(
+        self.token_embed = nn.Embed(vocab_padded, self.hidden, dtype=self.dtype)
+        self.pos_embed = self.param(
             "pos_embed", nn.initializers.normal(0.02), (self.max_seq, self.hidden)
         )
-        x = x + pos[None, : ids.shape[1]].astype(self.dtype)
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_embed")(x)
+        self.ln_embed = nn.LayerNorm(dtype=self.dtype)
         block_cls = Block
         if self.remat:
             # rematerialize each block on backward: HBM for FLOPs, the
             # standard long-context trade (jax.checkpoint)
             block_cls = nn.remat(Block)
         for i in range(self.layers):
-            x = block_cls(self.hidden, self.heads, self.intermediate,
-                          self.dtype, self.attention_fn, self.moe,
-                          name=f"layer_{i}")(x)
+            setattr(self, f"layer_{i}", block_cls(
+                self.hidden, self.heads, self.intermediate, self.dtype,
+                self.attention_fn, self.moe))
+
+    def embed(self, ids):
+        x = self.token_embed(ids)
+        x = x + self.pos_embed[None, : ids.shape[1]].astype(self.dtype)
+        return self.ln_embed(x)
+
+    def head(self, x):
         # tied MLM head: logits through the embedding transpose
-        return embed.attend(x.astype(jnp.float32))[..., : self.vocab]
+        return self.token_embed.attend(x.astype(jnp.float32))[..., : self.vocab]
+
+    def __call__(self, ids):
+        x = self.embed(ids)
+        for i in range(self.layers):
+            x = getattr(self, f"layer_{i}")(x)
+        return self.head(x)
+
+
+def pipeline_apply(model: Bert, params, ids, mesh, num_microbatches: int):
+    """Forward pass with the trunk run as a GPipe pipeline over the mesh
+    ``pipeline`` axis (`parallel.pipeline`); embed/head stay data-parallel
+    outside the manual region.  Layer params are restacked from the
+    standard per-layer tree each call, so the train state (and checkpoints)
+    are layout-identical to the non-pipelined model."""
+    x = model.apply(params, ids, method="embed")
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *(params["params"][f"layer_{i}"] for i in range(model.layers)),
+    )
+    blk = Block(model.hidden, model.heads, model.intermediate, model.dtype,
+                model.attention_fn, model.moe)
+    apply_one = lambda p, xb: blk.apply({"params": p}, xb)
+    if model.remat:
+        apply_one = jax.checkpoint(apply_one)
+
+    def stage_fn(local_stack, xb):
+        return jax.lax.scan(lambda c, p: (apply_one(p, c), None),
+                            xb, local_stack)[0]
+
+    x = parallel.pipeline(stage_fn, stacked, x, mesh,
+                          num_microbatches=num_microbatches)
+    return model.apply(params, x, method="head")
 
 
 def _mean_sown(tree, name) -> Any:
@@ -193,15 +234,20 @@ def _mean_sown(tree, name) -> Any:
     return sum(vals) / len(vals) if vals else jnp.zeros(())
 
 
-def mlm_loss(model: Bert, aux_coef: float = 0.01, z_coef: float = 1e-3):
+def mlm_loss(model: Bert, aux_coef: float = 0.01, z_coef: float = 1e-3,
+             apply_fn: Optional[Callable] = None):
     """Masked-LM: mask 15% of positions deterministically per step-seed,
     predict the original ids.  MoE models add the load-balance aux loss and
-    router z-loss collected from the ``moe_metrics`` collection."""
+    router z-loss collected from the ``moe_metrics`` collection.
+    ``apply_fn(params, ids) -> logits`` overrides the forward (the
+    pipeline-parallel path plugs ``pipeline_apply`` in here)."""
 
     def loss_fn(params, batch):
         ids, mask = batch  # mask: 1.0 where position is masked/predicted
         masked_ids = jnp.where(mask > 0, jnp.int32(103), ids)  # [MASK]=103
-        if model.moe is not None:
+        if apply_fn is not None:
+            logits, sown = apply_fn(params, masked_ids), {}
+        elif model.moe is not None:
             logits, sown = model.apply(params, masked_ids,
                                        mutable=["moe_metrics"])
         else:
@@ -258,6 +304,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--expert-parallel", type=int, default=1,
                    help="size of the expert mesh axis (experts sharded "
                         "across it; GSPMD derives the all-to-alls)")
+    p.add_argument("--pipeline-parallel", type=int, default=1,
+                   help="size of the pipeline mesh axis; the layer stack "
+                        "splits into this many GPipe stages (composes with "
+                        "data parallelism)")
+    p.add_argument("--pipeline-microbatches", type=int, default=0,
+                   help="microbatches streamed through the pipeline "
+                        "(0 = one per stage; more amortizes the bubble)")
     p.add_argument("--no-remat", dest="remat", action="store_false", default=True)
     p.add_argument("--log-interval", type=int, default=20)
     train_lib.add_profile_flags(p)
@@ -286,8 +339,38 @@ def moe_config_from(args, mesh=None) -> Optional[MoEConfig]:
                      capacity_factor=args.moe_capacity_factor, mesh=mesh)
 
 
+def validate_pipeline_flags(args) -> int:
+    """Coherence checks for --pipeline-parallel; returns the stage count."""
+    pp = getattr(args, "pipeline_parallel", 1)
+    micro = getattr(args, "pipeline_microbatches", 0)
+    if micro < 0:
+        raise ValueError(f"--pipeline-microbatches must be >= 0, got {micro}")
+    if micro > 0 and pp <= 1:
+        # never drop a requested flag silently
+        raise ValueError("--pipeline-microbatches needs --pipeline-parallel > 1")
+    if pp > 1:
+        if args.tensor_parallel > 1 or args.sequence_parallel > 1:
+            raise ValueError(
+                "--pipeline-parallel composes with data parallelism (and "
+                "--attention=flash) only; not with --tensor-parallel or "
+                "--sequence-parallel in this release")
+        if getattr(args, "moe_experts", 0) > 0:
+            raise ValueError(
+                "--pipeline-parallel does not compose with --moe-experts "
+                "(the MoE metrics collection cannot cross the pipeline's "
+                "manual region)")
+        if args.layers % pp != 0:
+            raise ValueError(
+                f"--layers {args.layers} must divide over "
+                f"--pipeline-parallel {pp}")
+    return pp
+
+
 def make_mesh_for(args, pe):
-    moe_config_from(args)  # flag coherence before mesh construction
+    # flag coherence before mesh construction, so a wrong-device-count run
+    # reports the actionable error, not an opaque axis-divisibility one
+    moe_config_from(args)
+    validate_pipeline_flags(args)
     axes = {"data": -1}
     if args.tensor_parallel > 1:
         axes["tensor"] = args.tensor_parallel
@@ -295,6 +378,8 @@ def make_mesh_for(args, pe):
         axes["sequence"] = args.sequence_parallel
     if getattr(args, "expert_parallel", 1) > 1:
         axes["expert"] = args.expert_parallel
+    if getattr(args, "pipeline_parallel", 1) > 1:
+        axes["pipeline"] = args.pipeline_parallel
     return dist.make_mesh(axes, env=pe)
 
 
@@ -374,8 +459,14 @@ def run(args, mesh=None) -> Dict[str, Any]:
         "step": jax.device_put(jnp.zeros((), jnp.int32), repl),
     }
 
+    apply_fn = None
+    pp = validate_pipeline_flags(args)
+    if pp > 1:
+        micro = getattr(args, "pipeline_microbatches", 0) or pp
+        apply_fn = lambda p, ids: pipeline_apply(model, p, ids, mesh, micro)
+    loss_fn = mlm_loss(model, apply_fn=apply_fn)
     train_step = train_lib.make_train_step(
-        mlm_loss(model), optimizer, mesh,
+        loss_fn, optimizer, mesh,
         state_shardings=jax.tree.map(lambda a: a.sharding, state),
     )
 
@@ -399,7 +490,7 @@ def run(args, mesh=None) -> Dict[str, Any]:
     if start_step >= args.steps:
         # the pod was restarted after the final checkpoint (the preemption
         # race): report completion instead of training further
-        final_loss = float(jax.jit(mlm_loss(model))(state["params"], batch))
+        final_loss = float(jax.jit(loss_fn)(state["params"], batch))
         if pe.process_id == 0:
             print(f"already complete: resumed at step {start_step} >= "
                   f"--steps {args.steps}")

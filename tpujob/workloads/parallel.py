@@ -249,6 +249,98 @@ def ulysses_attention(
 
 
 # ---------------------------------------------------------------------------
+# Pipeline parallelism (GPipe microbatch schedule)
+# ---------------------------------------------------------------------------
+
+
+def pipeline(
+    stage_fn,
+    stacked_params: Any,
+    x: jax.Array,
+    mesh,
+    *,
+    axis: str = "pipeline",
+    num_microbatches: Optional[int] = None,
+):
+    """Run a layer stack split over the ``axis`` mesh dim as a GPipe
+    pipeline.
+
+    ``stacked_params``: pytree whose leaves carry a leading layer dim L
+    (L % axis size == 0); stage s holds layers [s*L/n, (s+1)*L/n).
+    ``stage_fn(local_params, x_mb)`` applies one stage's layers to one
+    microbatch (local_params = the stage's slice of the stack).
+    ``x``: [batch, ...] activations; batch is cut into ``num_microbatches``
+    (default = the axis size) and streamed through the stages.
+
+    Schedule: M + n - 1 ticks of a ``lax.scan``.  At tick t stage 0 ingests
+    microbatch t, every stage applies its layers to the activation it
+    holds, and ``ppermute`` shifts results one hop down the pipeline ring
+    (neighbour-only ICI traffic, like the ring-attention rotation).  The
+    last stage accumulates finished microbatches and a masked ``psum``
+    broadcasts the result so the output is replicated over ``axis`` like
+    the input.  The (n-1)/(M+n-1) bubble is the classic GPipe cost — raise
+    ``num_microbatches`` to amortize it.  Gradients flow through the scan
+    and the ppermute transpose, so one ``jax.grad`` of a pipelined loss is
+    the full 1F1B-equivalent backward, compiled by XLA.
+
+    The reference has nothing like this (SURVEY.md §2.5: DP only); this is
+    the ``pp`` in the framework's dp×tp×sp×ep×pp story.
+    """
+    n = mesh.shape[axis]
+    m = num_microbatches or n
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    if not leaves:
+        raise ValueError("stacked_params is empty")
+    n_layers = leaves[0].shape[0]
+    if n_layers % n != 0:
+        raise ValueError(
+            f"layer stack of {n_layers} does not divide over "
+            f"{axis!r} axis size {n}")
+    batch_axis = _sp_batch_axis(mesh, x.shape[0])
+    b_local = x.shape[0] // (mesh.shape[batch_axis] if batch_axis else 1)
+    if b_local % m != 0:
+        raise ValueError(
+            f"per-device batch {b_local} does not divide into "
+            f"{m} microbatches")
+
+    def local(p_local, xb):
+        idx = jax.lax.axis_index(axis)
+        mb = xb.shape[0] // m
+        x_mb = xb.reshape((m, mb) + xb.shape[1:])
+        out0 = jnp.zeros_like(x_mb)
+        buf0 = jnp.zeros_like(x_mb[0])
+
+        def tick(carry, t):
+            buf, out = carry
+            # stage 0 ingests the next microbatch; later stages work on
+            # what arrived from their neighbour last tick.  Warmup/drain
+            # ticks process zeros on idle stages — numerically inert
+            # (LN/softmax of 0 is finite) and never written to `out`.
+            feed = x_mb[jnp.clip(t, 0, m - 1)]
+            cur = jnp.where(idx == 0, feed, buf)
+            y = stage_fn(p_local, cur)
+            widx = jnp.clip(t - (n - 1), 0, m - 1)
+            write = jnp.logical_and(idx == n - 1, t >= n - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                out, y.astype(out.dtype), widx, 0)
+            out = jnp.where(write, upd, out)
+            nxt = jax.lax.ppermute(y, axis, [(i, i + 1) for i in range(n - 1)])
+            return (nxt, out), None
+
+        (_, out), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(m + n - 1))
+        # only the last stage holds real outputs; broadcast to all stages
+        out = jnp.where(idx == n - 1, out, jnp.zeros_like(out))
+        out = jax.lax.psum(out, axis)
+        return out.reshape(xb.shape)
+
+    xspec = P(batch_axis, *([None] * (x.ndim - 1)))
+    return shard_map(
+        local, mesh=mesh, in_specs=(P(axis), xspec), out_specs=xspec,
+        check_vma=False,
+    )(stacked_params, x)
+
+
+# ---------------------------------------------------------------------------
 # Expert parallelism (sparse Mixture-of-Experts FFN)
 # ---------------------------------------------------------------------------
 
