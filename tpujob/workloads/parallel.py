@@ -8,6 +8,12 @@ slice scale:
 - **Tensor parallelism**: rule-based parameter partition specs; XLA/GSPMD
   inserts the per-layer collectives from the annotations (no hand-written
   all-reduces).
+- **Pipeline parallelism**: GPipe microbatch schedule over the ``pipeline``
+  mesh axis (``pipeline``) — shard_map + ppermute ring shifts under one
+  ``lax.scan``; one ``jax.grad`` through it is the pipeline backward.
+- **Expert parallelism**: sparse MoE FFN (``moe_ffn``) with top-k routing,
+  static capacity, and expert weights sharded over the ``expert`` axis;
+  GSPMD derives the dispatch/combine all-to-alls.
 - **Sequence/context parallelism**, two interchangeable implementations
   (the long-context story):
 
