@@ -180,12 +180,14 @@ class TestPartitionRules:
                                        "out": {"kernel": jnp.zeros((4, 4))}},
                   "ln": {"scale": jnp.ones((4,))}}}
         specs = parallel.partition_spec_tree(params, bertlib.PARTITION_RULES)
-        assert specs["layer_0"]["attn"]["query"]["kernel"] == P(None, "tensor")
+        assert specs["layer_0"]["attn"]["query"]["kernel"] == P("fsdp", "tensor")
         assert specs["layer_0"]["attn"]["query"]["bias"] == P("tensor")
-        assert specs["layer_0"]["attn"]["out"]["kernel"] == P("tensor", None)
+        assert specs["layer_0"]["attn"]["out"]["kernel"] == P("tensor", "fsdp")
         assert specs["layer_0"]["ln"]["scale"] == P()
 
     def test_shard_params_places_on_mesh(self):
+        """On a mesh without an fsdp axis, the fsdp rule entry sanitizes
+        away — pure-TP placement is unchanged by the ZeRO-3 table."""
         mesh = dist.make_mesh({"data": 2, "tensor": 4}, env=cpu_env())
         params = {"attn": {"query": {"kernel": jnp.zeros((8, 8))}}}
         sharded = parallel.shard_params(params, mesh, bertlib.PARTITION_RULES)
@@ -366,6 +368,37 @@ class TestBert:
         with pytest.raises(ValueError, match="ulysses"):
             bertlib.run(tiny_bert_args(tmp_path, steps=1, sequence_parallel=2,
                                        tensor_parallel=2, sp_mode="ulysses"))
+
+    def test_fsdp_matches_dp_numerics(self, tmp_path):
+        """ZeRO-3 sharding is annotation-only: loss parity with pure DP,
+        and params + optimizer moments actually live fsdp-sharded."""
+        r_dp = bertlib.run(tiny_bert_args(tmp_path, steps=2))
+        r_fs = bertlib.run(tiny_bert_args(tmp_path, steps=2, fsdp=4))
+        assert abs(r_dp["final_loss"] - r_fs["final_loss"]) < 1e-3
+        k = r_fs["state"]["params"]["params"]["layer_0"]["attn"]["query"]["kernel"]
+        assert "fsdp" in str(k.sharding.spec)
+        mu = r_fs["state"]["opt"][0].mu["params"]["layer_0"]["attn"]["query"]["kernel"]
+        assert "fsdp" in str(mu.sharding.spec), "moments must shard too (ZeRO)"
+
+    def test_fsdp_composes_with_tp(self, tmp_path):
+        r_dp = bertlib.run(tiny_bert_args(tmp_path, steps=2))
+        r = bertlib.run(tiny_bert_args(tmp_path, steps=2, fsdp=2,
+                                       tensor_parallel=2))
+        assert abs(r_dp["final_loss"] - r["final_loss"]) < 1e-3
+
+    def test_fsdp_composes_with_moe(self, tmp_path):
+        r_moe = bertlib.run(tiny_bert_args(tmp_path, steps=2, moe_experts=4))
+        r = bertlib.run(tiny_bert_args(tmp_path, steps=2, moe_experts=4,
+                                       fsdp=2, expert_parallel=2))
+        assert abs(r_moe["final_loss"] - r["final_loss"]) < 1e-3
+
+    def test_fsdp_rejects_sp_and_pp(self, tmp_path):
+        with pytest.raises(ValueError, match="fsdp"):
+            bertlib.run(tiny_bert_args(tmp_path, steps=1, fsdp=2,
+                                       sequence_parallel=2))
+        with pytest.raises(ValueError, match="fsdp"):
+            bertlib.run(tiny_bert_args(tmp_path, steps=1, fsdp=2,
+                                       pipeline_parallel=2))
 
     def test_pipeline_path_matches(self, tmp_path):
         """GPipe staging is a schedule, not an algorithm change: loss

@@ -44,19 +44,26 @@ from tpujob.workloads import parallel, train_lib
 # Column-parallel (split output dim) for QKV and MLP-in; row-parallel
 # (split input dim) for the attention projection and MLP-out; embeddings
 # split on vocab.  The Megatron layout, expressed as annotations.
+#
+# Each kernel's complementary dim additionally shards over "fsdp" (the
+# ZeRO-3 layout: params+moments live sharded, XLA all-gathers per layer on
+# use and reduce-scatters grads — all derived from these annotations).
+# `sanitize_spec` drops axes the mesh doesn't carry, so one table serves
+# DP, TP, FSDP, and TP x FSDP meshes unchanged.
 PARTITION_RULES = (
-    (r"attn/(query|key|value)/kernel", P(None, "tensor")),
+    (r"attn/(query|key|value)/kernel", P("fsdp", "tensor")),
     (r"attn/(query|key|value)/bias", P("tensor")),
-    (r"attn/out/kernel", P("tensor", None)),
-    (r"mlp_wi/kernel", P(None, "tensor")),
+    (r"attn/out/kernel", P("tensor", "fsdp")),
+    (r"mlp_wi/kernel", P("fsdp", "tensor")),
     (r"mlp_wi/bias", P("tensor")),
-    (r"mlp_wo/kernel", P("tensor", None)),
-    (r"token_embed/embedding", P("tensor", None)),
+    (r"mlp_wo/kernel", P("tensor", "fsdp")),
+    (r"token_embed/embedding", P("tensor", "fsdp")),
+    (r"pos_embed", P(None, "fsdp")),
     # MoE: experts split over the expert axis, each expert's FFN optionally
     # Megatron-split over tensor; the router stays replicated (it is tiny
     # and every token needs it)
-    (r"moe/wi", P("expert", None, "tensor")),
-    (r"moe/wo", P("expert", "tensor", None)),
+    (r"moe/wi", P("expert", "fsdp", "tensor")),
+    (r"moe/wo", P("expert", "tensor", "fsdp")),
     (r"moe/router", P()),
 )
 
@@ -311,6 +318,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pipeline-microbatches", type=int, default=0,
                    help="microbatches streamed through the pipeline "
                         "(0 = one per stage; more amortizes the bubble)")
+    p.add_argument("--fsdp", type=int, default=1,
+                   help="size of the fsdp mesh axis: ZeRO-3-style sharding "
+                        "of params and optimizer moments (batch also splits "
+                        "over it; composes with --tensor-parallel and "
+                        "--moe-experts)")
     p.add_argument("--no-remat", dest="remat", action="store_false", default=True)
     p.add_argument("--log-interval", type=int, default=20)
     train_lib.add_profile_flags(p)
@@ -366,12 +378,33 @@ def validate_pipeline_flags(args) -> int:
     return pp
 
 
+def validate_parallel_flags(args) -> int:
+    """All strategy-flag coherence rules in one place; returns the
+    pipeline stage count."""
+    moe_config_from(args)
+    pp = validate_pipeline_flags(args)
+    fsdp = getattr(args, "fsdp", 1)
+    if fsdp > 1:
+        if args.sequence_parallel > 1:
+            raise ValueError(
+                "--fsdp does not compose with --sequence-parallel in this "
+                "release (the SP manual region would re-gather the sharded "
+                "params every layer)")
+        if getattr(args, "pipeline_parallel", 1) > 1:
+            raise ValueError(
+                "--fsdp does not compose with --pipeline-parallel (the "
+                "stage param stacks would be re-gathered whole); pair "
+                "--fsdp with --tensor-parallel or --moe-experts instead")
+    return pp
+
+
 def make_mesh_for(args, pe):
     # flag coherence before mesh construction, so a wrong-device-count run
     # reports the actionable error, not an opaque axis-divisibility one
-    moe_config_from(args)
-    validate_pipeline_flags(args)
+    validate_parallel_flags(args)
     axes = {"data": -1}
+    if getattr(args, "fsdp", 1) > 1:
+        axes["fsdp"] = args.fsdp
     if args.tensor_parallel > 1:
         axes["tensor"] = args.tensor_parallel
     if args.sequence_parallel > 1:
@@ -460,7 +493,9 @@ def run(args, mesh=None) -> Dict[str, Any]:
     }
 
     apply_fn = None
-    pp = validate_pipeline_flags(args)
+    # run() may receive an external mesh (dryrun, tests), so the full flag
+    # coherence check must happen here too, not only in make_mesh_for
+    pp = validate_parallel_flags(args)
     if pp > 1:
         micro = getattr(args, "pipeline_microbatches", 0) or pp
         apply_fn = lambda p, ids: pipeline_apply(model, p, ids, mesh, micro)

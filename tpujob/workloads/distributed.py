@@ -229,28 +229,35 @@ def make_mesh(
     return Mesh(dmesh, axis_names=tuple(names))
 
 
-def batch_sharding(mesh, *batch_axes: str):
+def batch_axes(mesh) -> Tuple[str, ...]:
+    """The batch-parallel axes this mesh carries — the one home for the
+    'data and fsdp split the batch' rule (FSDP is data parallelism with
+    sharded state; every model axis replicates the batch)."""
+    return tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+
+
+def batch_sharding(mesh, *axes: str):
     """NamedSharding for a batch: dim 0 split over the given mesh axes
-    (default: every non-model axis present on the mesh)."""
+    (default: every batch-parallel axis present on the mesh)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    if not batch_axes:
-        batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
-        if not batch_axes:
+    if not axes:
+        axes = batch_axes(mesh)
+        if not axes:
             raise ValueError(
                 f"mesh axes {mesh.axis_names} contain no batch axis "
                 "('data'/'fsdp'); pass batch_axes explicitly"
             )
-    return NamedSharding(mesh, P(batch_axes if len(batch_axes) > 1 else batch_axes[0]))
+    return NamedSharding(mesh, P(axes if len(axes) > 1 else axes[0]))
 
 
-def batch_divisor(mesh, *batch_axes: str) -> int:
+def batch_divisor(mesh, *axes: str) -> int:
     """Global batch dim 0 must be a multiple of this (the number of batch
     shards the mesh produces)."""
-    if not batch_axes:
-        batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    if not axes:
+        axes = batch_axes(mesh)
     out = 1
-    for a in batch_axes:
+    for a in axes:
         out *= mesh.shape[a]
     return out
 

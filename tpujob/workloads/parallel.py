@@ -41,6 +41,8 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tpujob.workloads import distributed as dist
+
 
 # ---------------------------------------------------------------------------
 # Rule-based tensor-parallel parameter partitioning
@@ -115,13 +117,14 @@ def _block_attention(q, k, v, bias, m_prev, l_prev, o_prev, scale):
     return m_new, l_new, o_new
 
 
-def _sp_batch_axis(mesh, batch_size: int) -> Optional[str]:
-    """Mesh axis for the batch dim inside a sequence-parallel manual region:
-    keep it split over 'data' (an unsharded first dim would force an
-    all-gather of the whole batch), but skip when the static batch doesn't
-    divide it — e.g. batch-1 traces during model.init."""
-    if "data" in mesh.axis_names and batch_size % mesh.shape["data"] == 0:
-        return "data"
+def _sp_batch_axis(mesh, batch_size: int) -> Optional[Tuple[str, ...]]:
+    """Mesh axes for the batch dim inside a manual (shard_map) region or
+    sharding constraint: keep it split over the mesh's batch-parallel axes
+    (`dist.batch_axes`), but skip when the static batch doesn't divide
+    them — e.g. batch-1 traces during model.init."""
+    axes = dist.batch_axes(mesh)
+    if axes and batch_size % dist.batch_divisor(mesh, *axes) == 0:
+        return axes
     return None
 
 
@@ -303,7 +306,8 @@ def pipeline(
             f"layer stack of {n_layers} does not divide over "
             f"{axis!r} axis size {n}")
     batch_axis = _sp_batch_axis(mesh, x.shape[0])
-    b_local = x.shape[0] // (mesh.shape[batch_axis] if batch_axis else 1)
+    b_local = x.shape[0] // (
+        dist.batch_divisor(mesh, *batch_axis) if batch_axis else 1)
     if b_local % m != 0:
         raise ValueError(
             f"per-device batch {b_local} does not divide into "
