@@ -170,6 +170,7 @@ class Bert(nn.Module):
     attention_fn: Optional[Callable] = None
     moe: Optional[MoEConfig] = None
     remat: bool = True
+    final_ln: bool = False  # GPT-2-style ln_f before the head
 
     def setup(self):
         # vocab padded to a multiple of 128 so the vocab-sharded embedding
@@ -181,6 +182,8 @@ class Bert(nn.Module):
             "pos_embed", nn.initializers.normal(0.02), (self.max_seq, self.hidden)
         )
         self.ln_embed = nn.LayerNorm(dtype=self.dtype)
+        if self.final_ln:
+            self.ln_f = nn.LayerNorm(dtype=self.dtype)
         block_cls = Block
         if self.remat:
             # rematerialize each block on backward: HBM for FLOPs, the
@@ -197,7 +200,9 @@ class Bert(nn.Module):
         return self.ln_embed(x)
 
     def head(self, x):
-        # tied MLM head: logits through the embedding transpose
+        if self.final_ln:
+            x = self.ln_f(x)
+        # tied LM head: logits through the embedding transpose
         return self.token_embed.attend(x.astype(jnp.float32))[..., : self.vocab]
 
     def __call__(self, ids):
@@ -416,7 +421,12 @@ def make_mesh_for(args, pe):
     return dist.make_mesh(axes, env=pe)
 
 
-def build_model(args, mesh) -> Bert:
+def build_model(args, mesh, *, causal: bool = False,
+                final_ln: bool = False) -> Bert:
+    """Construct the transformer from the flag surface.  ``causal=True``
+    threads a causal mask through whichever attention path the flags pick
+    (dense/flash/ring/ulysses) — the decoder family (gpt.py) is the same
+    machine with masked attention and ln_f."""
     attention_fn = None
     use_flash = getattr(args, "attention", "dense") == "flash"
     if use_flash:
@@ -431,6 +441,7 @@ def build_model(args, mesh) -> Bert:
             impl = flash.flash_attention if use_flash else None
             attention_fn = lambda q, k, v: parallel.ulysses_attention(
                 q, k, v, mesh, axis="sequence", attention_impl=impl,
+                causal=causal,
             )
         else:
             if use_flash:
@@ -443,6 +454,7 @@ def build_model(args, mesh) -> Bert:
             attention_fn = lambda q, k, v: parallel.ring_attention(
                 q, k, v, mesh, axis="sequence",
                 head_axis="tensor" if "tensor" in mesh.axis_names else None,
+                causal=causal,
             )
     elif use_flash:
         if "tensor" in mesh.axis_names and mesh.shape["tensor"] > 1:
@@ -454,22 +466,34 @@ def build_model(args, mesh) -> Bert:
                 "--attention=flash does not compose with --tensor-parallel "
                 "(no GSPMD rule for the Pallas call); use dense attention "
                 "with TP, or flash without TP")
-        attention_fn = lambda q, k, v: flash.flash_attention(q, k, v)
+        attention_fn = lambda q, k, v: flash.flash_attention(q, k, v,
+                                                            causal=causal)
+    elif causal:
+        attention_fn = lambda q, k, v: parallel.full_attention(q, k, v,
+                                                               causal=True)
     moe = moe_config_from(args, mesh)
     return Bert(
         vocab=args.vocab, hidden=args.hidden, layers=args.layers,
         heads=args.heads, intermediate=args.intermediate, max_seq=args.seq_len,
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         attention_fn=attention_fn, moe=moe, remat=args.remat,
+        final_ln=final_ln,
     )
 
 
-def run(args, mesh=None) -> Dict[str, Any]:
-    pe = dist.initialize()
-    if mesh is None:
-        mesh = make_mesh_for(args, pe)
+def train(args, mesh, pe, model, make_loss, local_batch, *,
+          tag: str = "bert") -> Dict[str, Any]:
+    """Shared SPMD training driver for the transformer families (BERT here,
+    GPT in ``tpujob.workloads.gpt``): sharded init by PARTITION_RULES,
+    pipeline apply_fn wiring, AOT compile, step-exact checkpoint/resume,
+    profiler, and honest throughput accounting.
+
+    ``make_loss(apply_fn) -> loss_fn(params, batch)`` builds the model's
+    loss (apply_fn is None for the standard forward, or the pipelined
+    forward when --pipeline-parallel is set); ``local_batch`` is this
+    process's rows of the global batch (a tuple of arrays).
+    """
     writer = train_lib.SummaryWriter(args.dir, enabled=pe.process_id == 0)
-    model = build_model(args, mesh)
     optimizer = train_lib.adamw(args.lr)
 
     rng = jax.random.PRNGKey(args.seed)
@@ -499,7 +523,7 @@ def run(args, mesh=None) -> Dict[str, Any]:
     if pp > 1:
         micro = getattr(args, "pipeline_microbatches", 0) or pp
         apply_fn = lambda p, ids: pipeline_apply(model, p, ids, mesh, micro)
-    loss_fn = mlm_loss(model, apply_fn=apply_fn)
+    loss_fn = make_loss(apply_fn)
     train_step = train_lib.make_train_step(
         loss_fn, optimizer, mesh,
         state_shardings=jax.tree.map(lambda a: a.sharding, state),
@@ -517,10 +541,7 @@ def run(args, mesh=None) -> Dict[str, Any]:
             start_step = latest
             print(f"resumed from checkpoint step {latest}")
 
-    lo, sz = dist.local_batch_slice(args.batch_size, pe)
-    ids = datalib.synthetic_token_batch(args.batch_size, args.seq_len, args.vocab)
-    ids, mask = mask_batch(ids, args.seed)
-    batch = train_lib.put_batch((ids[lo : lo + sz], mask[lo : lo + sz]), mesh)
+    batch = train_lib.put_batch(local_batch, mesh)
 
     if start_step >= args.steps:
         # the pod was restarted after the final checkpoint (the preemption
@@ -564,10 +585,23 @@ def run(args, mesh=None) -> Dict[str, Any]:
     if ckpt:
         ckpt.close()
     if pe.process_id == 0:
-        print(f"bert(h{args.hidden}xl{args.layers}): {sps:.1f} samples/sec, "
+        print(f"{tag}(h{args.hidden}xl{args.layers}): {sps:.1f} samples/sec, "
               f"{tps:.0f} tokens/sec, loss={final_loss:.3f}")
     return {"samples_per_sec": sps, "tokens_per_sec": tps, "wall_s": wall,
             "final_loss": final_loss, "state": state}
+
+
+def run(args, mesh=None) -> Dict[str, Any]:
+    pe = dist.initialize()
+    if mesh is None:
+        mesh = make_mesh_for(args, pe)
+    model = build_model(args, mesh)
+    lo, sz = dist.local_batch_slice(args.batch_size, pe)
+    ids = datalib.synthetic_token_batch(args.batch_size, args.seq_len, args.vocab)
+    ids, mask = mask_batch(ids, args.seed)
+    return train(args, mesh, pe, model,
+                 lambda af: mlm_loss(model, apply_fn=af),
+                 (ids[lo : lo + sz], mask[lo : lo + sz]))
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,92 @@
+"""GPT-style decoder-only causal-LM pretraining workload.
+
+The autoregressive model family on the same TPU-first machine as BERT:
+the encoder blocks of ``tpujob.workloads.bert`` with a causal mask threaded
+through whichever attention path the flags pick (dense XLA, Pallas flash,
+ring or Ulysses sequence parallelism — all four implement ``causal=True``),
+a GPT-2-style ``ln_f`` before the tied LM head, and next-token
+cross-entropy.  The full parallelism matrix applies unchanged: DP,
+FSDP/ZeRO-3, tensor, sequence, GPipe pipeline, and sparse-MoE expert
+parallelism, all via the shared flag surface and ``bert.PARTITION_RULES``.
+
+The reference ships no GPT workload (its examples are MNIST and a
+send/recv smoke, SURVEY.md §2.3); this is model-family breadth beyond it,
+sized GPT-2-medium by default.
+
+Entrypoint:
+    python -m tpujob.workloads.gpt --steps 100 --layers 24
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpujob.workloads import bert as bertlib
+from tpujob.workloads import data as datalib
+from tpujob.workloads import distributed as dist
+
+
+def lm_loss(model, aux_coef: float = 0.01, z_coef: float = 1e-3,
+            apply_fn: Optional[Callable] = None):
+    """Next-token cross-entropy (shift-by-one), plus the MoE aux losses
+    when the FFNs are sparse — same collection plumbing as bert.mlm_loss."""
+
+    def loss_fn(params, batch):
+        (ids,) = batch  # [b, s]
+        if apply_fn is not None:
+            logits, sown = apply_fn(params, ids), {}
+        elif model.moe is not None:
+            logits, sown = model.apply(params, ids, mutable=["moe_metrics"])
+        else:
+            logits, sown = model.apply(params, ids), {}
+        logp = jax.nn.log_softmax(logits[:, :-1])
+        tok_ll = jnp.take_along_axis(logp, ids[:, 1:, None], axis=-1)[..., 0]
+        loss = -tok_ll.mean()
+        if sown:
+            loss = (loss
+                    + aux_coef * bertlib._mean_sown(sown, "load_balance")
+                    + z_coef * bertlib._mean_sown(sown, "router_z"))
+        return loss
+
+    return loss_fn
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """The BERT flag surface with decoder defaults (GPT-2-medium shapes,
+    GPT-2 vocab)."""
+    p = bertlib.build_parser()
+    p.description = "TPU-native GPT (decoder-only) causal-LM pretrain"
+    p.set_defaults(vocab=50257, seq_len=1024)
+    return p
+
+
+def build_model(args, mesh):
+    return bertlib.build_model(args, mesh, causal=True, final_ln=True)
+
+
+make_mesh_for = bertlib.make_mesh_for
+
+
+def run(args, mesh=None) -> Dict[str, Any]:
+    pe = dist.initialize()
+    if mesh is None:
+        mesh = make_mesh_for(args, pe)
+    model = build_model(args, mesh)
+    lo, sz = dist.local_batch_slice(args.batch_size, pe)
+    ids = datalib.synthetic_token_batch(args.batch_size, args.seq_len, args.vocab)
+    return bertlib.train(args, mesh, pe, model,
+                         lambda af: lm_loss(model, apply_fn=af),
+                         (ids[lo : lo + sz],), tag="gpt")
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    run(args)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
