@@ -35,3 +35,27 @@ def test_min_bounds_and_stats():
     mean = sum(stats.per_window_s) / len(stats.per_window_s)
     assert abs(stats.mean_s - mean) < 1e-12
     assert stats.std_s >= 0.0
+
+
+def test_steps_per_call_scales_counts():
+    """A k-steps-per-dispatch runner reports k x steps and per-step times
+    divided by k (the multi-step bench accounting)."""
+    from tpujob.workloads.benchlib import measure_windows
+
+    calls = []
+    stats = measure_windows(
+        lambda: calls.append(1), window_s=0.01, min_windows=2,
+        min_total_s=0.02, min_steps_per_window=3, fixed_steps=3,
+        steps_per_call=10,
+    )
+    assert stats.steps == len(calls) * 10
+    assert abs(stats.mean_s * stats.steps - stats.wall_s) / stats.wall_s < 0.5
+
+
+def test_steps_per_call_must_be_positive():
+    import pytest
+
+    from tpujob.workloads.benchlib import measure_windows
+
+    with pytest.raises(ValueError, match="steps_per_call"):
+        measure_windows(lambda: None, steps_per_call=0)

@@ -144,3 +144,61 @@ class TestTraining:
             np.asarray(res["state"]["params"]["params"]["fc2"]["bias"]),
         )
         ckpt.close()
+
+
+class TestMultiStep:
+    """`train_lib.make_multi_step`: k optimizer updates in one dispatch
+    (the dispatch-latency amortization bench.py runs on the tunneled
+    device) must be bit-compatible with k sequential single steps."""
+
+    def _setup(self):
+        mesh = dist.make_mesh({"data": -1}, env=dist.process_env({}))
+        model = mnist.Net()
+        opt = train_lib.sgd(0.01, 0.5)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1,) + datalib.IMAGE_SHAPE))
+        state = train_lib.init_state(params, opt, mesh)
+        x, y = datalib.synthetic_split(64, seed=0)
+        b = train_lib.put_batch(((x - datalib.MEAN) / datalib.STD, y), mesh)
+        return mesh, opt, state, b
+
+    def test_multi_step_matches_sequential(self):
+        mesh, opt, state, b = self._setup()
+        single = train_lib.make_train_step(mnist.nll_loss, opt, mesh,
+                                           donate=False)
+        s_seq, losses_seq = state, []
+        for _ in range(4):
+            s_seq, l = single(s_seq, b)
+            losses_seq.append(float(l))
+        multi = train_lib.make_multi_step(mnist.nll_loss, opt, mesh, k=4,
+                                          donate=False)
+        s_multi, losses = multi(state, b)
+        np.testing.assert_allclose(np.asarray(losses),
+                                   np.asarray(losses_seq), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(s_multi["params"]["params"]["fc2"]["bias"]),
+            np.asarray(s_seq["params"]["params"]["fc2"]["bias"]),
+            rtol=1e-6, atol=1e-7)
+        assert int(s_multi["step"]) == 4
+
+    def test_stacked_microbatches(self):
+        """stacked=True consumes a [k]-leading batch stack, one microbatch
+        per step — equivalent to feeding them sequentially."""
+        mesh, opt, state, b0 = self._setup()
+        x, y = datalib.synthetic_split(64, seed=0)
+        xs = jnp.stack([(x - datalib.MEAN) / datalib.STD + 0.01 * i
+                        for i in range(3)])
+        ys = jnp.stack([jnp.asarray(y)] * 3)
+        single = train_lib.make_train_step(mnist.nll_loss, opt, mesh,
+                                           donate=False)
+        s_seq = state
+        for i in range(3):
+            s_seq, _ = single(s_seq, train_lib.put_batch((xs[i], ys[i]), mesh))
+        multi = train_lib.make_multi_step(mnist.nll_loss, opt, mesh, k=3,
+                                          donate=False, stacked=True)
+        s_multi, losses = multi(state, (xs, ys))
+        assert losses.shape == (3,)
+        np.testing.assert_allclose(
+            np.asarray(s_multi["params"]["params"]["fc2"]["bias"]),
+            np.asarray(s_seq["params"]["params"]["fc2"]["bias"]),
+            rtol=1e-5, atol=1e-6)

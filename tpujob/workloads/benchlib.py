@@ -41,6 +41,7 @@ def measure_windows(
     min_total_s: float = 5.0,
     min_steps_per_window: int = 5,
     fixed_steps: Optional[int] = None,
+    steps_per_call: int = 1,
 ) -> WindowStats:
     """Time ``run_step`` (dispatch one async step, return something to
     drain on) in windows of ~``window_s`` seconds.
@@ -51,11 +52,17 @@ def measure_windows(
     count or window count) dispatches unequal collective counts per
     process and desynchronizes the streams (mispaired or hanging
     all-reduces).
+
+    ``steps_per_call``: optimizer steps one ``run_step`` call performs
+    (``train_lib.make_multi_step`` dispatch); reported steps and per-step
+    times account for it.  ``fixed_steps`` still counts calls.
     """
     import jax
 
     if fixed_steps is not None and fixed_steps <= 0:
         raise ValueError(f"fixed_steps must be positive, got {fixed_steps}")
+    if steps_per_call <= 0:
+        raise ValueError(f"steps_per_call must be positive, got {steps_per_call}")
 
     windows: List[tuple] = []  # (steps, seconds)
     t0 = time.perf_counter()
@@ -77,14 +84,14 @@ def measure_windows(
         windows.append((w_steps, time.perf_counter() - w0))
     wall = time.perf_counter() - t0
 
-    per_step = [s / w for w, s in windows]
+    per_step = [s / (w * steps_per_call) for w, s in windows]
     mean = sum(per_step) / len(per_step)
     std = (
         (sum((s - mean) ** 2 for s in per_step) / (len(per_step) - 1)) ** 0.5
         if len(per_step) > 1 else 0.0
     )
     return WindowStats(
-        steps=sum(w for w, _ in windows),
+        steps=sum(w for w, _ in windows) * steps_per_call,
         wall_s=wall,
         mean_s=mean,
         std_s=std,

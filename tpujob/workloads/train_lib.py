@@ -211,20 +211,7 @@ def make_train_step(
     """
     repl = dist.replicated(mesh)
     bsh = dist.batch_sharding(mesh)
-
-    def step(state, batch):
-        if has_extra:
-            (loss, extra), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state["params"], state["extra"], batch
-            )
-        else:
-            loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
-        updates, opt = optimizer.update(grads, state["opt"], state["params"])
-        params = optax.apply_updates(state["params"], updates)
-        out = {"params": params, "opt": opt, "step": state["step"] + 1}
-        if has_extra:
-            out["extra"] = extra
-        return out, loss
+    step = _step_body(loss_fn, optimizer, has_extra)
 
     if state_shardings is not None:
         # Tensor-parallel case: the caller committed params (and the
@@ -242,6 +229,76 @@ def make_train_step(
         step,
         in_shardings=(repl, bsh),
         out_shardings=(repl, repl),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def _step_body(loss_fn, optimizer, has_extra):
+    """The pure train step shared by the single- and multi-step builders."""
+
+    def step(state, batch):
+        if has_extra:
+            (loss, extra), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state["params"], state["extra"], batch
+            )
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        updates, opt = optimizer.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        out = {"params": params, "opt": opt, "step": state["step"] + 1}
+        if has_extra:
+            out["extra"] = extra
+        return out, loss
+
+    return step
+
+
+def make_multi_step(
+    loss_fn: Callable[..., Any],
+    optimizer: optax.GradientTransformation,
+    mesh,
+    k: int,
+    donate: bool = True,
+    has_extra: bool = False,
+    stacked: bool = False,
+    state_shardings: Any = None,
+):
+    """Like :func:`make_train_step`, but one dispatch runs ``k`` optimizer
+    updates under ``lax.scan`` and returns the per-step losses ``[k]``.
+
+    On a dispatch-latency-bound link (the usual state of a tunneled or
+    contended TPU: one host→device round trip costs more than a small
+    model's step takes to compute) the host loop pays that latency every
+    step; scanning k steps in-graph pays it once per k.  With
+    ``stacked=True`` the batch leaves carry a leading ``[k]`` dim of
+    per-step microbatches (the real-training shape, sharded on dim 1 by
+    the caller); otherwise one batch is reused for every step (the
+    steady-state benchmark shape).
+    """
+    repl = dist.replicated(mesh)
+    step = _step_body(loss_fn, optimizer, has_extra)
+
+    if stacked:
+        def multi(state, batches):
+            lead = jax.tree_util.tree_leaves(batches)[0].shape[0]
+            if lead != k:
+                raise ValueError(
+                    f"stacked batch carries {lead} microbatches but the "
+                    f"multi-step was built with k={k}")
+            return jax.lax.scan(step, state, batches)
+    else:
+        def multi(state, batch):
+            return jax.lax.scan(lambda s, _: step(s, batch), state, None,
+                                length=k)
+
+    # the batch arrives with whatever sharding the caller committed
+    # (put_batch); state replicated unless pinned to rule-derived layouts
+    # (TP/FSDP), mirroring make_train_step
+    ssh = state_shardings if state_shardings is not None else repl
+    return jax.jit(
+        multi,
+        in_shardings=(ssh, None),
+        out_shardings=(ssh, repl),
         donate_argnums=(0,) if donate else (),
     )
 
