@@ -473,6 +473,15 @@ class TestBert:
                                           expert_parallel=2))
         assert abs(r_dp["final_loss"] - r_ep["final_loss"]) < 1e-3
 
+    def test_moe_composes_with_sequence_parallel(self, tmp_path):
+        """Ring SP wraps only attention; the MoE FFN runs at jit level
+        with the sequence dim sharded — GSPMD keeps numerics exact."""
+        r_moe = bertlib.run(tiny_bert_args(tmp_path, steps=2, moe_experts=4))
+        r = bertlib.run(tiny_bert_args(tmp_path, steps=2, moe_experts=4,
+                                       sequence_parallel=2,
+                                       expert_parallel=2))
+        assert abs(r_moe["final_loss"] - r["final_loss"]) < 1e-3
+
     def test_expert_parallel_requires_moe(self, tmp_path):
         with pytest.raises(ValueError, match="moe-experts"):
             bertlib.run(tiny_bert_args(tmp_path, steps=1, expert_parallel=2))
@@ -497,6 +506,61 @@ class TestBert:
         for root, _, files in os.walk(trace_dir):
             found += [f for f in files if f.endswith((".xplane.pb", ".trace.json.gz"))]
         assert found, f"no trace files under {trace_dir}"
+
+    def test_grad_accum_equals_larger_step_count(self, tmp_path):
+        """With the same batch every mini-step, --grad-accum A over A*k
+        steps applies exactly the k updates of a plain k-step run."""
+        r_plain = bertlib.run(tiny_bert_args(tmp_path, steps=2))
+        r_accum = bertlib.run(tiny_bert_args(tmp_path, steps=4, grad_accum=2))
+        p1 = np.asarray(
+            r_plain["state"]["params"]["params"]["layer_0"]["attn"]["query"]["kernel"])
+        p2 = np.asarray(
+            r_accum["state"]["params"]["params"]["layer_0"]["attn"]["query"]["kernel"])
+        np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+        # the inner optimizer advanced steps//accum times — the unit the
+        # LR schedule is driven in (mini-step-unit schedules would stall)
+        assert int(r_accum["state"]["opt"].gradient_step) == 2
+
+    def test_grad_accum_must_divide_steps(self, tmp_path):
+        with pytest.raises(ValueError, match="grad-accum"):
+            bertlib.run(tiny_bert_args(tmp_path, steps=5, grad_accum=2))
+
+    def test_lr_schedule_values(self):
+        from tpujob.workloads import train_lib
+
+        s = train_lib.make_lr_schedule(1e-3, "cosine", 10, 100)
+        assert abs(float(s(0))) < 1e-9          # warmup starts at 0
+        assert abs(float(s(10)) - 1e-3) < 1e-9  # peak at warmup end
+        assert float(s(100)) < 1e-5             # decayed to ~0
+        # cosine without warmup decays FROM peak (update 0 must not be LR 0)
+        s0 = train_lib.make_lr_schedule(1e-3, "cosine", 0, 100)
+        assert abs(float(s0(0)) - 1e-3) < 1e-9
+        assert float(s0(100)) < 1e-5
+        s2 = train_lib.make_lr_schedule(1e-3, "constant", 4, 100)
+        assert abs(float(s2(2)) - 5e-4) < 1e-9  # mid-warmup
+        assert abs(float(s2(50)) - 1e-3) < 1e-9
+        # nothing to schedule -> plain float, no per-step indexing
+        assert train_lib.make_lr_schedule(1e-3, "constant", 0, 100) == 1e-3
+        with pytest.raises(ValueError, match="schedule"):
+            train_lib.make_lr_schedule(1e-3, "zigzag", 0, 100)
+
+    def test_cosine_warmup_trains_and_resumes(self, tmp_path):
+        """Schedule + grad-accum state (optax MultiSteps) must round-trip
+        the orbax checkpoint: resume continues mini-step-exact."""
+        args = tiny_bert_args(tmp_path, steps=4, lr_schedule="cosine",
+                              warmup_steps=2, grad_accum=2,
+                              checkpoint_interval=2)
+        bertlib.run(args)
+        res = bertlib.run(tiny_bert_args(tmp_path, steps=6,
+                                         lr_schedule="cosine",
+                                         warmup_steps=2, grad_accum=2,
+                                         checkpoint_interval=2))
+        assert np.isfinite(res["final_loss"])
+        from tpujob.workloads import train_lib
+
+        ckpt = train_lib.Checkpointer(str(tmp_path / "logs" / "ckpt"))
+        assert ckpt.latest_step() == 6
+        ckpt.close()
 
     def test_checkpoint_resume(self, tmp_path):
         """The preemption story: run 4 steps checkpointing every 2, kill,

@@ -292,6 +292,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, default=32, help="global batch")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--lr-schedule", choices=["constant", "cosine"],
+                   default="constant",
+                   help="constant (optionally warmed up) or cosine decay "
+                        "to 0 at --steps")
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help="linear LR warmup from 0 over this many steps")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="average grads over this many mini-steps per "
+                        "optimizer update (effective batch multiplier)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--bf16", action="store_true", default=True)
     p.add_argument("--no-bf16", dest="bf16", action="store_false")
@@ -494,7 +503,19 @@ def train(args, mesh, pe, model, make_loss, local_batch, *,
     process's rows of the global batch (a tuple of arrays).
     """
     writer = train_lib.SummaryWriter(args.dir, enabled=pe.process_id == 0)
-    optimizer = train_lib.adamw(args.lr)
+    accum = getattr(args, "grad_accum", 1)
+    if accum > 1 and args.steps % accum != 0:
+        raise ValueError(
+            f"--steps {args.steps} must be a multiple of --grad-accum "
+            f"{accum} (trailing mini-steps would accumulate gradients "
+            "that never apply)")
+    # the schedule is driven by the INNER optimizer's update count, which
+    # advances once per accum mini-steps — convert the flag surface's
+    # mini-step units to update units
+    lr = train_lib.make_lr_schedule(
+        args.lr, getattr(args, "lr_schedule", "constant"),
+        getattr(args, "warmup_steps", 0) // accum, args.steps // accum)
+    optimizer = train_lib.with_grad_accum(train_lib.adamw(lr), accum)
 
     rng = jax.random.PRNGKey(args.seed)
     sample = jnp.zeros((1, args.seq_len), jnp.int32)

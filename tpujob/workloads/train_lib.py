@@ -167,9 +167,44 @@ def sgd(lr: float, momentum: float) -> optax.GradientTransformation:
     return optax.sgd(lr, momentum=momentum)
 
 
-def adamw(lr: float, weight_decay: float = 0.01) -> optax.GradientTransformation:
-    """Transformer-default optimizer (BERT pretraining)."""
+def adamw(lr, weight_decay: float = 0.01) -> optax.GradientTransformation:
+    """Transformer-default optimizer (BERT pretraining).  ``lr`` may be a
+    float or an optax schedule."""
     return optax.adamw(lr, weight_decay=weight_decay)
+
+
+def make_lr_schedule(lr: float, kind: str, warmup_steps: int, total_steps: int):
+    """Learning-rate schedule from the flag surface: linear warmup to
+    ``lr`` over ``warmup_steps``, then constant or cosine decay to 0 at
+    ``total_steps``.  Returns a plain float when there is nothing to
+    schedule (XLA then folds the constant)."""
+    if kind == "cosine":
+        if warmup_steps <= 0:
+            # no warmup: decay from peak — warmup_cosine with a forced
+            # 1-step warmup would run the FIRST update at LR 0 (a no-op)
+            return optax.cosine_decay_schedule(lr, max(total_steps, 1))
+        return optax.warmup_cosine_decay_schedule(
+            0.0, lr, warmup_steps, max(total_steps, warmup_steps + 1))
+    if kind != "constant":
+        raise ValueError(f"unknown lr schedule {kind!r}")
+    if warmup_steps > 0:
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, lr, warmup_steps),
+             optax.constant_schedule(lr)],
+            [warmup_steps])
+    return lr
+
+
+def with_grad_accum(optimizer: optax.GradientTransformation, every: int):
+    """Gradient accumulation: average grads over ``every`` consecutive
+    mini-steps, apply one optimizer update (optax.MultiSteps).  The
+    train-step shape is unchanged — ``state['step']`` counts mini-steps;
+    the effective batch is every x the fed batch."""
+    if every <= 0:
+        raise ValueError(f"grad accumulation must be >= 1, got {every}")
+    if every == 1:
+        return optimizer
+    return optax.MultiSteps(optimizer, every_k_schedule=every)
 
 
 def init_state(
