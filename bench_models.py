@@ -166,27 +166,25 @@ def bench_resnet50(quick: bool) -> dict:
     return out
 
 
-def bench_bert_large(quick: bool) -> dict:
+def _bench_transformer(args, model, loss_fn, batch, seconds, *, metric,
+                       extra_fields=None) -> dict:
+    """Shared transformer-bench body (bert + gpt): sharded init by
+    PARTITION_RULES, scalar-replicated opt state, k-step dispatch, windowed
+    timing, tokens/s + MFU report.  ``batch`` is the already-built batch
+    tuple; seq is read from args."""
     import jax
+    import jax.numpy as jnp
 
     from tpujob.workloads import bert as bertlib
-    from tpujob.workloads import data as datalib
     from tpujob.workloads import distributed as dist
     from tpujob.workloads import parallel, train_lib
 
     n_chips = len(jax.devices())
-    batch = (8 if quick else 16) * n_chips
-    seq = 128 if quick else 512
-    argv = ["--batch-size", str(batch), "--seq-len", str(seq)]
-    args = bertlib.build_parser().parse_args(argv)
-    pe = dist.process_env({})
-    mesh = bertlib.make_mesh_for(args, pe)
-
-    model = bertlib.build_model(args, mesh)
+    mesh = bertlib.make_mesh_for(args, dist.process_env({}))
+    n_tokens = args.batch_size * args.seq_len
     optimizer = train_lib.adamw(args.lr)
-    import jax.numpy as jnp
-
-    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, seq), jnp.int32))
+    params = {"params": model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, args.seq_len), jnp.int32))["params"]}
     params = parallel.shard_params(params, mesh, bertlib.PARTITION_RULES)
     repl = dist.replicated(mesh)
     opt_state = jax.tree.map(
@@ -196,33 +194,31 @@ def bench_bert_large(quick: bool) -> dict:
     state = {"params": params, "opt": opt_state,
              "step": jax.device_put(jnp.zeros((), jnp.int32), repl)}
     step = train_lib.make_multi_step(
-        bertlib.mlm_loss(model), optimizer, mesh, k=STEPS_PER_DISPATCH,
+        loss_fn, optimizer, mesh, k=STEPS_PER_DISPATCH,
         state_shardings=jax.tree.map(lambda a: a.sharding, state),
     )
-    ids = datalib.synthetic_token_batch(batch, seq, args.vocab)
-    ids, mask = bertlib.mask_batch(ids, 0)
-    b = train_lib.put_batch((ids, mask), mesh)
+    b = train_lib.put_batch(batch, mesh)
     compiled = step.lower(state, b).compile()
 
     n_params = sum(x.size for x in jax.tree.leaves(params))
     sec_per_step, steps, std = time_compiled(
-        compiled, state, b, 1.0 if quick else 4.0,
-        steps_per_call=STEPS_PER_DISPATCH)
-    sps = batch / sec_per_step
-    tps = sps * seq
+        compiled, state, b, seconds, steps_per_call=STEPS_PER_DISPATCH)
+    sps = args.batch_size / sec_per_step
+    tps = sps * args.seq_len
     # 6 * params * tokens (fwd+bwd dense transformer estimate); remat adds
     # an extra fwd => 8 * params * tokens actually executed.  The scan
     # body is cost-analyzed once (see bench_resnet50), so no k scaling.
-    flops = compiled_flops(compiled, 8 * n_params * batch * seq)
+    flops = compiled_flops(compiled, 8 * n_params * n_tokens)
     peak = peak_flops(jax.devices()[0])
     out = {
-        "metric": "bert_large_train_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": round(tps / n_chips, 0),
         "unit": "tokens/s/chip",
         "samples_per_sec_per_chip": round(sps / n_chips, 2),
-        "global_batch": batch,
-        "seq_len": seq,
+        "global_batch": args.batch_size,
+        "seq_len": args.seq_len,
         "params_m": round(n_params / 1e6, 1),
+        **(extra_fields or {}),
         "chips": n_chips,
         "steps": steps,
         "step_ms": round(sec_per_step * 1e3, 2),
@@ -234,6 +230,58 @@ def bench_bert_large(quick: bool) -> dict:
         if peak:
             out["mfu_vs_spec"] = round(flops / sec_per_step / (peak * n_chips), 3)
     return out
+
+
+def bench_bert_large(quick: bool) -> dict:
+    import jax
+
+    from tpujob.workloads import bert as bertlib
+    from tpujob.workloads import data as datalib
+    from tpujob.workloads import distributed as dist
+
+    n_chips = len(jax.devices())
+    batch = (8 if quick else 16) * n_chips
+    seq = 128 if quick else 512
+    args = bertlib.build_parser().parse_args(
+        ["--batch-size", str(batch), "--seq-len", str(seq)])
+    mesh = bertlib.make_mesh_for(args, dist.process_env({}))
+    model = bertlib.build_model(args, mesh)
+    ids = datalib.synthetic_token_batch(batch, seq, args.vocab)
+    ids, mask = bertlib.mask_batch(ids, 0)
+    return _bench_transformer(
+        args, model, bertlib.mlm_loss(model), (ids, mask),
+        1.0 if quick else 4.0,
+        metric="bert_large_train_tokens_per_sec_per_chip")
+
+
+def bench_gpt_medium(quick: bool) -> dict:
+    """GPT-2-medium-shaped causal LM (the decoder family) with the Pallas
+    flash kernel on the full run; a tiny dense decoder in --quick
+    (interpret-mode flash at medium size on CPU would take minutes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpujob.workloads import data as datalib
+    from tpujob.workloads import distributed as dist
+    from tpujob.workloads import gpt as gptlib
+
+    n_chips = len(jax.devices())
+    batch = (4 if quick else 8) * n_chips
+    seq = 128 if quick else 1024
+    argv = ["--batch-size", str(batch), "--seq-len", str(seq)]
+    if quick:
+        argv += ["--hidden", "256", "--layers", "4", "--heads", "8",
+                 "--intermediate", "1024", "--vocab", "2048"]
+    else:
+        argv += ["--attention", "flash"]
+    args = gptlib.build_parser().parse_args(argv)
+    mesh = gptlib.make_mesh_for(args, dist.process_env({}))
+    model = gptlib.build_model(args, mesh)
+    ids = jnp.asarray(datalib.synthetic_token_batch(batch, seq, args.vocab))
+    return _bench_transformer(
+        args, model, gptlib.lm_loss(model), (ids,), 1.0 if quick else 4.0,
+        metric="gpt_medium_train_tokens_per_sec_per_chip",
+        extra_fields={"attention": args.attention})
 
 
 # ---------------------------------------------------------------------------
@@ -317,13 +365,14 @@ def bench_scaling(quick: bool) -> dict:
 BENCHES = {
     "resnet50": bench_resnet50,
     "bert-large": bench_bert_large,
+    "gpt": bench_gpt_medium,
     "scaling": bench_scaling,
 }
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description="flagship model benchmarks")
-    p.add_argument("--models", default="resnet50,bert-large,scaling",
+    p.add_argument("--models", default="resnet50,bert-large,gpt,scaling",
                    help=f"comma list from {sorted(BENCHES)}")
     p.add_argument("--quick", action="store_true",
                    help="small shapes/short timing (CI smoke)")

@@ -525,6 +525,13 @@ class TestBert:
         with pytest.raises(ValueError, match="grad-accum"):
             bertlib.run(tiny_bert_args(tmp_path, steps=5, grad_accum=2))
 
+    def test_grad_accum_must_divide_warmup(self, tmp_path):
+        """2 warmup mini-steps with accum 4 would floor to 0 schedule
+        updates — the warmup the user asked for must not silently vanish."""
+        with pytest.raises(ValueError, match="warmup"):
+            bertlib.run(tiny_bert_args(tmp_path, steps=4, grad_accum=4,
+                                       lr_schedule="cosine", warmup_steps=2))
+
     def test_lr_schedule_values(self):
         from tpujob.workloads import train_lib
 

@@ -509,6 +509,13 @@ def train(args, mesh, pe, model, make_loss, local_batch, *,
             f"--steps {args.steps} must be a multiple of --grad-accum "
             f"{accum} (trailing mini-steps would accumulate gradients "
             "that never apply)")
+    if accum > 1 and getattr(args, "warmup_steps", 0) % accum != 0:
+        # never drop a requested flag silently: flooring 2//4 warmup
+        # updates to 0 would skip the warmup the user asked for
+        raise ValueError(
+            f"--warmup-steps {args.warmup_steps} must be a multiple of "
+            f"--grad-accum {accum} (the schedule advances once per "
+            "accumulated update)")
     # the schedule is driven by the INNER optimizer's update count, which
     # advances once per accum mini-steps — convert the flag surface's
     # mini-step units to update units
