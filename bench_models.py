@@ -166,12 +166,13 @@ def bench_resnet50(quick: bool) -> dict:
     return out
 
 
-def _bench_transformer(args, model, loss_fn, batch, seconds, *, metric,
+def _bench_transformer(args, mesh, model, loss_fn, batch, seconds, *, metric,
                        extra_fields=None) -> dict:
     """Shared transformer-bench body (bert + gpt): sharded init by
     PARTITION_RULES, scalar-replicated opt state, k-step dispatch, windowed
-    timing, tokens/s + MFU report.  ``batch`` is the already-built batch
-    tuple; seq is read from args."""
+    timing, tokens/s + MFU report.  ``mesh`` must be the one the model was
+    built against (SP/MoE closures capture it); ``batch`` is the
+    already-built batch tuple; seq is read from args."""
     import jax
     import jax.numpy as jnp
 
@@ -180,7 +181,6 @@ def _bench_transformer(args, model, loss_fn, batch, seconds, *, metric,
     from tpujob.workloads import parallel, train_lib
 
     n_chips = len(jax.devices())
-    mesh = bertlib.make_mesh_for(args, dist.process_env({}))
     n_tokens = args.batch_size * args.seq_len
     optimizer = train_lib.adamw(args.lr)
     params = {"params": model.init(
@@ -249,7 +249,7 @@ def bench_bert_large(quick: bool) -> dict:
     ids = datalib.synthetic_token_batch(batch, seq, args.vocab)
     ids, mask = bertlib.mask_batch(ids, 0)
     return _bench_transformer(
-        args, model, bertlib.mlm_loss(model), (ids, mask),
+        args, mesh, model, bertlib.mlm_loss(model), (ids, mask),
         1.0 if quick else 4.0,
         metric="bert_large_train_tokens_per_sec_per_chip")
 
@@ -279,7 +279,8 @@ def bench_gpt_medium(quick: bool) -> dict:
     model = gptlib.build_model(args, mesh)
     ids = jnp.asarray(datalib.synthetic_token_batch(batch, seq, args.vocab))
     return _bench_transformer(
-        args, model, gptlib.lm_loss(model), (ids,), 1.0 if quick else 4.0,
+        args, mesh, model, gptlib.lm_loss(model), (ids,),
+        1.0 if quick else 4.0,
         metric="gpt_medium_train_tokens_per_sec_per_chip",
         extra_fields={"attention": args.attention})
 

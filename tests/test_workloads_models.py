@@ -397,6 +397,21 @@ class TestBert:
                                        fsdp=2, expert_parallel=2))
         assert abs(r_moe["final_loss"] - r["final_loss"]) < 1e-3
 
+    def test_fsdp_composes_with_flash(self, tmp_path):
+        """The Pallas kernel sees batch-axis sharding only under FSDP
+        (like plain DP, unlike the rejected TP head split) — loss parity
+        with dense FSDP.  seq 128 so the kernel engages."""
+        r_dense = bertlib.run(tiny_bert_args(tmp_path, steps=2, seq_len=128,
+                                             fsdp=4))
+        r_flash = bertlib.run(tiny_bert_args(tmp_path, steps=2, seq_len=128,
+                                             fsdp=4, attention="flash"))
+        assert abs(r_dense["final_loss"] - r_flash["final_loss"]) < 1e-3
+
+    def test_moe_k_must_be_positive(self, tmp_path):
+        with pytest.raises(ValueError, match="moe-k"):
+            bertlib.run(tiny_bert_args(tmp_path, steps=1, moe_experts=4,
+                                       moe_k=0))
+
     def test_fsdp_rejects_sp_and_pp(self, tmp_path):
         with pytest.raises(ValueError, match="fsdp"):
             bertlib.run(tiny_bert_args(tmp_path, steps=1, fsdp=2,

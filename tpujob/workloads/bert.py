@@ -357,6 +357,9 @@ def moe_config_from(args, mesh=None) -> Optional[MoEConfig]:
         if ep > 1:
             raise ValueError("--expert-parallel needs --moe-experts > 0")
         return None
+    if args.moe_k < 1:
+        # k=0 would silently zero every MoE FFN (all gates empty)
+        raise ValueError(f"--moe-k must be >= 1, got {args.moe_k}")
     if ep > 1 and n_experts % ep != 0:
         raise ValueError(
             f"--moe-experts {n_experts} must divide evenly over "

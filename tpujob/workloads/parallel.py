@@ -400,8 +400,9 @@ def moe_ffn(
     """
     b, s, d = x.shape
     num_experts = wi.shape[0]
-    if k > num_experts:
-        raise ValueError(f"top-k k={k} exceeds num_experts={num_experts}")
+    if not 1 <= k <= num_experts:
+        raise ValueError(
+            f"top-k k={k} must be in [1, num_experts={num_experts}]")
     cap = moe_capacity(s, num_experts, k, capacity_factor)
 
     # Router in fp32: tiny matmul, and exp/softmax on bf16 logits is where

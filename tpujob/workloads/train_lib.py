@@ -310,6 +310,8 @@ def make_multi_step(
     the caller); otherwise one batch is reused for every step (the
     steady-state benchmark shape).
     """
+    if k < 1:
+        raise ValueError(f"multi-step k must be >= 1, got {k}")
     repl = dist.replicated(mesh)
     step = _step_body(loss_fn, optimizer, has_extra)
 
