@@ -657,6 +657,43 @@ class TestGpt:
                                            attention="flash"))
         assert abs(r_dense["final_loss"] - r_flash["final_loss"]) < 1e-3
 
+    def _gen_setup(self, tmp_path):
+        from tpujob.workloads import gpt as gptlib
+
+        args = tiny_gpt_args(tmp_path, seq_len=32, vocab=97)
+        mesh = dist.make_mesh({"data": -1}, env=cpu_env())
+        model = gptlib.build_model(args, mesh)
+        v = {"params": model.init(jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 32), jnp.int32))["params"]}
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 97)
+        return gptlib, model, v, prompt
+
+    def test_generate_greedy_matches_naive_loop(self, tmp_path):
+        """The scanned static-shape decode equals token-by-token argmax
+        re-forwarding (proves suffix padding is inert under the mask)."""
+        gptlib, model, v, prompt = self._gen_setup(tmp_path)
+        out = gptlib.generate(model, v, prompt, 6)
+        assert out.shape == (2, 11)
+        buf = np.zeros((2, 11), dtype=np.int32)
+        buf[:, :5] = np.asarray(prompt)
+        for j in range(6):
+            logits = model.apply(v, jnp.asarray(buf))
+            buf[:, 5 + j] = np.asarray(jnp.argmax(logits[:, 4 + j], axis=-1))
+        np.testing.assert_array_equal(np.asarray(out), buf)
+
+    def test_generate_sampling_and_bounds(self, tmp_path):
+        gptlib, model, v, prompt = self._gen_setup(tmp_path)
+        a = gptlib.generate(model, v, prompt, 4, temperature=0.8,
+                            rng=jax.random.PRNGKey(7))
+        b = gptlib.generate(model, v, prompt, 4, temperature=0.8,
+                            rng=jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert ((np.asarray(a) >= 0) & (np.asarray(a) < 97)).all()
+        with pytest.raises(ValueError, match="max_seq"):
+            gptlib.generate(model, v, prompt, 64)
+        with pytest.raises(ValueError, match="rng"):
+            gptlib.generate(model, v, prompt, 2, temperature=1.0)
+
 
 class TestResNet:
     def _args(self, tmp_path, **over):

@@ -54,12 +54,71 @@ def lm_loss(model, aux_coef: float = 0.01, z_coef: float = 1e-3,
     return loss_fn
 
 
+def generate(
+    model,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+):
+    """Autoregressive decode, TPU-style: static shapes, one compile, a
+    ``lax.scan`` over positions.
+
+    Correctness-first design: each step runs the full forward over a
+    fixed-length buffer — the causal mask makes positions past the cursor
+    inert, so the suffix padding cannot influence sampled tokens.  (A KV
+    cache would make each step O(1) in recompute; this is O(n) but
+    compiles to one executable with no dynamic shapes.)
+    ``temperature`` 0 = greedy argmax; > 0 samples from the softmax with
+    ``rng``.  Returns [batch, prompt_len + max_new_tokens] token ids.
+    """
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    if total > model.max_seq:
+        raise ValueError(
+            f"prompt {p} + max_new_tokens {max_new_tokens} exceeds the "
+            f"model's max_seq {model.max_seq}")
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature > 0 sampling needs an rng key")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # unused on the greedy path
+    buf = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)
+
+    @jax.jit
+    def decode(params, buf, rng):
+        def step(carry, i):
+            buf, rng = carry
+            logits = model.apply(params, buf)  # [b, total, V]
+            # token i is written at position p+i, predicted from p+i-1
+            logit = jax.lax.dynamic_slice_in_dim(
+                logits, p + i - 1, 1, axis=1)[:, 0]
+            if temperature > 0:
+                rng, key = jax.random.split(rng)
+                nxt = jax.random.categorical(key, logit / temperature)
+            else:
+                nxt = jnp.argmax(logit, axis=-1)
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, nxt[:, None].astype(jnp.int32), p + i, axis=1)
+            return (buf, rng), None
+
+        (buf, _), _ = jax.lax.scan(
+            step, (buf, rng), jnp.arange(max_new_tokens))
+        return buf
+
+    return decode(params, buf, rng)
+
+
 def build_parser() -> argparse.ArgumentParser:
     """The BERT flag surface with decoder defaults (GPT-2-medium shapes,
     GPT-2 vocab)."""
     p = bertlib.build_parser()
     p.description = "TPU-native GPT (decoder-only) causal-LM pretrain"
     p.set_defaults(vocab=50257, seq_len=1024)
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, greedily decode N tokens from a "
+                        "training-batch prefix and print the ids")
     return p
 
 
@@ -72,14 +131,28 @@ make_mesh_for = bertlib.make_mesh_for
 
 def run(args, mesh=None) -> Dict[str, Any]:
     pe = dist.initialize()
+    n_gen = getattr(args, "generate", 0)
+    if n_gen >= args.seq_len:
+        # fail BEFORE training, not after the whole run completed
+        raise ValueError(
+            f"--generate {n_gen} must leave room for a prompt within "
+            f"--seq-len {args.seq_len} (need generate <= seq-len - 1)")
     if mesh is None:
         mesh = make_mesh_for(args, pe)
     model = build_model(args, mesh)
     lo, sz = dist.local_batch_slice(args.batch_size, pe)
     ids = datalib.synthetic_token_batch(args.batch_size, args.seq_len, args.vocab)
-    return bertlib.train(args, mesh, pe, model,
-                         lambda af: lm_loss(model, apply_fn=af),
-                         (ids[lo : lo + sz],), tag="gpt")
+    result = bertlib.train(args, mesh, pe, model,
+                           lambda af: lm_loss(model, apply_fn=af),
+                           (ids[lo : lo + sz],), tag="gpt")
+    if n_gen > 0:
+        # every process enters the SPMD decode (the trained params are
+        # globally sharded); only the print is rank-gated
+        prompt = jnp.asarray(ids[:1, : min(8, args.seq_len - n_gen)])
+        out = generate(model, result["state"]["params"], prompt, n_gen)
+        if pe.process_id == 0:
+            print(f"generated ids: {jax.device_get(out)[0].tolist()}")
+    return result
 
 
 def main(argv=None) -> int:
