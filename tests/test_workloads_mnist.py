@@ -202,3 +202,19 @@ class TestMultiStep:
             np.asarray(s_multi["params"]["params"]["fc2"]["bias"]),
             np.asarray(s_seq["params"]["params"]["fc2"]["bias"]),
             rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_decay_mask():
+    """AdamW decays matrices/embeddings only: with zero grads, kernels
+    shrink while biases/LayerNorm scales (1-D) stay exactly put."""
+    import optax
+
+    params = {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,)),
+              "scale": jnp.ones((4,))}
+    opt = train_lib.adamw(0.1, weight_decay=0.5)
+    upd, _ = opt.update(jax.tree.map(jnp.zeros_like, params),
+                        opt.init(params), params)
+    new = optax.apply_updates(params, upd)
+    assert float(jnp.abs(new["kernel"] - 1.0).sum()) > 0
+    np.testing.assert_array_equal(np.asarray(new["bias"]), 1.0)
+    np.testing.assert_array_equal(np.asarray(new["scale"]), 1.0)

@@ -167,10 +167,18 @@ def sgd(lr: float, momentum: float) -> optax.GradientTransformation:
     return optax.sgd(lr, momentum=momentum)
 
 
+def _decay_mask(params):
+    """Decay matrices/embeddings only — biases, LayerNorm scales, and
+    scalars are excluded (the standard AdamW practice: decaying a
+    LayerNorm scale toward 0 fights the normalization)."""
+    return jax.tree.map(lambda p: getattr(p, "ndim", 0) >= 2, params)
+
+
 def adamw(lr, weight_decay: float = 0.01) -> optax.GradientTransformation:
     """Transformer-default optimizer (BERT pretraining).  ``lr`` may be a
-    float or an optax schedule."""
-    return optax.adamw(lr, weight_decay=weight_decay)
+    float or an optax schedule.  Weight decay applies to >=2D params only
+    (see ``_decay_mask``)."""
+    return optax.adamw(lr, weight_decay=weight_decay, mask=_decay_mask)
 
 
 def make_lr_schedule(lr: float, kind: str, warmup_steps: int, total_steps: int):
