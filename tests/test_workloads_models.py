@@ -681,6 +681,45 @@ class TestGpt:
             buf[:, 5 + j] = np.asarray(jnp.argmax(logits[:, 4 + j], axis=-1))
         np.testing.assert_array_equal(np.asarray(out), buf)
 
+    def test_generate_cached_matches_full_reforward(self, tmp_path):
+        """KV-cached decode is the same function as the full re-forward:
+        teacher-forced logits allclose position-by-position, and the
+        greedy decodes agree on this fixed seed."""
+        gptlib, model, v, prompt = self._gen_setup(tmp_path)
+        ids = jax.random.randint(jax.random.PRNGKey(2), (2, 11), 0, 97)
+        logits_full = model.apply(v, ids)
+        dm = model.clone(decode=11, attention_fn=None, remat=False)
+        cache_shapes = jax.eval_shape(
+            dm.init, jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32))["cache"]
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+        outs = []
+        for t in range(11):
+            lg, mut = dm.apply({**v, "cache": cache}, ids[:, t:t + 1],
+                               mutable=["cache"])
+            cache = mut["cache"]
+            outs.append(lg[:, 0])
+        np.testing.assert_allclose(np.asarray(jnp.stack(outs, 1)),
+                                   np.asarray(logits_full),
+                                   rtol=2e-4, atol=2e-4)
+        full = gptlib.generate(model, v, prompt, 6)
+        cached = gptlib.generate_cached(model, v, prompt, 6)
+        np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+    def test_generate_cached_moe_falls_back_exact(self, tmp_path):
+        """MoE capacity is sequence-length-dependent, so cached decode
+        must route to the full re-forward — outputs equal generate()."""
+        from tpujob.workloads import gpt as gptlib
+
+        args = tiny_gpt_args(tmp_path, seq_len=32, vocab=97, moe_experts=4)
+        mesh = dist.make_mesh({"data": -1}, env=cpu_env())
+        model = gptlib.build_model(args, mesh)
+        v = {"params": model.init(jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 32), jnp.int32))["params"]}
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 97)
+        full = gptlib.generate(model, v, prompt, 4)
+        cached = gptlib.generate_cached(model, v, prompt, 4)
+        np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
     def test_generate_sampling_and_bounds(self, tmp_path):
         gptlib, model, v, prompt = self._gen_setup(tmp_path)
         a = gptlib.generate(model, v, prompt, 4, temperature=0.8,

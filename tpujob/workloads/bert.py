@@ -111,6 +111,10 @@ class Attention(nn.Module):
     heads: int
     dtype: Any = jnp.float32
     attention_fn: Optional[Callable] = None  # None => dense attention
+    # autoregressive decode: keep K/V for past positions in a mutable
+    # 'cache' collection and attend the single new token against them
+    # (gpt.generate_cached); 0 = training mode
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -122,8 +126,31 @@ class Attention(nn.Module):
         q = q.reshape(b, s, self.heads, d)
         k = k.reshape(b, s, self.heads, d)
         v = v.reshape(b, s, self.heads, d)
-        fn = self.attention_fn or parallel.full_attention
-        o = fn(q, k, v)  # [b, s, h, d]
+        if self.cache_len > 0:
+            if s != 1:
+                raise ValueError(
+                    f"cached decode feeds one position at a time, got {s}")
+            shape = (b, self.cache_len, self.heads, d)
+            ck = self.variable("cache", "cached_key",
+                               lambda: jnp.zeros(shape, k.dtype))
+            cv = self.variable("cache", "cached_value",
+                               lambda: jnp.zeros(shape, v.dtype))
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            i = ci.value
+            ck.value = jax.lax.dynamic_update_slice_in_dim(ck.value, k, i, 1)
+            cv.value = jax.lax.dynamic_update_slice_in_dim(cv.value, v, i, 1)
+            ci.value = i + 1
+            scale = d ** -0.5
+            sc = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) * scale
+            # causal: only filled cache slots (<= i) are visible
+            vis = jnp.arange(self.cache_len)[None, None, None, :] <= i
+            sc = jnp.where(vis, sc, -1e30)
+            p = jax.nn.softmax(sc, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.value)
+        else:
+            fn = self.attention_fn or parallel.full_attention
+            o = fn(q, k, v)  # [b, s, h, d]
         o = o.reshape(b, s, self.hidden)
         return nn.Dense(self.hidden, dtype=self.dtype, name="out")(o)
 
@@ -135,11 +162,12 @@ class Block(nn.Module):
     dtype: Any = jnp.float32
     attention_fn: Optional[Callable] = None
     moe: Optional[MoEConfig] = None
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, x):
         a = Attention(self.hidden, self.heads, self.dtype,
-                      self.attention_fn, name="attn")(x)
+                      self.attention_fn, self.cache_len, name="attn")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x + a)
         if self.moe is not None:
             h = MoEMlp(self.hidden, self.intermediate, self.moe,
@@ -171,6 +199,10 @@ class Bert(nn.Module):
     moe: Optional[MoEConfig] = None
     remat: bool = True
     final_ln: bool = False  # GPT-2-style ln_f before the head
+    # >0 = KV-cached autoregressive mode with this cache length
+    # (gpt.generate_cached sizes it to the actual decode length, not
+    # max_seq, so short decodes don't pay max_seq attention per step)
+    decode: int = 0
 
     def setup(self):
         # vocab padded to a multiple of 128 so the vocab-sharded embedding
@@ -184,19 +216,32 @@ class Bert(nn.Module):
         self.ln_embed = nn.LayerNorm(dtype=self.dtype)
         if self.final_ln:
             self.ln_f = nn.LayerNorm(dtype=self.dtype)
+        if self.decode:
+            # decode cursor for the positional embedding (layer caches
+            # track their own index; this one belongs to the trunk)
+            self.position = self.variable(
+                "cache", "position", lambda: jnp.zeros((), jnp.int32))
         block_cls = Block
-        if self.remat:
+        if self.remat and not self.decode:
             # rematerialize each block on backward: HBM for FLOPs, the
             # standard long-context trade (jax.checkpoint)
             block_cls = nn.remat(Block)
+        cache_len = self.decode
         for i in range(self.layers):
             setattr(self, f"layer_{i}", block_cls(
                 self.hidden, self.heads, self.intermediate, self.dtype,
-                self.attention_fn, self.moe))
+                self.attention_fn, self.moe, cache_len))
 
     def embed(self, ids):
         x = self.token_embed(ids)
-        x = x + self.pos_embed[None, : ids.shape[1]].astype(self.dtype)
+        if self.decode:
+            # one position per call: index pos_embed at the decode cursor
+            pos = jax.lax.dynamic_slice_in_dim(
+                self.pos_embed, self.position.value, 1, 0)
+            self.position.value = self.position.value + 1
+            x = x + pos[None].astype(self.dtype)
+        else:
+            x = x + self.pos_embed[None, : ids.shape[1]].astype(self.dtype)
         return self.ln_embed(x)
 
     def head(self, x):

@@ -110,6 +110,79 @@ def generate(
     return decode(params, buf, rng)
 
 
+def generate_cached(
+    model,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+):
+    """KV-cached autoregressive decode: O(1) recompute per token.
+
+    Clones the trained model into decode mode (`Bert.decode`) — each layer
+    keeps past K/V in a mutable ``cache`` collection and the forward sees
+    ONE token per step, vs :func:`generate`'s full re-forward.  Same param
+    tree, so the trained params drop in; attention falls back to the
+    dense cached path regardless of the training-time attention_fn (all
+    attention variants here are exact, so numerics match — pinned by
+    ``test_generate_cached_matches_full_reforward``).  One ``lax.scan``
+    covers prefill and generation uniformly: prompt positions feed the
+    known token, later positions feed the sampled one.
+    """
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    if total > model.max_seq:
+        raise ValueError(
+            f"prompt {p} + max_new_tokens {max_new_tokens} exceeds the "
+            f"model's max_seq {model.max_seq}")
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature > 0 sampling needs an rng key")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    if model.moe is not None:
+        # MoE capacity is per-sequence-length: a 1-token step never drops
+        # tokens while a full forward may, so cached decode would not be
+        # the same function — use the exact re-forward path instead
+        return generate(model, params, prompt, max_new_tokens,
+                        temperature=temperature, rng=rng)
+    dm = model.clone(decode=total, attention_fn=None, remat=False)
+    # only the cache SHAPES are wanted: eval_shape avoids materializing
+    # (and then discarding) a full parameter tree
+    cache_shapes = jax.eval_shape(
+        dm.init, jax.random.PRNGKey(0), jnp.zeros((b, 1), jnp.int32))["cache"]
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+    buf = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt)
+
+    @jax.jit
+    def decode(params, cache, buf, rng):
+        def step(carry, i):
+            cache, buf, rng = carry
+            tok = jax.lax.dynamic_slice_in_dim(buf, i, 1, axis=1)  # [b, 1]
+            logits, mut = dm.apply(
+                {**params, "cache": cache}, tok, mutable=["cache"])
+            cache = mut["cache"]
+            logit = logits[:, 0]
+            if temperature > 0:
+                rng, key = jax.random.split(rng)
+                sampled = jax.random.categorical(key, logit / temperature)
+            else:
+                sampled = jnp.argmax(logit, axis=-1)
+            # within the prompt the next token is already known
+            known = jax.lax.dynamic_slice_in_dim(buf, i + 1, 1, axis=1)[:, 0]
+            nxt = jnp.where(i + 1 < p, known, sampled).astype(jnp.int32)
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, nxt[:, None], i + 1, axis=1)
+            return (cache, buf, rng), None
+
+        (_, buf, _), _ = jax.lax.scan(
+            step, (cache, buf, rng), jnp.arange(total - 1))
+        return buf
+
+    return decode(params, cache, buf, rng)
+
+
 def build_parser() -> argparse.ArgumentParser:
     """The BERT flag surface with decoder defaults (GPT-2-medium shapes,
     GPT-2 vocab)."""
@@ -149,7 +222,7 @@ def run(args, mesh=None) -> Dict[str, Any]:
         # every process enters the SPMD decode (the trained params are
         # globally sharded); only the print is rank-gated
         prompt = jnp.asarray(ids[:1, : min(8, args.seq_len - n_gen)])
-        out = generate(model, result["state"]["params"], prompt, n_gen)
+        out = generate_cached(model, result["state"]["params"], prompt, n_gen)
         if pe.process_id == 0:
             print(f"generated ids: {jax.device_get(out)[0].tolist()}")
     return result
