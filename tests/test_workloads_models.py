@@ -734,6 +734,54 @@ class TestGpt:
             gptlib.generate(model, v, prompt, 2, temperature=1.0)
 
 
+class TestRealTextData:
+    """--data-file: byte-level real-corpus training for the LM families
+    (the reference example's real-dataset path, LM-shaped)."""
+
+    def _corpus(self, tmp_path, size=8192):
+        p = tmp_path / "corpus.txt"
+        p.write_bytes(bytes((i * 37 + 11) % 251 for i in range(size)))
+        return str(p)
+
+    def test_byte_dataset_chunks(self, tmp_path):
+        from tpujob.workloads import data as datalib
+
+        path = self._corpus(tmp_path, size=300)
+        chunks = datalib.byte_token_dataset(path, 64)
+        assert chunks.shape == (4, 64) and chunks.dtype == np.int32
+        raw = np.fromfile(path, dtype=np.uint8)
+        np.testing.assert_array_equal(chunks.reshape(-1), raw[:256])
+        with pytest.raises(ValueError, match="shorter"):
+            datalib.byte_token_dataset(path, 1024)
+
+    def test_batches_cycle_per_step(self, tmp_path):
+        from tpujob.workloads import bert as bertlib_
+        from tpujob.workloads import distributed as dist_
+
+        args = tiny_bert_args(tmp_path, vocab=256,
+                              data_file=self._corpus(tmp_path))
+        ids0, provider, sample = bertlib_.token_batches(
+            args, dist_.process_env({}))
+        assert provider is not None and ids0.shape == (16, 64)
+        assert sample.shape == (1, 64)
+        assert not np.array_equal(provider(0), provider(1))
+        np.testing.assert_array_equal(provider(0), ids0)  # step 0 = template
+        np.testing.assert_array_equal(provider(3), provider(3))  # deterministic
+
+    def test_gpt_learns_real_text(self, tmp_path):
+        from tpujob.workloads import gpt as gptlib
+
+        res = gptlib.run(tiny_gpt_args(tmp_path, vocab=256, steps=30,
+                                       lr=0.003,
+                                       data_file=self._corpus(tmp_path)))
+        assert res["final_loss"] < 4.5, res  # ln(256)=5.55 at chance
+
+    def test_data_file_needs_byte_vocab(self, tmp_path):
+        with pytest.raises(ValueError, match="vocab"):
+            bertlib.run(tiny_bert_args(tmp_path, steps=1,
+                                       data_file=self._corpus(tmp_path)))
+
+
 class TestResNet:
     def _args(self, tmp_path, **over):
         argv = ["--width", "16", "--image-size", "64", "--batch-size", "16",

@@ -387,8 +387,38 @@ def build_parser() -> argparse.ArgumentParser:
     train_lib.add_profile_flags(p)
     p.add_argument("--checkpoint-interval", type=int, default=0,
                    help="steps between checkpoints; 0 disables")
+    p.add_argument("--data-file", default=None,
+                   help="train on this file's raw bytes as a byte-level "
+                        "corpus (vocab must be >= 256) instead of "
+                        "synthetic tokens; batches cycle the chunks "
+                        "deterministically per step")
     p.add_argument("--dir", default="logs")
     return p
+
+
+def token_batches(args, pe):
+    """(template local batch ids, provider(step)->ids or None, sample row):
+    synthetic fixed batch by default; with --data-file, deterministic
+    per-step cycling over the file's byte chunks.  ``sample`` is global
+    row 0 — IDENTICAL on every host (generation prompts must agree
+    across the SPMD decode, unlike the per-host local slice)."""
+    lo, sz = dist.local_batch_slice(args.batch_size, pe)
+    if not getattr(args, "data_file", None):
+        ids = datalib.synthetic_token_batch(
+            args.batch_size, args.seq_len, args.vocab)
+        return ids[lo : lo + sz], None, ids[0:1]
+    if args.vocab < 256:
+        raise ValueError(
+            f"--data-file is a byte-level corpus: --vocab {args.vocab} "
+            "must be >= 256")
+    chunks = datalib.byte_token_dataset(args.data_file, args.seq_len)
+
+    def provider(step: int):
+        # gather only this host's rows of the global step batch
+        idx = (np.arange(lo, lo + sz) + step * args.batch_size) % len(chunks)
+        return chunks[idx]
+
+    return provider(0), provider, chunks[0:1]
 
 
 def moe_config_from(args, mesh=None) -> Optional[MoEConfig]:
@@ -539,7 +569,7 @@ def build_model(args, mesh, *, causal: bool = False,
 
 
 def train(args, mesh, pe, model, make_loss, local_batch, *,
-          tag: str = "bert") -> Dict[str, Any]:
+          tag: str = "bert", batch_provider=None) -> Dict[str, Any]:
     """Shared SPMD training driver for the transformer families (BERT here,
     GPT in ``tpujob.workloads.gpt``): sharded init by PARTITION_RULES,
     pipeline apply_fn wiring, AOT compile, step-exact checkpoint/resume,
@@ -549,6 +579,10 @@ def train(args, mesh, pe, model, make_loss, local_batch, *,
     loss (apply_fn is None for the standard forward, or the pipelined
     forward when --pipeline-parallel is set); ``local_batch`` is this
     process's rows of the global batch (a tuple of arrays).
+    ``batch_provider(step) -> local batch tuple`` (optional) supplies a
+    DIFFERENT batch per step — same shapes as ``local_batch`` (the AOT
+    template), deterministic in ``step`` so checkpoint resume replays the
+    exact stream (the --data-file real-corpus path).
     """
     writer = train_lib.SummaryWriter(args.dir, enabled=pe.process_id == 0)
     accum = getattr(args, "grad_accum", 1)
@@ -641,6 +675,8 @@ def train(args, mesh, pe, model, make_loss, local_batch, *,
     try:
         for i in range(start_step, args.steps):
             profiler.step(i - start_step, block_on=loss)
+            if batch_provider is not None:
+                batch = train_lib.put_batch(batch_provider(i), mesh)
             state, loss = compiled(state, batch)
             if i % args.log_interval == 0:
                 writer.add_scalar("loss", float(loss), i)
@@ -672,12 +708,24 @@ def run(args, mesh=None) -> Dict[str, Any]:
     if mesh is None:
         mesh = make_mesh_for(args, pe)
     model = build_model(args, mesh)
+    ids0, provider, _ = token_batches(args, pe)
     lo, sz = dist.local_batch_slice(args.batch_size, pe)
-    ids = datalib.synthetic_token_batch(args.batch_size, args.seq_len, args.vocab)
-    ids, mask = mask_batch(ids, args.seed)
+
+    def masked(ids_local, seed):
+        # draw the GLOBAL mask (same seed on every host) and slice this
+        # host's rows, so masked positions stay i.i.d. across the global
+        # batch — masking the local slice directly would repeat one
+        # pattern on every host
+        _, mask = mask_batch(
+            np.zeros((args.batch_size, args.seq_len), np.int32), seed)
+        return ids_local, mask[lo : lo + sz]
+
+    bp = None
+    if provider is not None:
+        bp = lambda step: masked(provider(step), args.seed + step)
     return train(args, mesh, pe, model,
                  lambda af: mlm_loss(model, apply_fn=af),
-                 (ids[lo : lo + sz], mask[lo : lo + sz]))
+                 masked(ids0, args.seed), batch_provider=bp)
 
 
 def main(argv=None) -> int:

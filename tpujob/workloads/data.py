@@ -181,3 +181,24 @@ def synthetic_token_batch(batch: int, seq_len: int, vocab: int = 30522, seed: in
     rng = np.random.RandomState(seed)
     ids = rng.randint(0, vocab, size=(batch, seq_len)).astype(np.int32)
     return ids
+
+
+def byte_token_dataset(path: str, seq_len: int,
+                       limit_chunks: Optional[int] = None) -> np.ndarray:
+    """Real-text LM data with zero dependencies: the file's raw bytes,
+    chunked to [n, seq_len] int32 token ids (vocab 256).
+
+    The byte-level analog of the reference example's real-dataset path
+    (its MNIST streams FashionMNIST, ``examples/mnist/mnist.py:117-132``)
+    for the LM workloads — any text or binary file is a corpus, with no
+    tokenizer download (zero-egress-safe).
+    """
+    raw = np.fromfile(path, dtype=np.uint8)
+    n = len(raw) // seq_len
+    if limit_chunks is not None:
+        n = min(n, limit_chunks)
+    if n == 0:
+        raise ValueError(
+            f"{path!r} holds {len(raw)} bytes — shorter than one "
+            f"seq_len={seq_len} chunk")
+    return raw[: n * seq_len].reshape(n, seq_len).astype(np.int32)

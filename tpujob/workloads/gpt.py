@@ -25,7 +25,6 @@ import jax
 import jax.numpy as jnp
 
 from tpujob.workloads import bert as bertlib
-from tpujob.workloads import data as datalib
 from tpujob.workloads import distributed as dist
 
 
@@ -213,15 +212,16 @@ def run(args, mesh=None) -> Dict[str, Any]:
     if mesh is None:
         mesh = make_mesh_for(args, pe)
     model = build_model(args, mesh)
-    lo, sz = dist.local_batch_slice(args.batch_size, pe)
-    ids = datalib.synthetic_token_batch(args.batch_size, args.seq_len, args.vocab)
+    ids0, provider, sample = bertlib.token_batches(args, pe)
+    bp = None if provider is None else (lambda step: (provider(step),))
     result = bertlib.train(args, mesh, pe, model,
                            lambda af: lm_loss(model, apply_fn=af),
-                           (ids[lo : lo + sz],), tag="gpt")
+                           (ids0,), tag="gpt", batch_provider=bp)
     if n_gen > 0:
-        # every process enters the SPMD decode (the trained params are
-        # globally sharded); only the print is rank-gated
-        prompt = jnp.asarray(ids[:1, : min(8, args.seq_len - n_gen)])
+        # every process enters the SPMD decode with the SAME prompt
+        # (global row 0, not this host's local slice); only the print is
+        # rank-gated
+        prompt = jnp.asarray(sample[:, : min(8, args.seq_len - n_gen)])
         out = generate_cached(model, result["state"]["params"], prompt, n_gen)
         if pe.process_id == 0:
             print(f"generated ids: {jax.device_get(out)[0].tolist()}")
