@@ -33,7 +33,32 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30  # finite: a fully-masked row must not NaN the running max
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+def _block_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k):
+    """Masked scaled scores S_ij = mask(scale·Q_i K_j^T) for one block pair
+    — THE shared definition across the forward and both backward kernels,
+    so the backward's recomputed P can never drift from the forward's."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [block_q, block_k]
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return s
+
+
+def _causal_live(qi, ki, block_q, block_k):
+    """A K block strictly in the future of every Q row contributes nothing
+    — its matmuls are skipped entirely."""
+    return ki * block_k <= qi * block_q + block_q - 1
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, scale: float, causal: bool, block_q: int, block_k: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -44,26 +69,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # causal: a K block strictly in the future of every Q row contributes
-    # nothing — skip its matmuls entirely (the ki==0 block is never fully
-    # masked, so _init above always runs)
-    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+    # the ki==0 block is never fully masked, so _init above always runs
+    live = _causal_live(qi, ki, block_q, block_k) if causal else True
 
     @pl.when(live)
     def _update():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [block_q, block_k]
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _block_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k)
         # running softmax: m/l replicated across the 128-lane dim so the
         # scratch keeps MXU/VPU-native tiling
         m_prev = m_ref[:, :1]                      # [block_q, 1]
@@ -80,21 +93,31 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     @pl.when(ki == pl.num_programs(2) - 1)
     def _finalize():
         o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+        # logsumexp per row, saved for the backward's P recomputation.
+        # Stored 128-lane-replicated: Mosaic requires the last block dim be
+        # a multiple of 128, so a flat [rows] layout cannot lower (the
+        # official TPU flash kernel stores its residuals the same way).
+        lse_ref[0] = m_ref[:] + jnp.log(l_ref[:])
+
+
+def _fold(x):  # [b, s, h, d] -> [b*h, s, d]
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold(x, b, h):  # [b*h, s, d] -> [b, s, h, d]
+    return x.reshape(b, h, x.shape[1], x.shape[2]).transpose(0, 2, 1, 3)
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     b, sq, h, d = q.shape
     sk = k.shape[1]
-
-    def fold(x):  # [b, s, h, d] -> [b*h, s, d]
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-
     grid = (b * h, sq // block_q, sk // block_k)
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -102,41 +125,172 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 128), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu_vmem((block_q, 128), jnp.float32),  # running max m
             pltpu_vmem((block_q, 128), jnp.float32),  # running sum l
             pltpu_vmem((block_q, d), jnp.float32),    # output accumulator
         ],
         interpret=interpret,
-    )(fold(q), fold(k), fold(v))
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    )(_fold(q), _fold(k), _fold(v))
+    return _unfold(out, b, h), lse
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale: float, causal: bool,
+               block_q: int, block_k: int):
+    """dQ_i = scale * sum_j (P_ij ∘ (dO_i V_j^T − D_i)) K_j, P recomputed
+    in VMEM from the saved logsumexp (FlashAttention-2 eq. for dS)."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = _causal_live(qi, ki, block_q, block_k) if causal else True
+
+    @pl.when(live)
+    def _update():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _block_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])           # [block_q, block_k]
+        dp = jax.lax.dot_general(                    # dO V^T
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                causal: bool, block_q: int, block_k: int):
+    """dV_j = sum_i P_ij^T dO_i;  dK_j = scale * sum_i dS_ij^T Q_i — one
+    K/V block accumulates over the (sequentially iterated) Q blocks."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = _causal_live(qi, ki, block_q, block_k) if causal else True
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _block_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])           # [block_q, block_k]
+        dv_acc[:] += jax.lax.dot_general(            # P^T dO
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(                    # dO V^T
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dk_acc[:] += jax.lax.dot_general(            # dS^T Q
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    """FlashAttention-2 backward: two Pallas passes (dQ; then dK+dV), each
+    recomputing its P blocks in VMEM from the forward's logsumexp — no
+    [seq, seq] tensor ever reaches HBM, so long-context *training* has the
+    same O(S·d) memory as the forward.  ``D_i = rowsum(dO_i ∘ O_i)`` (the
+    softmax-Jacobian row term) is a cheap elementwise reduction XLA fuses,
+    so it stays outside the kernels."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    dof = _fold(g)
+    # D = rowsum(dO * O): [b*h, sq] f32, stored 128-lane-replicated like
+    # the lse (Mosaic block layout requirement)
+    delta = (dof.astype(jnp.float32) * _fold(out).astype(jnp.float32)).sum(-1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (128,))
+
+    q_spec3 = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
+    k_spec3 = pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0))
+    r_spec3 = pl.BlockSpec((1, block_q, 128), lambda bh, qi, ki: (bh, qi, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b * h, sq // block_q, sk // block_k),
+        in_specs=[q_spec3, k_spec3, k_spec3, q_spec3, r_spec3, r_spec3],
+        out_specs=q_spec3,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu_vmem((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    # dK/dV: K-block outer, Q-block inner (the sequential axis accumulates)
+    q_specT = pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
+    k_specT = pl.BlockSpec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0))
+    r_specT = pl.BlockSpec((1, block_q, 128), lambda bh, ki, qi: (bh, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b * h, sk // block_k, sq // block_q),
+        in_specs=[q_specT, k_specT, k_specT, q_specT, r_specT, r_specT],
+        out_specs=[k_specT, k_specT],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu_vmem((block_k, d), jnp.float32),
+            pltpu_vmem((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+    return (_unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    # backward recomputes the dense attention and differentiates it — the
-    # memory win applies to the forward/inference path; a Pallas backward
-    # kernel is the follow-up (this matches what XLA's dense path does
-    # during training anyway, so training sees no regression vs dense)
-    from tpujob.workloads.parallel import full_attention
-
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: full_attention(q, k, v, causal=causal, scale=scale),
-        q, k, v,
-    )
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal, scale,
+                           block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -158,7 +312,9 @@ def flash_attention(
     ``interpret=None`` auto-selects the Pallas interpreter off-TPU (tests,
     CPU meshes) and the compiled Mosaic kernel on TPU.  Shapes that don't
     tile (seq % block != 0) fall back to dense attention.  Differentiable
-    via a recompute backward (see ``_flash_bwd``).
+    via the FlashAttention-2 Pallas backward (``_flash_backward``): P
+    blocks are recomputed in VMEM from the saved logsumexp, so training at
+    long sequence length keeps the same O(S·d) memory as the forward.
     """
     from tpujob.workloads.parallel import full_attention
 
