@@ -59,6 +59,44 @@ class TestRingAttention:
         g = jax.grad(loss)(q)
         assert np.isfinite(np.asarray(g)).all()
 
+    def test_causal_grads_match_dense(self):
+        """The zigzag causal path (input-selected chunk pairs, folded
+        accumulators) must differentiate exactly like dense attention."""
+        q, k, v = self._qkv(seed=4)
+        mesh = dist.make_mesh({"sequence": 8}, env=cpu_env())
+        g = jax.jit(jax.grad(lambda q: parallel.ring_attention(
+            q, k, v, mesh, causal=True).sum()))(q)
+        gd = jax.grad(lambda q: parallel.full_attention(
+            q, k, v, causal=True).sum())(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gd),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_causal_untileable_falls_back(self):
+        """Sequence not divisible into 2n zigzag chunks: the contiguous
+        masked path (with lax.cond dead-block skip) must still be exact."""
+        q, k, v = self._qkv(s=40, seed=6)  # 40 % 16 != 0
+        mesh = dist.make_mesh({"sequence": 8}, env=cpu_env())
+        ring = parallel.ring_attention(q, k, v, mesh, causal=True)
+        full = parallel.full_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(full),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_causal_zigzag_skips_dead_blocks(self):
+        """Causal ring work is (2n+1)/4n of non-causal (0.5625 at n=4):
+        the zigzag assignment never computes a fully-masked block, and the
+        unrolled hops make compiled cost analysis count every einsum —
+        so the ratio is measurable, not inferred."""
+        q, k, v = self._qkv(s=256, d=16, seed=8)
+        mesh = dist.make_mesh({"data": -1, "sequence": 4}, env=cpu_env())
+        fl = {}
+        for causal in (True, False):
+            fl[causal] = jax.jit(
+                lambda q, k, v, c=causal: parallel.ring_attention(
+                    q, k, v, mesh, causal=c)
+            ).lower(q, k, v).compile().cost_analysis()["flops"]
+        ratio = fl[True] / fl[False]
+        assert 0.45 < ratio < 0.65, f"causal/non-causal flops {ratio:.3f}"
+
 
 class TestUlyssesAttention:
     def _qkv(self, b=2, s=32, h=8, d=8, seed=0):
@@ -164,13 +202,26 @@ class TestFlashAttention:
                                    rtol=2e-5, atol=2e-5)
 
     def test_grads_match_dense(self):
+        """The Pallas FlashAttention-2 backward (dq/dk/dv recomputed from
+        the saved logsumexp) must match dense differentiation — all three
+        grads, multi-block shapes, causal and not, a non-trivial
+        cotangent."""
         from tpujob.workloads.flash import flash_attention
 
-        q, k, v = self._qkv(s=128)
-        g_flash = jax.grad(lambda q: flash_attention(q, k, v).sum())(q)
-        g_dense = jax.grad(lambda q: parallel.full_attention(q, k, v).sum())(q)
-        np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_dense),
-                                   rtol=2e-5, atol=2e-5)
+        for causal, seed in ((False, 0), (True, 7)):
+            q, k, v = self._qkv(s=256, seed=seed)
+            ct = jax.random.normal(jax.random.PRNGKey(seed + 1), q.shape)
+
+            def loss(fn, causal=causal):
+                return lambda q, k, v: jnp.sum(
+                    fn(q, k, v, causal=causal) * ct)
+
+            gf = jax.grad(loss(flash_attention), (0, 1, 2))(q, k, v)
+            gd = jax.grad(loss(parallel.full_attention), (0, 1, 2))(q, k, v)
+            for a, b, name in zip(gf, gd, "qkv"):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5,
+                    err_msg=f"d{name} mismatch (causal={causal})")
 
 
 class TestPartitionRules:
@@ -705,20 +756,59 @@ class TestGpt:
         cached = gptlib.generate_cached(model, v, prompt, 6)
         np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
 
-    def test_generate_cached_moe_falls_back_exact(self, tmp_path):
-        """MoE capacity is sequence-length-dependent, so cached decode
-        must route to the full re-forward — outputs equal generate()."""
+    def test_moe_generate_is_causal(self, tmp_path):
+        """MoE decode must be causal despite the fixed-length buffer.
+
+        Without the routing validity mask, padding positions past the
+        cursor compete for expert-capacity slots in k-major priority order
+        and can evict a realized token's assignment — suffix contents then
+        change prefix logits (observed in 16/20 trials at cf=0.5).
+
+        (1) With the mask, realized-position logits are invariant to the
+            suffix buffer contents even at tight capacity — and the test
+            proves it has teeth by asserting the UNMASKED forward does
+            differ under the same perturbation.
+        (2) At capacity that can never overflow (cf = E/k, so cap >= s),
+            generate() exactly equals a token-by-token re-forward over
+            only the realized prefix, and generate_cached's MoE fallback
+            inherits it.
+        """
         from tpujob.workloads import gpt as gptlib
 
-        args = tiny_gpt_args(tmp_path, seq_len=32, vocab=97, moe_experts=4)
         mesh = dist.make_mesh({"data": -1}, env=cpu_env())
+        args = tiny_gpt_args(tmp_path, seq_len=32, vocab=97, moe_experts=4,
+                             moe_capacity_factor=0.5)
         model = gptlib.build_model(args, mesh)
         v = {"params": model.init(jax.random.PRNGKey(0),
                                   jnp.zeros((1, 32), jnp.int32))["params"]}
-        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 97)
-        full = gptlib.generate(model, v, prompt, 4)
-        cached = gptlib.generate_cached(model, v, prompt, 4)
-        np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0, 97)
+        total, p = 12, 6
+        valid = (jnp.arange(total)[None, :] < p) * jnp.ones((2, 1))
+        pads = [jnp.zeros((2, total - p), jnp.int32),
+                jax.random.randint(jax.random.PRNGKey(9), (2, total - p),
+                                   0, 97)]
+        bufs = [jnp.concatenate([prompt, pad], 1) for pad in pads]
+        masked = [np.asarray(model.apply(v, b, valid)[:, :p]) for b in bufs]
+        np.testing.assert_allclose(masked[0], masked[1], rtol=1e-5, atol=1e-5)
+        raw = [np.asarray(model.apply(v, b)[:, :p]) for b in bufs]
+        assert np.abs(raw[0] - raw[1]).max() > 1e-4, \
+            "perturbation has no teeth: unmasked forward already invariant"
+
+        # (2) overflow-free capacity: buffer decode == prefix re-forward
+        args2 = tiny_gpt_args(tmp_path, seq_len=32, vocab=97, moe_experts=4,
+                              moe_capacity_factor=2.0)  # cap >= s at E=4,k=2
+        model2 = gptlib.build_model(args2, mesh)
+        v2 = {"params": model2.init(jax.random.PRNGKey(0),
+                                    jnp.zeros((1, 32), jnp.int32))["params"]}
+        gen = np.asarray(gptlib.generate(model2, v2, prompt, 4))
+        toks = np.asarray(prompt)
+        for _ in range(4):
+            lg = model2.apply(v2, jnp.asarray(toks))
+            nxt = np.asarray(jnp.argmax(lg[:, -1], -1)).astype(toks.dtype)
+            toks = np.concatenate([toks, nxt[:, None]], 1)
+        np.testing.assert_array_equal(gen, toks)
+        cached = np.asarray(gptlib.generate_cached(model2, v2, prompt, 4))
+        np.testing.assert_array_equal(gen, cached)
 
     def test_generate_sampling_and_bounds(self, tmp_path):
         gptlib, model, v, prompt = self._gen_setup(tmp_path)
@@ -780,6 +870,17 @@ class TestRealTextData:
         with pytest.raises(ValueError, match="vocab"):
             bertlib.run(tiny_bert_args(tmp_path, steps=1,
                                        data_file=self._corpus(tmp_path)))
+        # 256 covers the bytes but leaves no room for the [MASK] token —
+        # a genuine 0x67 byte must never be confusable with a mask (the
+        # MLM path reserves id 256; GPT is fine at 256)
+        with pytest.raises(ValueError, match="257"):
+            bertlib.run(tiny_bert_args(tmp_path, steps=1, vocab=256,
+                                       data_file=self._corpus(tmp_path)))
+
+    def test_bert_mlm_real_text_uses_reserved_mask(self, tmp_path):
+        res = bertlib.run(tiny_bert_args(tmp_path, vocab=257, steps=2,
+                                         data_file=self._corpus(tmp_path)))
+        assert np.isfinite(res["final_loss"])
 
 
 class TestResNet:

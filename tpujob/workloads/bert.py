@@ -88,7 +88,7 @@ class MoEMlp(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, valid=None):
         e = self.cfg.experts
         router = self.param("router", nn.initializers.normal(0.02),
                             (self.hidden, e), jnp.float32)
@@ -99,7 +99,7 @@ class MoEMlp(nn.Module):
         y, metrics = parallel.moe_ffn(
             x, router, wi.astype(self.dtype), wo.astype(self.dtype),
             self.cfg.mesh, k=self.cfg.k,
-            capacity_factor=self.cfg.capacity_factor,
+            capacity_factor=self.cfg.capacity_factor, valid=valid,
         )
         self.sow("moe_metrics", "load_balance", metrics["load_balance"])
         self.sow("moe_metrics", "router_z", metrics["router_z"])
@@ -165,13 +165,13 @@ class Block(nn.Module):
     cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, valid=None):
         a = Attention(self.hidden, self.heads, self.dtype,
                       self.attention_fn, self.cache_len, name="attn")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x + a)
         if self.moe is not None:
             h = MoEMlp(self.hidden, self.intermediate, self.moe,
-                       self.dtype, name="moe")(x)
+                       self.dtype, name="moe")(x, valid)
         else:
             h = nn.Dense(self.intermediate, dtype=self.dtype, name="mlp_wi")(x)
             h = nn.gelu(h)
@@ -250,10 +250,14 @@ class Bert(nn.Module):
         # tied LM head: logits through the embedding transpose
         return self.token_embed.attend(x.astype(jnp.float32))[..., : self.vocab]
 
-    def __call__(self, ids):
+    def __call__(self, ids, valid=None):
+        # ``valid`` ([batch, seq] 0/1, optional) marks positions that are
+        # real tokens; MoE routing skips the rest so a fixed decode buffer
+        # stays causal (see ``parallel.moe_ffn``).  Dense models ignore it
+        # (the causal attention mask already makes padding inert).
         x = self.embed(ids)
         for i in range(self.layers):
-            x = getattr(self, f"layer_{i}")(x)
+            x = getattr(self, f"layer_{i}")(x, valid)
         return self.head(x)
 
 
@@ -292,16 +296,19 @@ def _mean_sown(tree, name) -> Any:
 
 
 def mlm_loss(model: Bert, aux_coef: float = 0.01, z_coef: float = 1e-3,
-             apply_fn: Optional[Callable] = None):
+             apply_fn: Optional[Callable] = None, mask_id: int = 103):
     """Masked-LM: mask 15% of positions deterministically per step-seed,
     predict the original ids.  MoE models add the load-balance aux loss and
     router z-loss collected from the ``moe_metrics`` collection.
     ``apply_fn(params, ids) -> logits`` overrides the forward (the
-    pipeline-parallel path plugs ``pipeline_apply`` in here)."""
+    pipeline-parallel path plugs ``pipeline_apply`` in here).
+    ``mask_id``: the [MASK] token — 103 (the WordPiece id) for synthetic
+    vocabularies; byte-level corpora use 256 so a literal 0x67 byte is
+    never confused with a masked position (see ``run``)."""
 
     def loss_fn(params, batch):
         ids, mask = batch  # mask: 1.0 where position is masked/predicted
-        masked_ids = jnp.where(mask > 0, jnp.int32(103), ids)  # [MASK]=103
+        masked_ids = jnp.where(mask > 0, jnp.int32(mask_id), ids)
         if apply_fn is not None:
             logits, sown = apply_fn(params, masked_ids), {}
         elif model.moe is not None:
@@ -389,8 +396,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="steps between checkpoints; 0 disables")
     p.add_argument("--data-file", default=None,
                    help="train on this file's raw bytes as a byte-level "
-                        "corpus (vocab must be >= 256) instead of "
-                        "synthetic tokens; batches cycle the chunks "
+                        "corpus instead of synthetic tokens (vocab >= 256; "
+                        "the MLM objective needs >= 257 — id 256 is "
+                        "[MASK]); batches cycle the chunks "
                         "deterministically per step")
     p.add_argument("--dir", default="logs")
     return p
@@ -705,6 +713,16 @@ def train(args, mesh, pe, model, make_loss, local_batch, *,
 
 def run(args, mesh=None) -> Dict[str, Any]:
     pe = dist.initialize()
+    mask_id = 103
+    if getattr(args, "data_file", None):
+        # ids 0-255 are literal bytes, so the WordPiece [MASK]=103 would
+        # collide with genuine 0x67 bytes: reserve id 256 as the mask and
+        # require the vocabulary to carry it
+        if args.vocab < 257:
+            raise ValueError(
+                f"--data-file with the MLM objective needs --vocab >= 257 "
+                f"(256 byte values + the [MASK] token), got {args.vocab}")
+        mask_id = 256
     if mesh is None:
         mesh = make_mesh_for(args, pe)
     model = build_model(args, mesh)
@@ -724,7 +742,7 @@ def run(args, mesh=None) -> Dict[str, Any]:
     if provider is not None:
         bp = lambda step: masked(provider(step), args.seed + step)
     return train(args, mesh, pe, model,
-                 lambda af: mlm_loss(model, apply_fn=af),
+                 lambda af: mlm_loss(model, apply_fn=af, mask_id=mask_id),
                  masked(ids0, args.seed), batch_provider=bp)
 
 

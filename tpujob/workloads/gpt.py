@@ -67,9 +67,13 @@ def generate(
 
     Correctness-first design: each step runs the full forward over a
     fixed-length buffer — the causal mask makes positions past the cursor
-    inert, so the suffix padding cannot influence sampled tokens.  (A KV
-    cache would make each step O(1) in recompute; this is O(n) but
-    compiles to one executable with no dynamic shapes.)
+    inert, so the suffix padding cannot influence sampled tokens.  For MoE
+    models the attention mask alone is not enough (padding positions would
+    compete for expert-capacity slots and could evict a realized token's
+    assignment), so a validity mask additionally stops routing past the
+    cursor (``parallel.moe_ffn`` ``valid``).  (A KV cache would make each
+    step O(1) in recompute; this is O(n) but compiles to one executable
+    with no dynamic shapes.)
     ``temperature`` 0 = greedy argmax; > 0 samples from the softmax with
     ``rng``.  Returns [batch, prompt_len + max_new_tokens] token ids.
     """
@@ -89,7 +93,13 @@ def generate(
     def decode(params, buf, rng):
         def step(carry, i):
             buf, rng = carry
-            logits = model.apply(params, buf)  # [b, total, V]
+            if model.moe is not None:
+                # positions [0, p+i) are realized; the rest must not route
+                valid = (jnp.arange(total)[None, :] < p + i).astype(
+                    jnp.float32) * jnp.ones((b, 1))
+                logits = model.apply(params, buf, valid)  # [b, total, V]
+            else:
+                logits = model.apply(params, buf)  # [b, total, V]
             # token i is written at position p+i, predicted from p+i-1
             logit = jax.lax.dynamic_slice_in_dim(
                 logits, p + i - 1, 1, axis=1)[:, 0]

@@ -128,6 +128,22 @@ def _sp_batch_axis(mesh, batch_size: int) -> Optional[Tuple[str, ...]]:
     return None
 
 
+_UNROLL_MAX_HOPS = 16
+
+
+def _ring_hops(n: int, body, carry):
+    """Run ``body(i, carry)`` for the n ring hops.  Unrolled for small n:
+    XLA then sees every hop (cost analysis counts real FLOPs, and each
+    hop's ppermute can overlap the previous hop's compute instead of
+    hitting a loop barrier); ``fori_loop`` beyond that bounds compile
+    time."""
+    if n <= _UNROLL_MAX_HOPS:
+        for i in range(n):
+            carry = body(i, carry)
+        return carry
+    return jax.lax.fori_loop(0, n, body, carry)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -152,10 +168,22 @@ def ring_attention(
     ``head_axis`` additionally splits the heads dim over a tensor-parallel
     mesh axis (ring-over-sequence composes with Megatron-style TP: each
     device holds its head shard of its sequence block).
+
+    ``causal=True`` uses the zigzag block assignment when the sequence
+    tiles into 2n chunks (see ``_zigzag_ring_attention``): causal work is
+    then perfectly balanced across the ring and fully-masked future blocks
+    are never computed — (2n+1)/4n of the non-causal FLOPs (56% at n=4)
+    instead of paying every einsum and masking after.  Shapes that don't
+    tile fall back to the contiguous layout, which still skips dead
+    blocks' compute via ``lax.cond`` (runtime win, but the last device
+    remains the n-hop critical path).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n = mesh.shape[axis]
+    if causal and n > 1 and q.shape[1] == k.shape[1] \
+            and q.shape[1] % (2 * n) == 0:
+        return _zigzag_ring_attention(q, k, v, mesh, axis, head_axis, scale)
 
     def local(qb, kb, vb):
         idx = jax.lax.axis_index(axis)
@@ -169,23 +197,36 @@ def ring_attention(
             # kc/vc arrived from neighbour idx+1 at each hop, so after i
             # hops the resident block is (idx + i) % n
             src_block = (idx + i) % n
-            bias = None
+
+            def attend(mlo):
+                m, l, o = mlo
+                bias = None
+                if causal:
+                    sk = kc.shape[1]
+                    q_pos = idx * sq + jnp.arange(sq)
+                    k_pos = src_block * sk + jnp.arange(sk)
+                    mask = q_pos[:, None] >= k_pos[None, :]
+                    # finite mask value: a fully-masked block (all-future K)
+                    # must not poison the running max (exp(-inf+inf)=nan)
+                    bias = jnp.where(mask, 0.0, -1e30)[None, None]
+                return _block_attention(qb, kc, vc, bias, m, l, o, scale)
+
             if causal:
-                sk = kc.shape[1]
-                q_pos = idx * sq + jnp.arange(sq)
-                k_pos = src_block * sk + jnp.arange(sk)
-                mask = q_pos[:, None] >= k_pos[None, :]
-                # finite mask value: a fully-masked block (all-future K) must
-                # not poison the running max with -inf (exp(-inf+inf)=nan)
-                bias = jnp.where(mask, 0.0, -1e30)[None, None]
-            m, l, o = _block_attention(qb, kc, vc, bias, m, l, o, scale)
+                # a K/V block strictly in this Q shard's future contributes
+                # nothing — skip its einsums entirely (the block must still
+                # ride the ring for the devices behind us, but ~half the
+                # hops do no compute; a fully-masked bias would pay them)
+                m, l, o = jax.lax.cond(
+                    src_block <= idx, attend, lambda mlo: mlo, (m, l, o))
+            else:
+                m, l, o = attend((m, l, o))
             # rotate K/V to the next device (receive from idx+1)
             perm = [(j, (j - 1) % n) for j in range(n)]
             kc = jax.lax.ppermute(kc, axis, perm)
             vc = jax.lax.ppermute(vc, axis, perm)
             return m, l, o, kc, vc
 
-        m, l, o, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, o0, kb, vb))
+        m, l, o, _, _ = _ring_hops(n, step, (m0, l0, o0, kb, vb))
         out = o / l[..., None]
         return out.transpose(0, 2, 1, 3)  # [b, sq, h, d]
 
@@ -194,6 +235,118 @@ def ring_attention(
         local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)
+
+
+def _zigzag_ring_attention(q, k, v, mesh, axis, head_axis, scale):
+    """Causal ring attention with the zigzag (folded) block assignment.
+
+    The global sequence is cut into 2n chunks; device j holds the PAIR
+    (chunk j, chunk 2n-1-j) — one early, one late.  That folding balances
+    causal work exactly: for every arriving K/V pair from device s != j,
+    precisely two of the four (Q chunk x K chunk) combinations are live,
+    and both are *fully* visible (no mask needed):
+
+      - late Q (2n-1-j) x early K (s): always live, since 2n-1-j >= n > s;
+      - the third live pair flips with the ring direction: early Q x early
+        K when s < j, late Q x late K when s > j — selected with
+        ``jnp.where`` on the chunk inputs and accumulator, so the compiled
+        program has ONE einsum pair of static shape, not a branch.
+
+    The local hop (s = j) runs the two triangular diagonals plus the
+    always-live cross pair.  Total: 3 + 2(n-1) chunk-attentions versus
+    4n for the non-skipping contiguous schedule — (2n+1)/4n of the
+    FLOPs (56.25% at n=4, -> 50% as n grows), *balanced*, so the wall
+    clock drops with the FLOPs instead of bottlenecking on the last
+    device the way contiguous dead-block skipping does.  This is the
+    standard zigzag/striped causal ring layout (e.g. the zigzag variant
+    of ring flash attention); the permutation in and out of zigzag order
+    is two O(S·d) shuffles, negligible against the O(S²·d/n) attention.
+
+    Inputs/outputs are in natural sequence order, sharded on ``axis``
+    like :func:`ring_attention` — the zigzag layout is internal.
+    """
+    import numpy as np
+
+    n = mesh.shape[axis]
+    b, S, h, d = q.shape
+    c = S // (2 * n)
+    # device j's shard of the zigzag layout = chunks (j, 2n-1-j)
+    perm = np.concatenate([
+        np.r_[np.arange(j * c, (j + 1) * c),
+              np.arange((2 * n - 1 - j) * c, (2 * n - j) * c)]
+        for j in range(n)
+    ])
+    inv = np.argsort(perm)
+
+    def local(qb, kb, vb):
+        idx = jax.lax.axis_index(axis)
+        qA, qB = qb[:, :c], qb[:, c:]  # early chunk j, late chunk 2n-1-j
+        bl, _, hl, dl = qb.shape  # local sizes (batch/heads may be sharded)
+
+        def acc0():
+            m = jnp.full((bl, hl, c), -jnp.inf, q.dtype)
+            l = jnp.zeros((bl, hl, c), q.dtype)
+            o = jnp.zeros((bl, hl, c, dl), q.dtype)
+            return m, l, o
+
+        # triangular (within-chunk diagonal) bias; finite mask value as in
+        # the contiguous path
+        tri = jnp.where(
+            jnp.arange(c)[:, None] >= jnp.arange(c)[None, :], 0.0, -1e30
+        )[None, None]
+
+        # hop 0: the resident pair is our own (s = j)
+        kA, kB = kb[:, :c], kb[:, c:]
+        vA, vB = vb[:, :c], vb[:, c:]
+        mA, lA, oA = _block_attention(qA, kA, vA, tri, *acc0(), scale)
+        mB, lB, oB = _block_attention(qB, kA, vA, None, *acc0(), scale)
+        mB, lB, oB = _block_attention(qB, kB, vB, tri, mB, lB, oB, scale)
+
+        ring_perm = [(j, (j - 1) % n) for j in range(n)]
+
+        def hop(i, carry):
+            mA, lA, oA, mB, lB, oB, kc, vc = carry
+            kc = jax.lax.ppermute(kc, axis, ring_perm)
+            vc = jax.lax.ppermute(vc, axis, ring_perm)
+            s = (idx + i) % n  # owner of the newly resident pair
+            kA, kB = kc[:, :c], kc[:, c:]
+            vA, vB = vc[:, :c], vc[:, c:]
+            # late Q x early K: live and fully visible for every s != idx
+            mB, lB, oB = _block_attention(qB, kA, vA, None, mB, lB, oB, scale)
+            # the direction-dependent pair: early x early when the sender
+            # is behind us, late x late when ahead — same shapes either
+            # way, so select inputs and accumulator instead of branching
+            early = s < idx
+            q2 = jnp.where(early, qA, qB)
+            k2 = jnp.where(early, kA, kB)
+            v2 = jnp.where(early, vA, vB)
+            m2p = jnp.where(early, mA, mB)
+            l2p = jnp.where(early, lA, lB)
+            o2p = jnp.where(early, oA, oB)
+            m2, l2, o2 = _block_attention(q2, k2, v2, None, m2p, l2p, o2p,
+                                          scale)
+            mA = jnp.where(early, m2, mA)
+            lA = jnp.where(early, l2, lA)
+            oA = jnp.where(early, o2, oA)
+            mB = jnp.where(early, mB, m2)
+            lB = jnp.where(early, lB, l2)
+            oB = jnp.where(early, oB, o2)
+            return mA, lA, oA, mB, lB, oB, kc, vc
+
+        mA, lA, oA, mB, lB, oB, _, _ = _ring_hops(
+            n - 1, lambda i, cr: hop(i + 1, cr),
+            (mA, lA, oA, mB, lB, oB, kb, vb))
+        out = jnp.concatenate(
+            [oA / lA[..., None], oB / lB[..., None]], axis=2)
+        return out.transpose(0, 2, 1, 3)  # [b, 2c, h, d]
+
+    spec = P(_sp_batch_axis(mesh, q.shape[0]), axis, head_axis, None)
+    qz, kz, vz = (x[:, perm] for x in (q, k, v))
+    out = shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(qz, kz, vz)
+    return out[:, inv]
 
 
 def ulysses_attention(
@@ -379,6 +532,7 @@ def moe_ffn(
     k: int = 2,
     capacity_factor: float = 1.25,
     activation=jax.nn.gelu,
+    valid: Optional[jax.Array] = None,
 ):
     """Sparse MoE feed-forward with top-k routing and expert parallelism.
 
@@ -387,6 +541,16 @@ def moe_ffn(
     Returns ``(y, metrics)`` with y shaped like x and ``metrics`` carrying
     ``load_balance`` (Switch-style aux loss, 1.0 when perfectly balanced)
     and ``router_z`` (logit-magnitude regularizer).
+
+    ``valid``: optional [batch, seq] 0/1 mask — positions with 0 are not
+    routed at all: they consume no expert-capacity slots and their output
+    is 0 (the residual stream carries them).  This is what makes
+    autoregressive decode over a fixed buffer causal: without it, padding
+    positions past the cursor compete for capacity in k-major priority
+    order and can evict an earlier position's assignment once an expert
+    overflows (observed empirically — suffix edits changed prefix outputs
+    at low capacity).  The aux metrics are computed over valid positions
+    only.
 
     TPU-first dispatch (the GShard/GSPMD idiom): routing builds dense
     dispatch/combine masks per batch-row group and two einsums move tokens
@@ -417,6 +581,10 @@ def moe_ffn(
     # Position of each assignment in its expert's buffer, first choices
     # before second choices (priority order = k-major), per group (=row).
     oh = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)  # [b,s,k,E]
+    if valid is not None:
+        # unrouted positions: zero the whole assignment before the slot
+        # cumsum so they never occupy (or steal) a capacity slot
+        oh = oh * valid.astype(jnp.float32)[..., None, None]
     oh_prio = oh.transpose(0, 2, 1, 3).reshape(b, k * s, num_experts)
     pos = jnp.cumsum(oh_prio, axis=1) - 1.0  # [b, k*s, E]
     pos = jnp.sum(pos * oh_prio, axis=-1)  # [b, k*s] slot of each assignment
@@ -443,10 +611,19 @@ def moe_ffn(
     out = constrain(jnp.einsum("becf,efd->becd", h, wo))
     y = jnp.einsum("bsec,becd->bsd", combine, out)
 
-    # Switch aux loss: E * sum_e(frac_assigned_e * mean_prob_e); 1.0 when
-    # balanced.  router_z keeps logits small (numerical safety valve).
-    density = oh.sum(axis=(0, 1, 2)) / (b * s * k)
-    mean_prob = probs.mean(axis=(0, 1))
+    # Switch aux loss: E * sum_e(density_e * mean_prob_e); 1.0 when
+    # balanced.  density_e is the fraction of tokens whose TOP-1 choice is
+    # expert e (the Switch/GShard formulation — counting all k assignments
+    # minimizes at the same uniform point but carries slightly different
+    # gradients).  router_z keeps logits small (numerical safety valve).
+    if valid is not None:
+        vmask = valid.astype(jnp.float32)
+        denom = jnp.maximum(vmask.sum(), 1.0)
+        density = oh[:, :, 0, :].sum(axis=(0, 1)) / denom
+        mean_prob = (probs * vmask[..., None]).sum(axis=(0, 1)) / denom
+    else:
+        density = oh[:, :, 0, :].sum(axis=(0, 1)) / (b * s)
+        mean_prob = probs.mean(axis=(0, 1))
     load_balance = num_experts * jnp.sum(density * mean_prob)
     router_z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
     return y, {"load_balance": load_balance, "router_z": router_z}
