@@ -186,19 +186,64 @@ def synthetic_token_batch(batch: int, seq_len: int, vocab: int = 30522, seed: in
 def byte_token_dataset(path: str, seq_len: int,
                        limit_chunks: Optional[int] = None) -> np.ndarray:
     """Real-text LM data with zero dependencies: the file's raw bytes,
-    chunked to [n, seq_len] int32 token ids (vocab 256).
+    chunked to [n, seq_len] token ids (vocab 256).
 
     The byte-level analog of the reference example's real-dataset path
     (its MNIST streams FashionMNIST, ``examples/mnist/mnist.py:117-132``)
     for the LM workloads — any text or binary file is a corpus, with no
     tokenizer download (zero-egress-safe).
+
+    The returned array is a **memory-mapped view** (uint8): a multi-GB
+    corpus costs no host RAM until rows are actually sliced; fancy-indexed
+    row reads (``chunks[idx]``) materialize only those rows.  Callers
+    convert the sliced batch to int32 (``.astype``) at feed time.
     """
-    raw = np.fromfile(path, dtype=np.uint8)
-    n = len(raw) // seq_len
+    size = os.path.getsize(path)
+    n = size // seq_len
     if limit_chunks is not None:
         n = min(n, limit_chunks)
     if n == 0:
         raise ValueError(
-            f"{path!r} holds {len(raw)} bytes — shorter than one "
+            f"{path!r} holds {size} bytes — shorter than one "
             f"seq_len={seq_len} chunk")
-    return raw[: n * seq_len].reshape(n, seq_len).astype(np.int32)
+    raw = np.memmap(path, dtype=np.uint8, mode="r", shape=(n * seq_len,))
+    return raw.reshape(n, seq_len)
+
+
+def bpe_token_dataset(path: str, seq_len: int, tokenizer,
+                      cache_dir: Optional[str] = None) -> np.ndarray:
+    """BPE-tokenized corpus as memory-mapped [n, seq_len] chunks.
+
+    The corpus is encoded ONCE into a sidecar token file next to the
+    corpus and memory-mapped thereafter (uint16 when the vocab fits, else
+    uint32) — the RAM cost per host is the sliced batch, not the corpus.
+    The sidecar name carries a digest of the tokenizer's merges AND the
+    corpus size/mtime, so editing the corpus or retraining the tokenizer
+    invalidates the cache instead of silently serving stale tokens.
+    """
+    import hashlib
+
+    v = tokenizer.vocab_size
+    dtype = np.uint16 if v <= np.iinfo(np.uint16).max else np.uint32
+    st = os.stat(path)
+    key = hashlib.sha1(
+        repr((tokenizer.merges, st.st_size, st.st_mtime_ns)).encode()
+    ).hexdigest()[:12]
+    base = os.path.join(cache_dir, os.path.basename(path)) if cache_dir else path
+    sidecar = f"{base}.bpe{v}-{key}.tokens"
+    if not os.path.exists(sidecar):
+        with open(path, "rb") as f:
+            ids = tokenizer.encode(f.read())
+        # per-process tmp name + atomic replace: concurrent hosts building
+        # the same cache race benignly (last replace wins, same content)
+        tmp = f"{sidecar}.{os.getpid()}.tmp"
+        ids.astype(dtype).tofile(tmp)
+        os.replace(tmp, sidecar)
+    count = os.path.getsize(sidecar) // np.dtype(dtype).itemsize
+    n = count // seq_len
+    if n == 0:
+        raise ValueError(
+            f"{path!r} encodes to {count} BPE tokens — shorter than one "
+            f"seq_len={seq_len} chunk")
+    toks = np.memmap(sidecar, dtype=dtype, mode="r", shape=(n * seq_len,))
+    return toks.reshape(n, seq_len)
