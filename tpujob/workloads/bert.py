@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -57,8 +58,15 @@ PARTITION_RULES = (
     (r"mlp_wi/kernel", P("fsdp", "tensor")),
     (r"mlp_wi/bias", P("tensor")),
     (r"mlp_wo/kernel", P("tensor", "fsdp")),
-    (r"token_embed/embedding", P("tensor", "fsdp")),
-    (r"pos_embed", P(None, "fsdp")),
+    # Embeddings: vocab split over tensor AND fsdp (the padded vocab is a
+    # multiple of 128, so both divide).  Sharding the hidden dim over fsdp
+    # instead would make every lookup emit a hidden-over-fsdp activation
+    # that must reshard to the batch layout — the "involuntary full
+    # rematerialization" the SPMD partitioner warns about on fsdp x tensor
+    # meshes.  Vocab-dim sharding keeps the same ZeRO memory win and lets
+    # the lookup resolve as masked-gather + psum with batch-sharded output.
+    (r"token_embed/embedding", P(("tensor", "fsdp"), None)),
+    (r"pos_embed", P("fsdp", None)),
     # MoE: experts split over the expert axis, each expert's FFN optionally
     # Megatron-split over tensor; the router stays replicated (it is tiny
     # and every token needs it)
@@ -163,6 +171,10 @@ class Block(nn.Module):
     attention_fn: Optional[Callable] = None
     moe: Optional[MoEConfig] = None
     cache_len: int = 0
+    # mesh for activation sharding annotations (dist.constrain_activation);
+    # None inside manual regions (the pipeline's stage_fn), where a
+    # sharding constraint would be illegal
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, valid=None):
@@ -176,7 +188,12 @@ class Block(nn.Module):
             h = nn.Dense(self.intermediate, dtype=self.dtype, name="mlp_wi")(x)
             h = nn.gelu(h)
             h = nn.Dense(self.hidden, dtype=self.dtype, name="mlp_wo")(h)
-        return nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x + h)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x + h)
+        # pin the block boundary to the batch-sharded layout: without the
+        # annotation, GSPMD propagation can pull the QKV/MLP kernels' fsdp
+        # contracting-dim sharding backward into the residual stream and
+        # pay an involuntary replicate-repartition every step
+        return dist.constrain_activation(x, self.mesh)
 
 
 class Bert(nn.Module):
@@ -203,6 +220,8 @@ class Bert(nn.Module):
     # (gpt.generate_cached sizes it to the actual decode length, not
     # max_seq, so short decodes don't pay max_seq attention per step)
     decode: int = 0
+    # mesh for activation sharding annotations at block boundaries
+    mesh: Any = None
 
     def setup(self):
         # vocab padded to a multiple of 128 so the vocab-sharded embedding
@@ -230,7 +249,7 @@ class Bert(nn.Module):
         for i in range(self.layers):
             setattr(self, f"layer_{i}", block_cls(
                 self.hidden, self.heads, self.intermediate, self.dtype,
-                self.attention_fn, self.moe, cache_len))
+                self.attention_fn, self.moe, cache_len, self.mesh))
 
     def embed(self, ids):
         x = self.token_embed(ids)
@@ -242,7 +261,7 @@ class Bert(nn.Module):
             x = x + pos[None].astype(self.dtype)
         else:
             x = x + self.pos_embed[None, : ids.shape[1]].astype(self.dtype)
-        return self.ln_embed(x)
+        return dist.constrain_activation(self.ln_embed(x), self.mesh)
 
     def head(self, x):
         if self.final_ln:
@@ -400,33 +419,96 @@ def build_parser() -> argparse.ArgumentParser:
                         "the MLM objective needs >= 257 — id 256 is "
                         "[MASK]); batches cycle the chunks "
                         "deterministically per step")
+    p.add_argument("--tokenizer", default=None, metavar="bpe:PATH[:V]",
+                   help="tokenize --data-file with a trained byte-level "
+                        "BPE instead of raw bytes: 'bpe:PATH' loads PATH; "
+                        "'bpe:PATH:V' additionally trains a V-id tokenizer "
+                        "on the corpus and saves it to PATH when missing. "
+                        "Tokens cache to a memory-mapped sidecar next to "
+                        "the corpus")
     p.add_argument("--dir", default="logs")
     return p
 
 
-def token_batches(args, pe):
+def tokenizer_from_args(args, reserve: int = 0):
+    """Resolve ``--tokenizer`` to a BPETokenizer (or None for raw bytes).
+
+    Spec: ``bpe:PATH`` loads an existing tokenizer; ``bpe:PATH:V`` trains
+    a V-id tokenizer on the corpus and saves it to PATH when missing
+    (deterministic, so every host trains the identical tokenizer).
+    ``reserve``: ids the objective needs past the tokenizer (the MLM
+    [MASK]) — validated against --vocab here, before any training runs.
+    """
+    spec = getattr(args, "tokenizer", None)
+    if not spec:
+        return None
+    if not getattr(args, "data_file", None):
+        raise ValueError("--tokenizer needs --data-file (it tokenizes the "
+                         "real corpus, not synthetic ids)")
+    parts = spec.split(":")
+    if parts[0] != "bpe" or len(parts) not in (2, 3) or not parts[1]:
+        raise ValueError(
+            f"--tokenizer must be 'bpe:PATH' or 'bpe:PATH:VOCAB', "
+            f"got {spec!r}")
+    from tpujob.workloads import tokenizer as toklib
+
+    path = parts[1]
+    if len(parts) == 3:
+        target = int(parts[2])
+        if args.vocab < target + reserve:
+            # fail before spending time training a tokenizer the model
+            # cannot hold
+            raise ValueError(
+                f"--vocab {args.vocab} is too small for a {target}-id "
+                f"tokenizer{' plus the [MASK] id' if reserve else ''}: "
+                f"need >= {target + reserve}")
+        tok = toklib.load_or_train(path, args.data_file, target)
+    elif os.path.exists(path):
+        tok = toklib.BPETokenizer.load(path)
+    else:
+        raise ValueError(
+            f"--tokenizer {spec!r}: {path} does not exist; use "
+            f"'bpe:{path}:VOCAB' to train it on the corpus, or run "
+            "python -m tpujob.workloads.tokenizer train")
+    if args.vocab < tok.vocab_size + reserve:
+        need = tok.vocab_size + reserve
+        raise ValueError(
+            f"--vocab {args.vocab} is too small for the "
+            f"{tok.vocab_size}-id tokenizer"
+            f"{' plus the [MASK] id' if reserve else ''}: need >= {need}")
+    return tok
+
+
+def token_batches(args, pe, tokenizer=None):
     """(template local batch ids, provider(step)->ids or None, sample row):
     synthetic fixed batch by default; with --data-file, deterministic
-    per-step cycling over the file's byte chunks.  ``sample`` is global
-    row 0 — IDENTICAL on every host (generation prompts must agree
-    across the SPMD decode, unlike the per-host local slice)."""
+    per-step cycling over the corpus chunks — raw bytes, or BPE tokens
+    when ``tokenizer`` is set (both memory-mapped: RAM holds the sliced
+    batch, not the corpus).  ``sample`` is global row 0 — IDENTICAL on
+    every host (generation prompts must agree across the SPMD decode,
+    unlike the per-host local slice)."""
     lo, sz = dist.local_batch_slice(args.batch_size, pe)
     if not getattr(args, "data_file", None):
         ids = datalib.synthetic_token_batch(
             args.batch_size, args.seq_len, args.vocab)
         return ids[lo : lo + sz], None, ids[0:1]
-    if args.vocab < 256:
-        raise ValueError(
-            f"--data-file is a byte-level corpus: --vocab {args.vocab} "
-            "must be >= 256")
-    chunks = datalib.byte_token_dataset(args.data_file, args.seq_len)
+    if tokenizer is not None:
+        chunks = datalib.bpe_token_dataset(args.data_file, args.seq_len,
+                                           tokenizer)
+    else:
+        if args.vocab < 256:
+            raise ValueError(
+                f"--data-file is a byte-level corpus: --vocab {args.vocab} "
+                "must be >= 256")
+        chunks = datalib.byte_token_dataset(args.data_file, args.seq_len)
 
     def provider(step: int):
-        # gather only this host's rows of the global step batch
+        # gather only this host's rows of the global step batch; the
+        # fancy-indexed read materializes just those rows off the memmap
         idx = (np.arange(lo, lo + sz) + step * args.batch_size) % len(chunks)
-        return chunks[idx]
+        return np.asarray(chunks[idx], dtype=np.int32)
 
-    return provider(0), provider, chunks[0:1]
+    return provider(0), provider, np.asarray(chunks[0:1], dtype=np.int32)
 
 
 def moe_config_from(args, mesh=None) -> Optional[MoEConfig]:
@@ -461,11 +543,13 @@ def validate_pipeline_flags(args) -> int:
         # never drop a requested flag silently
         raise ValueError("--pipeline-microbatches needs --pipeline-parallel > 1")
     if pp > 1:
-        if args.tensor_parallel > 1 or args.sequence_parallel > 1:
+        if args.sequence_parallel > 1:
             raise ValueError(
-                "--pipeline-parallel composes with data parallelism (and "
-                "--attention=flash) only; not with --tensor-parallel or "
-                "--sequence-parallel in this release")
+                "--pipeline-parallel composes with data and tensor "
+                "parallelism (the Megatron TP x PP layout) and with "
+                "--attention=flash; not with --sequence-parallel in this "
+                "release (two nested manual regions over sequence and "
+                "pipeline)")
         if getattr(args, "moe_experts", 0) > 0:
             raise ValueError(
                 "--pipeline-parallel does not compose with --moe-experts "
@@ -572,7 +656,7 @@ def build_model(args, mesh, *, causal: bool = False,
         heads=args.heads, intermediate=args.intermediate, max_seq=args.seq_len,
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         attention_fn=attention_fn, moe=moe, remat=args.remat,
-        final_ln=final_ln,
+        final_ln=final_ln, mesh=mesh,
     )
 
 
@@ -713,11 +797,15 @@ def train(args, mesh, pe, model, make_loss, local_batch, *,
 
 def run(args, mesh=None) -> Dict[str, Any]:
     pe = dist.initialize()
+    # MLM reserves one id past the real token alphabet as [MASK]: the
+    # WordPiece 103 for synthetic vocabularies; the first post-alphabet id
+    # for real corpora (raw bytes: 256; BPE: tokenizer vocab_size), so a
+    # genuine token is never confusable with a masked position
     mask_id = 103
-    if getattr(args, "data_file", None):
-        # ids 0-255 are literal bytes, so the WordPiece [MASK]=103 would
-        # collide with genuine 0x67 bytes: reserve id 256 as the mask and
-        # require the vocabulary to carry it
+    tok = tokenizer_from_args(args, reserve=1)
+    if tok is not None:
+        mask_id = tok.vocab_size
+    elif getattr(args, "data_file", None):
         if args.vocab < 257:
             raise ValueError(
                 f"--data-file with the MLM objective needs --vocab >= 257 "
@@ -726,7 +814,7 @@ def run(args, mesh=None) -> Dict[str, Any]:
     if mesh is None:
         mesh = make_mesh_for(args, pe)
     model = build_model(args, mesh)
-    ids0, provider, _ = token_batches(args, pe)
+    ids0, provider, _ = token_batches(args, pe, tokenizer=tok)
     lo, sz = dist.local_batch_slice(args.batch_size, pe)
 
     def masked(ids_local, seed):

@@ -262,6 +262,42 @@ def batch_divisor(mesh, *axes: str) -> int:
     return out
 
 
+def constrain_activation(x, mesh):
+    """Pin a [batch, seq, ...] activation to the canonical layout: batch
+    split over the batch axes, seq over the sequence axis when present,
+    feature dims replicated.
+
+    This is the GSPMD activation-annotation idiom: without it, sharding
+    propagation can pull a kernel's layout backward into the activations —
+    e.g. on an fsdp x tensor mesh the QKV/MLP kernels' fsdp-sharded
+    contracting dim makes the partitioner shard inter-layer activations
+    hidden-over-fsdp while other uses want them batch-sharded, and the
+    conflict resolves by "involuntary full rematerialization" (replicate,
+    then repartition) every step.  Annotating the block boundaries keeps
+    activations batch-sharded and the weights all-gather instead (the
+    ZeRO-3 pattern).
+
+    No-op when ``mesh`` is None, when the mesh has no batch axis, or when
+    the leading dim doesn't divide the batch shards (e.g. the batch-1
+    trace during ``model.init`` or a small decode batch).
+    """
+    if mesh is None:
+        return x
+    axes = batch_axes(mesh)
+    if not axes or x.shape[0] % batch_divisor(mesh, *axes) != 0:
+        return x
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    seq = None
+    if x.ndim >= 3 and "sequence" in mesh.axis_names \
+            and x.shape[1] % mesh.shape["sequence"] == 0:
+        seq = "sequence"
+    spec = P(axes if len(axes) > 1 else axes[0], seq,
+             *([None] * (x.ndim - 2)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 def replicated(mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 

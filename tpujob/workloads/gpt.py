@@ -222,7 +222,8 @@ def run(args, mesh=None) -> Dict[str, Any]:
     if mesh is None:
         mesh = make_mesh_for(args, pe)
     model = build_model(args, mesh)
-    ids0, provider, sample = bertlib.token_batches(args, pe)
+    tok = bertlib.tokenizer_from_args(args)
+    ids0, provider, sample = bertlib.token_batches(args, pe, tokenizer=tok)
     bp = None if provider is None else (lambda step: (provider(step),))
     result = bertlib.train(args, mesh, pe, model,
                            lambda af: lm_loss(model, apply_fn=af),

@@ -423,6 +423,7 @@ def pipeline(
     *,
     axis: str = "pipeline",
     num_microbatches: Optional[int] = None,
+    skip_idle: bool = True,
 ):
     """Run a layer stack split over the ``axis`` mesh dim as a GPipe
     pipeline.
@@ -443,7 +444,27 @@ def pipeline(
     the input.  The (n-1)/(M+n-1) bubble is the classic GPipe cost — raise
     ``num_microbatches`` to amortize it.  Gradients flow through the scan
     and the ppermute transpose, so one ``jax.grad`` of a pipelined loss is
-    the full 1F1B-equivalent backward, compiled by XLA.
+    the full pipelined backward, compiled by XLA.
+
+    ``skip_idle`` (default True): warmup/drain ticks skip the stage
+    compute under ``lax.cond`` instead of processing zeros — in an SPMD
+    lockstep schedule the bubble is *executed* FLOPs, not just idleness,
+    and this eliminates that work (exact parity; the tick count and the
+    ppermute barriers are unchanged).  Note on 1F1B: its remaining
+    benefit over GPipe — peak activation memory ∝ stages instead of
+    ∝ microbatches via interleaving each microbatch's backward between
+    other microbatches' forwards — cannot be expressed through
+    ``jax.grad`` of a forward schedule (the transpose runs after the
+    forward completes); the framework's composition for bounding
+    activation memory is ``--grad-accum`` over pipelined sub-batches,
+    which trades bubble for memory on the same curve.
+
+    **Composes with tensor parallelism** (the Megatron TP x PP layout):
+    only the pipeline and batch axes are manual in the shard_map; any
+    other mesh axis (``tensor``) stays *auto*, so the per-layer kernels
+    keep their rule-derived Megatron shardings inside the stages and
+    GSPMD inserts the TP collectives there exactly as it does outside a
+    pipeline.
 
     The reference has nothing like this (SURVEY.md §2.5: DP only); this is
     the ``pp`` in the framework's dp×tp×sp×ep×pp story.
@@ -476,12 +497,25 @@ def pipeline(
         def tick(carry, t):
             buf, out = carry
             # stage 0 ingests the next microbatch; later stages work on
-            # what arrived from their neighbour last tick.  Warmup/drain
-            # ticks process zeros on idle stages — numerically inert
-            # (LN/softmax of 0 is finite) and never written to `out`.
+            # what arrived from their neighbour last tick.
             feed = x_mb[jnp.clip(t, 0, m - 1)]
             cur = jnp.where(idx == 0, feed, buf)
-            y = stage_fn(p_local, cur)
+            if skip_idle:
+                # stage `idx` holds a real microbatch only for ticks
+                # [idx, idx + m): warmup/drain ticks skip the stage
+                # compute entirely (lax.cond) instead of chewing zeros —
+                # the idle-stage half of the pipeline-bubble cost is
+                # wasted FLOPs in an SPMD lockstep schedule, and this
+                # removes them (the schedule length, and hence the
+                # (n-1)/(m+n-1) wall-clock bubble, is unchanged: a tick
+                # still waits on the ppermute barrier)
+                active = jnp.logical_and(idx <= t, t < idx + m)
+                y = jax.lax.cond(
+                    active, lambda c: stage_fn(p_local, c), lambda c: c, cur)
+            else:
+                # numerically inert on idle stages (LN/softmax of 0 is
+                # finite) and never written to `out`
+                y = stage_fn(p_local, cur)
             widx = jnp.clip(t - (n - 1), 0, m - 1)
             write = jnp.logical_and(idx == n - 1, t >= n - 1)
             upd = jax.lax.dynamic_update_index_in_dim(
@@ -497,9 +531,13 @@ def pipeline(
         return out.reshape(xb.shape)
 
     xspec = P(batch_axis, *([None] * (x.ndim - 1)))
+    # manual over the pipeline + batch axes only; everything else (the
+    # tensor axis) stays auto so Megatron parameter annotations drive the
+    # TP collectives inside each stage
+    manual = {axis} | set(dist.batch_axes(mesh))
     return shard_map(
         local, mesh=mesh, in_specs=(P(axis), xspec), out_specs=xspec,
-        check_vma=False,
+        check_vma=False, axis_names=frozenset(manual),
     )(stacked_params, x)
 
 
