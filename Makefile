@@ -9,10 +9,16 @@ PY ?= python
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci native lint codegen-verify unit e2e bench-smoke dryrun images clean
+.PHONY: ci ci-fast native lint codegen-verify unit unit-fast e2e bench-smoke dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
+
+# Pre-commit gate (<2 min): everything except the slow model-parity tests,
+# the e2e scripts, and the dryrun.  Full `make ci` (~25 min, model suite
+# included) remains the end-of-round snapshot gate — see README.
+ci-fast: native lint codegen-verify unit-fast
+	@echo "ci-fast: ALL PASSED"
 
 # docs/swagger.json must match the dataclass types (hack/verify-codegen.sh)
 codegen-verify:
@@ -26,6 +32,15 @@ lint:
 
 unit:
 	$(PY) -m pytest tests/ -q
+
+# the operator/controller/kube/api tests only — the model-path suites
+# (workload models + mnist + e2e harness) dominate full-unit wall time,
+# and test_graft_entry re-runs the dryrun subprocesses that `make ci`
+# covers in its own `dryrun` stage
+unit-fast:
+	$(PY) -m pytest tests/ -q --ignore=tests/test_workloads_models.py \
+		--ignore=tests/test_workloads_mnist.py --ignore=tests/test_e2e.py \
+		--ignore=tests/test_examples.py --ignore=tests/test_graft_entry.py
 
 e2e:
 	scripts/run-defaults.sh

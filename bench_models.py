@@ -46,6 +46,33 @@ def peak_flops(device) -> float | None:
     return None
 
 
+def perf_fields(flops: float | None, sec_per_step: float, n_chips: int,
+                device) -> dict:
+    """FLOP-derived report fields, honestly labeled.
+
+    ``flops_per_step`` and ``achieved_tflops_per_chip`` are always
+    emitted; the ratio to the device_kind's spec-sheet peak is called
+    ``mfu_vs_spec`` ONLY when achieved <= spec — on this tunneled device
+    the reported kind ("TPU v5 lite") sustains many times a v5e's peak,
+    and an "MFU" of 20 is a hardware-identification artifact, not a
+    utilization number; it is emitted as ``spec_peak_exceeded_x``
+    instead."""
+    out = {}
+    if not flops:
+        return out
+    out["flops_per_step"] = round(flops)
+    ach = flops / sec_per_step / n_chips
+    out["achieved_tflops_per_chip"] = round(ach / 1e12, 1)
+    peak = peak_flops(device)
+    if peak:
+        ratio = ach / peak
+        if ratio <= 1.0:
+            out["mfu_vs_spec"] = round(ratio, 3)
+        else:
+            out["spec_peak_exceeded_x"] = round(ratio, 1)
+    return out
+
+
 def compiled_flops(compiled, fallback: float | None) -> float | None:
     """FLOPs per executed step from XLA's cost analysis (falls back to the
     analytic estimate when the backend doesn't report them)."""
@@ -145,7 +172,6 @@ def bench_resnet50(quick: bool) -> dict:
     # empirically: the same model reports 6.12 TFLOP/step compiled either
     # single-step or as a k=4 scan.
     flops = compiled_flops(compiled, 3 * 4.09e9 * batch)
-    peak = peak_flops(jax.devices()[0])
     out = {
         "metric": "resnet50_train_samples_per_sec_per_chip",
         "value": round(sps / n_chips, 1),
@@ -157,12 +183,7 @@ def bench_resnet50(quick: bool) -> dict:
         "step_ms_std": round(std * 1e3, 3),
         "platform": jax.devices()[0].device_kind,
     }
-    if flops:
-        out["achieved_tflops_per_chip"] = round(flops / sec_per_step / n_chips / 1e12, 1)
-        if peak:
-            # >1.0 means the advertised device_kind's spec-sheet peak does
-            # not match the hardware actually serving the tunnel
-            out["mfu_vs_spec"] = round(flops / sec_per_step / (peak * n_chips), 3)
+    out.update(perf_fields(flops, sec_per_step, n_chips, jax.devices()[0]))
     return out
 
 
@@ -209,7 +230,6 @@ def _bench_transformer(args, mesh, model, loss_fn, batch, seconds, *, metric,
     # an extra fwd => 8 * params * tokens actually executed.  The scan
     # body is cost-analyzed once (see bench_resnet50), so no k scaling.
     flops = compiled_flops(compiled, 8 * n_params * n_tokens)
-    peak = peak_flops(jax.devices()[0])
     out = {
         "metric": metric,
         "value": round(tps / n_chips, 0),
@@ -225,10 +245,7 @@ def _bench_transformer(args, mesh, model, loss_fn, batch, seconds, *, metric,
         "step_ms_std": round(std * 1e3, 3),
         "platform": jax.devices()[0].device_kind,
     }
-    if flops:
-        out["achieved_tflops_per_chip"] = round(flops / sec_per_step / n_chips / 1e12, 1)
-        if peak:
-            out["mfu_vs_spec"] = round(flops / sec_per_step / (peak * n_chips), 3)
+    out.update(perf_fields(flops, sec_per_step, n_chips, jax.devices()[0]))
     return out
 
 
@@ -256,8 +273,12 @@ def bench_bert_large(quick: bool) -> dict:
 
 def bench_gpt_medium(quick: bool) -> dict:
     """GPT-2-medium-shaped causal LM (the decoder family) with the Pallas
-    flash kernel on the full run; a tiny dense decoder in --quick
-    (interpret-mode flash at medium size on CPU would take minutes)."""
+    flash kernel.  --quick shrinks to a tiny decoder but KEEPS
+    ``--attention flash`` at seq 128 (one kernel block): on TPU that
+    exercises the real ``pallas_call`` Mosaic lowering for the forward AND
+    backward kernels, so a lowering break is caught by ``make bench-smoke``
+    before the end-of-round bench — the interpret-mode unit tests cannot
+    catch it."""
     import jax
     import jax.numpy as jnp
 
@@ -268,12 +289,11 @@ def bench_gpt_medium(quick: bool) -> dict:
     n_chips = len(jax.devices())
     batch = (4 if quick else 8) * n_chips
     seq = 128 if quick else 1024
-    argv = ["--batch-size", str(batch), "--seq-len", str(seq)]
+    argv = ["--batch-size", str(batch), "--seq-len", str(seq),
+            "--attention", "flash"]
     if quick:
         argv += ["--hidden", "256", "--layers", "4", "--heads", "8",
                  "--intermediate", "1024", "--vocab", "2048"]
-    else:
-        argv += ["--attention", "flash"]
     args = gptlib.build_parser().parse_args(argv)
     mesh = gptlib.make_mesh_for(args, dist.process_env({}))
     model = gptlib.build_model(args, mesh)
