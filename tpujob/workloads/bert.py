@@ -306,6 +306,64 @@ def pipeline_apply(model: Bert, params, ids, mesh, num_microbatches: int):
     return model.apply(params, x, method="head")
 
 
+def make_1f1b_value_and_grad(model: Bert, mesh, num_microbatches: int,
+                             head_loss, preprocess,
+                             batch_shards: Optional[int] = None):
+    """(params, batch) -> (loss, grads) via the interleaved 1F1B schedule
+    (``pipeline_schedule.pipeline_1f1b``) — fused forward+backward with
+    the stash bound ~n microbatches instead of m.
+
+    ``preprocess(batch) -> (input_ids, extra)`` produces the trunk input
+    and the per-row arrays the loss needs; ``head_loss(logits_mb,
+    extra_mb) -> scalar`` must be scaled so its mean over microbatches
+    and batch shards IS the step loss (see the workload ``run()``s).
+    Embed and head run outside the pipelined region; their grads come
+    from explicit VJPs and merge with the per-stage stack grads (tied
+    embeddings accumulate from both sides).
+    """
+    from tpujob.workloads import pipeline_schedule
+
+    blk = Block(model.hidden, model.heads, model.intermediate, model.dtype,
+                model.attention_fn, model.moe)
+
+    def stage_fn(local_stack, xb):
+        # no remat wrapper: the 1F1B backward tick already recomputes its
+        # stage forward under jax.vjp, and residuals live only within the
+        # tick (checkpointing here would recompute twice)
+        return jax.lax.scan(lambda c, p: (blk.apply({"params": p}, c), None),
+                            xb, local_stack)[0]
+
+    def vag(params, batch):
+        ids_in, extra = preprocess(batch)
+        p = params["params"]
+        # every non-layer param in one tree: flax setup() registers the
+        # trunk params eagerly, so partial trees must carry them all (the
+        # unused ones just get zero grads from each vjp)
+        outer = {"params": {k: v for k, v in p.items()
+                            if not k.startswith("layer_")}}
+        x, vjp_embed = jax.vjp(
+            lambda pt: model.apply(pt, ids_in, method="embed"), outer)
+        stacked = jax.tree.map(
+            lambda *xs: jnp.stack(xs),
+            *(p[f"layer_{i}"] for i in range(model.layers)))
+        head_fn = lambda ht, y, ex: head_loss(
+            model.apply(ht, y, method="head"), ex)
+        loss, dstack, dhead, dx = pipeline_schedule.pipeline_1f1b(
+            stage_fn, stacked, x, head_fn, outer, extra, mesh,
+            num_microbatches=num_microbatches, batch_shards=batch_shards)
+        dembed = vjp_embed(dx.astype(x.dtype))[0]
+        gp = {k: jax.tree.map(jnp.zeros_like, v) for k, v in p.items()}
+        for src in (dembed["params"], dhead["params"]):
+            for k, v in src.items():
+                gp[k] = jax.tree.map(jnp.add, gp[k], v)
+        for i in range(model.layers):
+            gp[f"layer_{i}"] = jax.tree.map(
+                lambda g, d, i=i: g + d[i], gp[f"layer_{i}"], dstack)
+        return loss, {"params": gp}
+
+    return vag
+
+
 def _mean_sown(tree, name) -> Any:
     """Mean of every sown leaf whose key path contains ``name`` (one value
     per MoE layer; the mean keeps loss coefficients depth-independent)."""
@@ -403,6 +461,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pipeline-microbatches", type=int, default=0,
                    help="microbatches streamed through the pipeline "
                         "(0 = one per stage; more amortizes the bubble)")
+    p.add_argument("--pipeline-schedule", choices=["gpipe", "1f1b"],
+                   default="gpipe",
+                   help="gpipe: forward schedule + jax.grad transpose "
+                        "(activation stash grows with microbatches); "
+                        "1f1b: interleaved forward/backward with explicit "
+                        "per-stage VJPs — stash bounded by the stage "
+                        "count, independent of microbatches")
     p.add_argument("--fsdp", type=int, default=1,
                    help="size of the fsdp mesh axis: ZeRO-3-style sharding "
                         "of params and optimizer moments (batch also splits "
@@ -537,11 +602,19 @@ def validate_pipeline_flags(args) -> int:
     """Coherence checks for --pipeline-parallel; returns the stage count."""
     pp = getattr(args, "pipeline_parallel", 1)
     micro = getattr(args, "pipeline_microbatches", 0)
+    sched = getattr(args, "pipeline_schedule", "gpipe")
     if micro < 0:
         raise ValueError(f"--pipeline-microbatches must be >= 0, got {micro}")
     if micro > 0 and pp <= 1:
         # never drop a requested flag silently
         raise ValueError("--pipeline-microbatches needs --pipeline-parallel > 1")
+    if sched != "gpipe" and pp <= 1:
+        raise ValueError("--pipeline-schedule needs --pipeline-parallel > 1")
+    if sched == "1f1b" and getattr(args, "tensor_parallel", 1) > 1:
+        raise ValueError(
+            "--pipeline-schedule=1f1b does not compose with "
+            "--tensor-parallel in this release; use the gpipe schedule "
+            "for TP x PP")
     if pp > 1:
         if args.sequence_parallel > 1:
             raise ValueError(
@@ -661,7 +734,8 @@ def build_model(args, mesh, *, causal: bool = False,
 
 
 def train(args, mesh, pe, model, make_loss, local_batch, *,
-          tag: str = "bert", batch_provider=None) -> Dict[str, Any]:
+          tag: str = "bert", batch_provider=None,
+          make_f1b=None) -> Dict[str, Any]:
     """Shared SPMD training driver for the transformer families (BERT here,
     GPT in ``tpujob.workloads.gpt``): sharded init by PARTITION_RULES,
     pipeline apply_fn wiring, AOT compile, step-exact checkpoint/resume,
@@ -719,16 +793,33 @@ def train(args, mesh, pe, model, make_loss, local_batch, *,
     }
 
     apply_fn = None
+    vag = None
     # run() may receive an external mesh (dryrun, tests), so the full flag
     # coherence check must happen here too, not only in make_mesh_for
     pp = validate_parallel_flags(args)
     if pp > 1:
         micro = getattr(args, "pipeline_microbatches", 0) or pp
-        apply_fn = lambda p, ids: pipeline_apply(model, p, ids, mesh, micro)
+        if getattr(args, "pipeline_schedule", "gpipe") == "1f1b":
+            if make_f1b is None:
+                raise ValueError(
+                    "--pipeline-schedule=1f1b is not supported for this "
+                    "workload (no per-microbatch loss decomposition)")
+            from tpujob.workloads import pipeline_schedule
+            # ONE shard decision, shared with the schedule (the loss
+            # scaling in make_f1b must match what the schedule divides by)
+            shards = pipeline_schedule.batch_shard_count(
+                mesh, args.batch_size)
+            preprocess, head_loss = make_f1b(micro, shards)
+            vag = make_1f1b_value_and_grad(model, mesh, micro, head_loss,
+                                           preprocess, batch_shards=shards)
+        else:
+            apply_fn = lambda p, ids: pipeline_apply(model, p, ids, mesh,
+                                                     micro)
     loss_fn = make_loss(apply_fn)
     train_step = train_lib.make_train_step(
         loss_fn, optimizer, mesh,
         state_shardings=jax.tree.map(lambda a: a.sharding, state),
+        value_and_grad_fn=vag,
     )
 
     ckpt = None
@@ -829,9 +920,34 @@ def run(args, mesh=None) -> Dict[str, Any]:
     bp = None
     if provider is not None:
         bp = lambda step: masked(provider(step), args.seed + step)
+
+    def make_f1b(micro, shards):
+        """MLM per-microbatch loss for the 1F1B schedule: normalized by
+        the GLOBAL mask count (threaded through ``extra`` as a broadcast
+        row so the shard-mean equals the exact global masked mean) and
+        scaled by micro*shards so the schedule's mean IS the step loss."""
+
+        def preprocess(batch):
+            ids, mask = batch
+            masked_ids = jnp.where(mask > 0, jnp.int32(mask_id), ids)
+            total = jnp.maximum(mask.sum(), 1.0)
+            extra = (ids, mask,
+                     jnp.broadcast_to(total, (ids.shape[0],)))
+            return masked_ids, extra
+
+        def head_loss(logits, ex):
+            ids_mb, mask_mb, tot = ex
+            logp = jax.nn.log_softmax(logits)
+            tok_ll = jnp.take_along_axis(logp, ids_mb[..., None],
+                                         axis=-1)[..., 0]
+            return -(tok_ll * mask_mb).sum() / tot[0] * (micro * shards)
+
+        return preprocess, head_loss
+
     return train(args, mesh, pe, model,
                  lambda af: mlm_loss(model, apply_fn=af, mask_id=mask_id),
-                 masked(ids0, args.seed), batch_provider=bp)
+                 masked(ids0, args.seed), batch_provider=bp,
+                 make_f1b=make_f1b)
 
 
 def main(argv=None) -> int:

@@ -139,6 +139,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pltpu_vmem((block_q, d), jnp.float32),    # output accumulator
         ],
         interpret=interpret,
+        **_grid_params(),
     )(_fold(q), _fold(k), _fold(v))
     return _unfold(out, b, h), lse
 
@@ -249,6 +250,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         scratch_shapes=[pltpu_vmem((block_q, d), jnp.float32)],
         interpret=interpret,
+        **_grid_params(),
     )(qf, kf, vf, dof, lse, delta)
 
     # dK/dV: K-block outer, Q-block inner (the sequential axis accumulates)
@@ -270,6 +272,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
             pltpu_vmem((block_k, d), jnp.float32),
         ],
         interpret=interpret,
+        **_grid_params(),
     )(qf, kf, vf, dof, lse, delta)
     return (_unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h))
 
@@ -340,3 +343,18 @@ def pltpu_vmem(shape, dtype):
         return pltpu.VMEM(shape, dtype)
     except ImportError:  # pragma: no cover - non-TPU pallas builds
         return pl.MemorySpace.ANY(shape, dtype)  # type: ignore[attr-defined]
+
+
+def _grid_params(last_arbitrary: int = 1):
+    """Mosaic compiler params marking the grid's non-accumulating dims
+    parallel (only the innermost, scratch-carrying dim is sequential) —
+    lets the TPU scheduler parallelize/pipeline freely, as the official
+    flash kernel does.  Empty off-TPU (interpret mode ignores them)."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        sem = ("parallel",) * (3 - last_arbitrary) + ("arbitrary",) * last_arbitrary
+        return {"compiler_params": pltpu.CompilerParams(
+            dimension_semantics=sem)}
+    except ImportError:  # pragma: no cover
+        return {}

@@ -225,9 +225,29 @@ def run(args, mesh=None) -> Dict[str, Any]:
     tok = bertlib.tokenizer_from_args(args)
     ids0, provider, sample = bertlib.token_batches(args, pe, tokenizer=tok)
     bp = None if provider is None else (lambda step: (provider(step),))
+
+    def make_f1b(micro, shards):
+        """Causal-LM per-microbatch loss for the 1F1B schedule: the
+        shift-by-one token mean — per-microbatch token counts are equal,
+        so the schedule's mean of means IS the global mean (no scaling)."""
+
+        def preprocess(batch):
+            (ids,) = batch
+            return ids, (ids,)
+
+        def head_loss(logits, ex):
+            (ids_mb,) = ex
+            logp = jax.nn.log_softmax(logits[:, :-1])
+            tok_ll = jnp.take_along_axis(
+                logp, ids_mb[:, 1:, None], axis=-1)[..., 0]
+            return -tok_ll.mean()
+
+        return preprocess, head_loss
+
     result = bertlib.train(args, mesh, pe, model,
                            lambda af: lm_loss(model, apply_fn=af),
-                           (ids0,), tag="gpt", batch_provider=bp)
+                           (ids0,), tag="gpt", batch_provider=bp,
+                           make_f1b=make_f1b)
     if n_gen > 0:
         # every process enters the SPMD decode with the SAME prompt
         # (global row 0, not this host's local slice); only the print is

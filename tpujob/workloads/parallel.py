@@ -450,14 +450,11 @@ def pipeline(
     compute under ``lax.cond`` instead of processing zeros — in an SPMD
     lockstep schedule the bubble is *executed* FLOPs, not just idleness,
     and this eliminates that work (exact parity; the tick count and the
-    ppermute barriers are unchanged).  Note on 1F1B: its remaining
-    benefit over GPipe — peak activation memory ∝ stages instead of
-    ∝ microbatches via interleaving each microbatch's backward between
-    other microbatches' forwards — cannot be expressed through
-    ``jax.grad`` of a forward schedule (the transpose runs after the
-    forward completes); the framework's composition for bounding
-    activation memory is ``--grad-accum`` over pipelined sub-batches,
-    which trades bubble for memory on the same curve.
+    ppermute barriers are unchanged).  For the 1F1B memory bound
+    (activation stash ∝ stages instead of ∝ microbatches — which cannot
+    be expressed through ``jax.grad`` of a forward-only schedule), see
+    ``pipeline_schedule.pipeline_1f1b``: the fused interleaved
+    forward+backward behind ``--pipeline-schedule 1f1b``.
 
     **Composes with tensor parallelism** (the Megatron TP x PP layout):
     only the pipeline and batch axes are manual in the shard_map; any

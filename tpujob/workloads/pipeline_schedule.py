@@ -1,0 +1,419 @@
+"""True 1F1B pipeline schedule: interleaved forward/backward with explicit
+per-stage VJPs.
+
+``parallel.pipeline`` + ``jax.grad`` runs the full forward schedule, then
+the full transposed backward — correct and simple, but every microbatch's
+boundary activation stays live from its forward tick until its backward
+tick, so peak stash memory grows with the microbatch count m.  1F1B's
+whole point is to interleave each microbatch's backward between other
+microbatches' forwards so a stage stashes at most ~(n - s) activations —
+and that interleaving CANNOT be expressed through ``jax.grad`` of a
+forward-only schedule (the transpose runs only after the forward
+completes).  So this module schedules forward and backward ticks itself:
+
+- ``build_1f1b_tables(n, m)`` simulates the classic 1F1B policy (each
+  stage: n-s warmup forwards, then alternate backward/forward, then
+  drain) ONCE at trace time, producing static per-tick tables: which
+  microbatch each stage forwards/backwards, which stash slot holds each
+  in-flight activation, and where arriving ppermute traffic lands.  The
+  simulator asserts every dependency (a forward needs its input to have
+  arrived; a backward needs its cotangent) and that every op runs exactly
+  once, so a scheduling bug fails loudly at trace time, not numerically.
+- ``pipeline_1f1b(...)`` executes the timetable as one ``lax.scan`` under
+  ``shard_map``: per tick each stage runs idle / forward / forward+loss
+  (last stage) / backward via ``lax.switch``, activations ppermute down
+  the ring and cotangents ppermute up, and backward ticks recompute their
+  stage forward under ``jax.vjp`` (the remat trade — FLOP-neutral with
+  the rematerialized GPipe backward).  It returns the mean loss and
+  grads for (stage params, head params, pipeline input), i.e. it IS the
+  fused forward+backward, not a differentiable forward.
+
+Memory: peak stashed activations per stage is the simulator's measured
+``stash_depth`` (~n+1), independent of m — the 1F1B bound, pinned by the
+``memory_analysis`` comparison in tests.
+
+The reference has nothing remotely like this (SURVEY.md §2.5: its only
+strategy is DDP data parallelism); the design target is the Megatron-LM
+1F1B schedule expressed TPU-first (static tables + lax.scan + ppermute,
+no host control flow).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from tpujob.workloads import distributed as dist
+
+
+# action codes for the per-tick lax.switch
+IDLE, FWD, FWD_LOSS, BWD = 0, 1, 2, 3
+
+
+@dataclasses.dataclass(frozen=True)
+class Tables:
+    """Static 1F1B timetable (everything [T, n] int32 unless noted)."""
+
+    n: int
+    m: int
+    ticks: int
+    stash_depth: int        # activation stash slots per stage
+    cot_depth: int          # cotangent stash slots per stage
+    action: np.ndarray      # IDLE/FWD/FWD_LOSS/BWD
+    op_mb: np.ndarray       # microbatch index of the tick's op (-1 idle)
+    op_slot: np.ndarray     # stash slot: fwd reads / bwd reads+frees
+    cot_slot: np.ndarray    # bwd: cotangent slot to read
+    arr_slot: np.ndarray    # where the arriving activation lands (-1 drop)
+    cotarr_slot: np.ndarray  # where the arriving cotangent lands (-1 drop)
+    loss_cot_slot: np.ndarray  # last stage fwd tick: slot for the loss cot
+    feed_mb: np.ndarray     # stage-0 fwd tick: microbatch to load from x
+
+
+def build_1f1b_tables(n: int, m: int) -> Tables:
+    """Simulate the 1F1B policy and emit the static timetable.
+
+    Policy per stage s (classic): complete min(n - s, m) warmup forwards
+    first; afterwards prefer the oldest ready backward, else the next
+    forward whose input has arrived; stop when all m backwards are done.
+    """
+    if m < 1 or n < 1:
+        raise ValueError(f"need n >= 1, m >= 1, got n={n} m={m}")
+    warmup = [min(n - s, m) for s in range(n)]
+    fwd_done = [[None] * m for _ in range(n)]   # tick fwd completed
+    bwd_done = [[None] * m for _ in range(n)]
+    # activation/cotangent arrival ticks at each stage (stage 0 activations
+    # "arrive" at their fwd tick from the local feed; last-stage cotangents
+    # at its fwd tick from the local loss vjp)
+    act_arrival = [dict() for _ in range(n)]
+    cot_arrival = [dict() for _ in range(n)]
+
+    rows: List[dict] = []
+    t = 0
+    while not all(all(x is not None for x in bwd_done[s]) for s in range(n)):
+        row = {"action": [IDLE] * n, "op_mb": [-1] * n}
+        for s in range(n):
+            fwds = sum(x is not None for x in fwd_done[s])
+            bwds = sum(x is not None for x in bwd_done[s])
+            # oldest microbatch ready to go backward
+            bwd_j = next(
+                (j for j in range(m)
+                 if bwd_done[s][j] is None and fwd_done[s][j] is not None
+                 and cot_arrival[s].get(j, 10**9) <= t),
+                None)
+            fwd_j = fwds if fwds < m else None
+            if fwd_j is not None and s > 0 \
+                    and act_arrival[s].get(fwd_j, 10**9) > t:
+                fwd_j = None
+            if fwds < warmup[s] and fwd_j is not None:
+                row["action"][s], row["op_mb"][s] = FWD, fwd_j
+            elif bwd_j is not None:
+                row["action"][s], row["op_mb"][s] = BWD, bwd_j
+            elif fwd_j is not None:
+                row["action"][s], row["op_mb"][s] = FWD, fwd_j
+        # commit this tick's effects (ppermute lands next tick)
+        for s in range(n):
+            a, j = row["action"][s], row["op_mb"][s]
+            if a == FWD:
+                fwd_done[s][j] = t
+                if s == 0:
+                    act_arrival[0][j] = t  # local feed
+                if s + 1 < n:
+                    act_arrival[s + 1][j] = t + 1
+                if s == n - 1:
+                    row["action"][s] = FWD_LOSS
+                    cot_arrival[s][j] = t  # local loss vjp
+            elif a == BWD:
+                bwd_done[s][j] = t
+                if s > 0:
+                    cot_arrival[s - 1][j] = t + 1
+        rows.append(row)
+        t += 1
+        if t > 4 * (m + n) + 16:
+            raise AssertionError("1F1B simulator failed to converge")
+
+    T = len(rows)
+    # slot assignment: an activation occupies a slot from its arrival tick
+    # until its backward completes; cotangents from arrival until consumed
+    def assign_slots(arrival, release):
+        slots = [dict() for _ in range(n)]  # mb -> slot per stage
+        depth = 1
+        for s in range(n):
+            free: List[int] = []
+            next_new = 0
+            events = sorted(
+                [(arrival[s][j], 0, j) for j in arrival[s]]
+                + [(release[s][j], 1, j) for j in arrival[s]])
+            for _, kind, j in events:
+                if kind == 0:
+                    if free:
+                        slots[s][j] = free.pop()
+                    else:
+                        slots[s][j] = next_new
+                        next_new += 1
+                        depth = max(depth, next_new)
+                else:
+                    free.append(slots[s][j])
+        return slots, depth
+
+    act_release = [{j: bwd_done[s][j] for j in act_arrival[s]}
+                   for s in range(n)]
+    cot_release = [{j: bwd_done[s][j] for j in cot_arrival[s]}
+                   for s in range(n)]
+    act_slots, stash_depth = assign_slots(act_arrival, act_release)
+    cot_slots, cot_depth = assign_slots(cot_arrival, cot_release)
+
+    def tbl(fill=-1):
+        return np.full((T, n), fill, dtype=np.int32)
+
+    action = tbl(IDLE)
+    op_mb, op_slot, cot_slot = tbl(), tbl(), tbl()
+    arr_slot, cotarr_slot, loss_cot_slot, feed_mb = tbl(), tbl(), tbl(), tbl()
+    for t_, row in enumerate(rows):
+        for s in range(n):
+            a, j = row["action"][s], row["op_mb"][s]
+            action[t_, s] = a
+            if a == IDLE:
+                continue
+            op_mb[t_, s] = j
+            op_slot[t_, s] = act_slots[s][j]
+            if a in (FWD, FWD_LOSS) and s == 0:
+                feed_mb[t_, s] = j
+            if a == FWD_LOSS:
+                loss_cot_slot[t_, s] = cot_slots[s][j]
+            if a == BWD:
+                cot_slot[t_, s] = cot_slots[s][j]
+    # arrivals: activation sent from s-1's fwd at t-1 lands at (t, s);
+    # cotangent from s+1's bwd at t-1 lands at (t, s)
+    for t_, row in enumerate(rows[:-1]):
+        for s in range(n):
+            a, j = row["action"][s], row["op_mb"][s]
+            if a in (FWD, FWD_LOSS) and s + 1 < n:
+                arr_slot[t_ + 1, s + 1] = act_slots[s + 1][j]
+            if a == BWD and s > 0:
+                cotarr_slot[t_ + 1, s - 1] = cot_slots[s - 1][j]
+
+    # invariants: every op exactly once, dependencies respected
+    for s in range(n):
+        assert sorted(j for t_ in range(T)
+                      for a, j in [(action[t_, s], op_mb[t_, s])]
+                      if a in (FWD, FWD_LOSS)) == list(range(m))
+        assert sorted(op_mb[t_, s] for t_ in range(T)
+                      if action[t_, s] == BWD) == list(range(m))
+        for j in range(m):
+            assert act_arrival[s][j] <= fwd_done[s][j]
+            assert fwd_done[s][j] < bwd_done[s][j]
+            assert cot_arrival[s][j] <= bwd_done[s][j]
+            if s > 0:
+                assert fwd_done[s - 1][j] < fwd_done[s][j]
+            if s + 1 < n:
+                assert bwd_done[s + 1][j] < bwd_done[s][j]
+    return Tables(
+        n=n, m=m, ticks=T, stash_depth=stash_depth, cot_depth=cot_depth,
+        action=action, op_mb=op_mb, op_slot=op_slot, cot_slot=cot_slot,
+        arr_slot=arr_slot, cotarr_slot=cotarr_slot,
+        loss_cot_slot=loss_cot_slot, feed_mb=feed_mb,
+    )
+
+
+def batch_shard_count(mesh, global_batch: int) -> int:
+    """How many ways the batch dim splits over the mesh's batch axes —
+    THE one decision shared by pipeline_1f1b and any caller that scales
+    its per-microbatch loss by the shard count.  Falls back to 1 when the
+    batch doesn't divide (e.g. a batch-1 trace)."""
+    axes = dist.batch_axes(mesh)
+    if not axes:
+        return 1
+    div = dist.batch_divisor(mesh, *axes)
+    return div if global_batch % div == 0 else 1
+
+
+def _put_slot(buf, val, slot):
+    """buf[slot] = val when slot >= 0, else no-op (cheap selects)."""
+    upd = jax.lax.dynamic_update_index_in_dim(
+        buf, val.astype(buf.dtype), jnp.clip(slot, 0, buf.shape[0] - 1), 0)
+    return jnp.where(slot >= 0, upd, buf)
+
+
+def pipeline_1f1b(
+    stage_fn,
+    stacked_params: Any,
+    x: jax.Array,
+    head_fn,
+    head_params: Any,
+    extra: Any,
+    mesh,
+    *,
+    axis: str = "pipeline",
+    num_microbatches: Optional[int] = None,
+    batch_shards: Optional[int] = None,
+):
+    """Fused forward+backward over the 1F1B timetable.
+
+    ``stage_fn(local_stack, x_mb) -> y_mb`` (shape/dtype-preserving);
+    ``head_fn(head_params, y_mb, extra_mb) -> scalar`` is the
+    per-microbatch loss (its mean over all microbatches and batch shards
+    is the returned loss); ``extra`` is a pytree of [batch, ...] arrays
+    cut into microbatches alongside ``x`` (labels, masks).
+    ``batch_shards``: how many ways the batch dim splits over the mesh's
+    batch axes — pass the value your loss scaling was computed against
+    (see :func:`batch_shard_count`; callers that scale ``head_fn`` by the
+    shard count MUST share one decision, or the loss silently mis-scales
+    by the data-axis size); None derives it from ``x`` here.
+
+    Returns ``(loss, d_stacked_params, d_head_params, dx)`` — the exact
+    gradients of the mean loss (parity with ``jax.grad`` of the GPipe
+    schedule is pinned by tests).  Backward ticks recompute their stage
+    forward under ``jax.vjp`` (same FLOP trade as the rematerialized
+    GPipe backward); what 1F1B buys is the stash bound: at most
+    ``Tables.stash_depth`` (~n) microbatch activations live per stage,
+    independent of the microbatch count.
+    """
+    n = mesh.shape[axis]
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    if not leaves:
+        raise ValueError("stacked_params is empty")
+    if leaves[0].shape[0] % n != 0:
+        raise ValueError(
+            f"layer stack of {leaves[0].shape[0]} does not divide over "
+            f"{axis!r} axis size {n}")
+    shards = (batch_shard_count(mesh, x.shape[0]) if batch_shards is None
+              else batch_shards)
+    if shards > 1 and x.shape[0] % shards != 0:
+        raise ValueError(
+            f"batch {x.shape[0]} does not divide over {shards} batch "
+            "shards")
+    batch_axis = dist.batch_axes(mesh) if shards > 1 else None
+    b_local = x.shape[0] // shards
+    m = num_microbatches or n
+    if b_local % m != 0:
+        raise ValueError(
+            f"per-device batch {b_local} does not divide into "
+            f"{m} microbatches")
+    tables = build_1f1b_tables(n, m)
+    rows = {
+        "action": tables.action, "op_mb": tables.op_mb,
+        "op_slot": tables.op_slot, "cot_slot": tables.cot_slot,
+        "arr_slot": tables.arr_slot, "cotarr_slot": tables.cotarr_slot,
+        "loss_cot_slot": tables.loss_cot_slot, "feed_mb": tables.feed_mb,
+    }
+    rows = {k: jnp.asarray(v) for k, v in rows.items()}
+
+    def local(p_local, h_params, xb, extra_b):
+        idx = jax.lax.axis_index(axis)
+        mb = xb.shape[0] // m
+        x_mb = xb.reshape((m, mb) + xb.shape[1:])
+        extra_mb = jax.tree.map(
+            lambda a: a.reshape((m, a.shape[0] // m) + a.shape[1:]), extra_b)
+        mb_shape = x_mb.shape[1:]
+        zeros_mb = jnp.zeros(mb_shape, x_mb.dtype)
+
+        def head_loss(y, j):
+            ex = jax.tree.map(lambda a: a[j], extra_mb)
+            loss_j, vjp = jax.vjp(head_fn, h_params, y, ex)
+            dh, dy, _ = vjp(jnp.ones((), loss_j.dtype) / m)
+            return loss_j / m, dh, dy
+
+        dh0 = jax.tree.map(jnp.zeros_like, h_params)
+        dp0 = jax.tree.map(jnp.zeros_like, p_local)
+
+        def tick(carry, row):
+            stash, cots, act_in, cot_in, dP, dH, dxs, loss = carry
+            pick = lambda k: row[k][idx]
+            act = pick("action")
+            j = pick("op_mb")
+            slot = pick("op_slot")
+            # 1) land last tick's ppermute traffic
+            stash = _put_slot(stash, act_in, pick("arr_slot"))
+            cots = _put_slot(cots, cot_in, pick("cotarr_slot"))
+            # 2) stage-0 feed lands in the op slot before use
+            feed = pick("feed_mb")
+            stash = jnp.where(
+                feed >= 0,
+                _put_slot(stash, x_mb[jnp.clip(feed, 0, m - 1)], slot),
+                stash)
+            x_in = stash[jnp.clip(slot, 0, tables.stash_depth - 1)]
+            g_in = cots[jnp.clip(pick("cot_slot"), 0, tables.cot_depth - 1)]
+            jmb = jnp.clip(j, 0, m - 1)
+
+            def do_idle(_):
+                return (zeros_mb, zeros_mb, dp0, dh0,
+                        jnp.zeros((), jnp.float32), zeros_mb)
+
+            def do_fwd(_):
+                y = stage_fn(p_local, x_in)
+                return (y, zeros_mb, dp0, dh0,
+                        jnp.zeros((), jnp.float32), zeros_mb)
+
+            def do_fwd_loss(_):
+                y = stage_fn(p_local, x_in)
+                loss_j, dh, dy = head_loss(y, jmb)
+                return (y, zeros_mb, dp0, dh,
+                        loss_j.astype(jnp.float32), dy.astype(x_mb.dtype))
+
+            def do_bwd(_):
+                y, vjp = jax.vjp(stage_fn, p_local, x_in)
+                dp, dx = vjp(g_in.astype(y.dtype))
+                return (zeros_mb, dx.astype(x_mb.dtype), dp, dh0,
+                        jnp.zeros((), jnp.float32), zeros_mb)
+
+            send_down, send_up, dp_add, dh_add, loss_add, cot_w = \
+                jax.lax.switch(act, [do_idle, do_fwd, do_fwd_loss, do_bwd],
+                               None)
+            # last stage: the loss cotangent enters the cot stash locally
+            cots = _put_slot(cots, cot_w, pick("loss_cot_slot"))
+            dP = jax.tree.map(jnp.add, dP, dp_add)
+            dH = jax.tree.map(jnp.add, dH, dh_add)
+            loss = loss + loss_add
+            # stage 0's backward output is d(loss)/d(pipeline input)
+            is_s0_bwd = jnp.logical_and(idx == 0, act == BWD)
+            dxs = jnp.where(
+                is_s0_bwd,
+                jax.lax.dynamic_update_index_in_dim(dxs, send_up, jmb, 0),
+                dxs)
+            act_in = jax.lax.ppermute(
+                send_down, axis, [(i, i + 1) for i in range(n - 1)])
+            cot_in = jax.lax.ppermute(
+                send_up, axis, [(i, i - 1) for i in range(1, n)])
+            return (stash, cots, act_in, cot_in, dP, dH, dxs, loss), None
+
+        stash0 = jnp.zeros((tables.stash_depth,) + mb_shape, x_mb.dtype)
+        cots0 = jnp.zeros((tables.cot_depth,) + mb_shape, x_mb.dtype)
+        carry0 = (stash0, cots0, zeros_mb, zeros_mb, dp0, dh0,
+                  jnp.zeros((m,) + mb_shape, x_mb.dtype),
+                  jnp.zeros((), jnp.float32))
+        (_, _, _, _, dP, dH, dxs, loss), _ = jax.lax.scan(
+            tick, carry0, rows)
+        # reductions: loss/dH live on the last stage, dxs on stage 0 —
+        # psum over the pipeline ring (others hold zeros); batch-shard
+        # means divide by the shard count (the DDP all-reduce, explicit)
+        loss = jax.lax.psum(loss, axis)
+        dH = jax.lax.psum(jax.tree.map(
+            lambda a: jnp.where(idx == n - 1, a, jnp.zeros_like(a)), dH),
+            axis)
+        dxs = jax.lax.psum(
+            jnp.where(idx == 0, dxs, jnp.zeros_like(dxs)), axis)
+        if batch_axis:
+            loss = jax.lax.psum(loss, batch_axis) / shards
+            dH = jax.tree.map(
+                lambda a: jax.lax.psum(a, batch_axis) / shards, dH)
+            dP = jax.tree.map(
+                lambda a: jax.lax.psum(a, batch_axis) / shards, dP)
+            dxs = dxs / shards
+        return loss, dP, dH, dxs.reshape(xb.shape)
+
+    xspec = P(batch_axis, *([None] * (x.ndim - 1)))
+    exspec = jax.tree.map(
+        lambda a: P(batch_axis, *([None] * (a.ndim - 1))), extra)
+    manual = {axis} | set(dist.batch_axes(mesh))
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis), P(), xspec, exspec),
+        out_specs=(P(), P(axis), P(), xspec),
+        check_vma=False, axis_names=frozenset(manual),
+    )(stacked_params, head_params, x, extra)

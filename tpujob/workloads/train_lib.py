@@ -241,6 +241,7 @@ def make_train_step(
     donate: bool = True,
     has_extra: bool = False,
     state_shardings: Any = None,
+    value_and_grad_fn: Optional[Callable] = None,
 ):
     """Build the jitted DP train step.
 
@@ -251,10 +252,20 @@ def make_train_step(
     caller committed rule-based tensor-parallel layouts; batch is split on
     the data axis.  XLA inserts the gradient psum from the annotations
     (this is DDP's allreduce, compiled).
+
+    ``value_and_grad_fn(params, batch) -> (loss, grads)`` replaces
+    ``jax.value_and_grad(loss_fn)`` when the gradient computation is
+    itself a schedule (the 1F1B pipeline interleaves each microbatch's
+    backward between other microbatches' forwards, which a transpose of
+    the forward cannot express).
     """
+    if value_and_grad_fn is not None and has_extra:
+        raise ValueError(
+            "value_and_grad_fn does not support has_extra (it returns "
+            "(loss, grads) with no mutable-collection slot)")
     repl = dist.replicated(mesh)
     bsh = dist.batch_sharding(mesh)
-    step = _step_body(loss_fn, optimizer, has_extra)
+    step = _step_body(loss_fn, optimizer, has_extra, value_and_grad_fn)
 
     if state_shardings is not None:
         # Tensor-parallel case: the caller committed params (and the
@@ -276,11 +287,13 @@ def make_train_step(
     )
 
 
-def _step_body(loss_fn, optimizer, has_extra):
+def _step_body(loss_fn, optimizer, has_extra, value_and_grad_fn=None):
     """The pure train step shared by the single- and multi-step builders."""
 
     def step(state, batch):
-        if has_extra:
+        if value_and_grad_fn is not None:
+            loss, grads = value_and_grad_fn(state["params"], batch)
+        elif has_extra:
             (loss, extra), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state["params"], state["extra"], batch
             )
