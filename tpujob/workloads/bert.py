@@ -123,22 +123,30 @@ class Attention(nn.Module):
     # 'cache' collection and attend the single new token against them
     # (gpt.generate_cached); 0 = training mode
     cache_len: int = 0
+    # grouped-query attention: project K/V to this many heads (must
+    # divide heads); each KV head serves heads/kv_heads query heads.
+    # The KV cache and the ring-rotated K/V shrink by the same factor;
+    # compute paths see full heads via a broadcast repeat.  None = MHA.
+    kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x):
         d = self.hidden // self.heads
+        hkv = self.kv_heads or self.heads
+        rep = self.heads // hkv
         q = nn.Dense(self.hidden, dtype=self.dtype, name="query")(x)
-        k = nn.Dense(self.hidden, dtype=self.dtype, name="key")(x)
-        v = nn.Dense(self.hidden, dtype=self.dtype, name="value")(x)
+        k = nn.Dense(hkv * d, dtype=self.dtype, name="key")(x)
+        v = nn.Dense(hkv * d, dtype=self.dtype, name="value")(x)
         b, s, _ = x.shape
         q = q.reshape(b, s, self.heads, d)
-        k = k.reshape(b, s, self.heads, d)
-        v = v.reshape(b, s, self.heads, d)
+        k = k.reshape(b, s, hkv, d)
+        v = v.reshape(b, s, hkv, d)
         if self.cache_len > 0:
             if s != 1:
                 raise ValueError(
                     f"cached decode feeds one position at a time, got {s}")
-            shape = (b, self.cache_len, self.heads, d)
+            # the cache stores KV heads only — the GQA decode-memory win
+            shape = (b, self.cache_len, hkv, d)
             ck = self.variable("cache", "cached_key",
                                lambda: jnp.zeros(shape, k.dtype))
             cv = self.variable("cache", "cached_value",
@@ -150,13 +158,21 @@ class Attention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice_in_dim(cv.value, v, i, 1)
             ci.value = i + 1
             scale = d ** -0.5
-            sc = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) * scale
+            # grouped einsum: the rep query heads sharing a KV head attend
+            # the cache directly — no materialized rep-times K/V repeat,
+            # so the decode-memory win actually holds per step
+            qg = q.reshape(b, s, hkv, rep, d)
+            sc = jnp.einsum("bqhrd,bkhd->bhrqk", qg, ck.value) * scale
             # causal: only filled cache slots (<= i) are visible
-            vis = jnp.arange(self.cache_len)[None, None, None, :] <= i
+            vis = jnp.arange(self.cache_len)[None, None, None, None, :] <= i
             sc = jnp.where(vis, sc, -1e30)
             p = jax.nn.softmax(sc, axis=-1)
-            o = jnp.einsum("bhqk,bkhd->bqhd", p, cv.value)
+            o = jnp.einsum("bhrqk,bkhd->bqhrd", p, cv.value)
+            o = o.reshape(b, s, self.heads, d)
         else:
+            # every attention path (dense/flash/ring/ulysses) accepts
+            # grouped-query K/V and broadcasts heads AFTER its
+            # collectives, so the SP paths move the small tensors
             fn = self.attention_fn or parallel.full_attention
             o = fn(q, k, v)  # [b, s, h, d]
         o = o.reshape(b, s, self.hidden)
@@ -175,11 +191,13 @@ class Block(nn.Module):
     # None inside manual regions (the pipeline's stage_fn), where a
     # sharding constraint would be illegal
     mesh: Any = None
+    kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, valid=None):
         a = Attention(self.hidden, self.heads, self.dtype,
-                      self.attention_fn, self.cache_len, name="attn")(x)
+                      self.attention_fn, self.cache_len, self.kv_heads,
+                      name="attn")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x + a)
         if self.moe is not None:
             h = MoEMlp(self.hidden, self.intermediate, self.moe,
@@ -222,6 +240,8 @@ class Bert(nn.Module):
     decode: int = 0
     # mesh for activation sharding annotations at block boundaries
     mesh: Any = None
+    # grouped-query attention: KV heads per layer (None = heads)
+    kv_heads: Optional[int] = None
 
     def setup(self):
         # vocab padded to a multiple of 128 so the vocab-sharded embedding
@@ -249,7 +269,8 @@ class Bert(nn.Module):
         for i in range(self.layers):
             setattr(self, f"layer_{i}", block_cls(
                 self.hidden, self.heads, self.intermediate, self.dtype,
-                self.attention_fn, self.moe, cache_len, self.mesh))
+                self.attention_fn, self.moe, cache_len, self.mesh,
+                self.kv_heads))
 
     def embed(self, ids):
         x = self.token_embed(ids)
@@ -292,7 +313,7 @@ def pipeline_apply(model: Bert, params, ids, mesh, num_microbatches: int):
         *(params["params"][f"layer_{i}"] for i in range(model.layers)),
     )
     blk = Block(model.hidden, model.heads, model.intermediate, model.dtype,
-                model.attention_fn, model.moe)
+                model.attention_fn, model.moe, kv_heads=model.kv_heads)
     apply_one = lambda p, xb: blk.apply({"params": p}, xb)
     if model.remat:
         apply_one = jax.checkpoint(apply_one)
@@ -324,7 +345,7 @@ def make_1f1b_value_and_grad(model: Bert, mesh, num_microbatches: int,
     from tpujob.workloads import pipeline_schedule
 
     blk = Block(model.hidden, model.heads, model.intermediate, model.dtype,
-                model.attention_fn, model.moe)
+                model.attention_fn, model.moe, kv_heads=model.kv_heads)
 
     def stage_fn(local_stack, xb):
         # no remat wrapper: the 1F1B backward tick already recomputes its
@@ -416,6 +437,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hidden", type=int, default=1024)
     p.add_argument("--layers", type=int, default=24)
     p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--kv-heads", type=int, default=0,
+                   help="grouped-query attention: project K/V to this "
+                        "many heads (must divide --heads; 0 = MHA). The "
+                        "KV cache and ring-rotated K/V shrink by "
+                        "heads/kv-heads")
     p.add_argument("--intermediate", type=int, default=4096)
     p.add_argument("--seq-len", type=int, default=512)
     p.add_argument("--batch-size", type=int, default=32, help="global batch")
@@ -639,6 +665,21 @@ def validate_parallel_flags(args) -> int:
     """All strategy-flag coherence rules in one place; returns the
     pipeline stage count."""
     moe_config_from(args)
+    kvh = getattr(args, "kv_heads", 0)
+    if kvh:
+        if kvh < 0:
+            raise ValueError(f"--kv-heads must be >= 1, got {kvh}")
+        if args.heads % kvh != 0:
+            raise ValueError(
+                f"--kv-heads {kvh} must divide --heads {args.heads}")
+        tp = getattr(args, "tensor_parallel", 1)
+        if tp > 1 and kvh % tp != 0:
+            # the K/V projection's output dim is kv_heads*head_dim; a TP
+            # split that doesn't divide the KV heads would shard across a
+            # head boundary
+            raise ValueError(
+                f"--kv-heads {kvh} must divide evenly over "
+                f"--tensor-parallel {tp}")
     pp = validate_pipeline_flags(args)
     fsdp = getattr(args, "fsdp", 1)
     if fsdp > 1:
@@ -730,6 +771,7 @@ def build_model(args, mesh, *, causal: bool = False,
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
         attention_fn=attention_fn, moe=moe, remat=args.remat,
         final_ln=final_ln, mesh=mesh,
+        kv_heads=getattr(args, "kv_heads", 0) or None,
     )
 
 

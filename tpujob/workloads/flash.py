@@ -319,10 +319,13 @@ def flash_attention(
     blocks are recomputed in VMEM from the saved logsumexp, so training at
     long sequence length keeps the same O(S·d) memory as the forward.
     """
-    from tpujob.workloads.parallel import full_attention
+    from tpujob.workloads.parallel import _gqa_repeat, full_attention
 
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    # grouped-query K/V broadcast up to the query heads before tiling
+    # (a KV-head-aware kernel grid is a possible future optimization)
+    k, v = _gqa_repeat(q, k, v)
     sq, sk = q.shape[1], k.shape[1]
     # blocks stay MXU-shaped: a sequence that doesn't tile into full
     # 128-row blocks takes the dense path rather than handing Mosaic an

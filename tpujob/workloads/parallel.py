@@ -209,7 +209,10 @@ def ring_attention(
                     # finite mask value: a fully-masked block (all-future K)
                     # must not poison the running max (exp(-inf+inf)=nan)
                     bias = jnp.where(mask, 0.0, -1e30)[None, None]
-                return _block_attention(qb, kc, vc, bias, m, l, o, scale)
+                # GQA: the ring rotates the small KV-head tensors; heads
+                # broadcast only here, at compute
+                kr, vr = _gqa_repeat(qb, kc, vc)
+                return _block_attention(qb, kr, vr, bias, m, l, o, scale)
 
             if causal:
                 # a K/V block strictly in this Q shard's future contributes
@@ -295,12 +298,18 @@ def _zigzag_ring_attention(q, k, v, mesh, axis, head_axis, scale):
             jnp.arange(c)[:, None] >= jnp.arange(c)[None, :], 0.0, -1e30
         )[None, None]
 
+        def attend(qc, kc, vc, bias, m, l, o):
+            # GQA: heads broadcast at compute only — the ring rotates the
+            # small KV-head tensors
+            kr, vr = _gqa_repeat(qc, kc, vc)
+            return _block_attention(qc, kr, vr, bias, m, l, o, scale)
+
         # hop 0: the resident pair is our own (s = j)
         kA, kB = kb[:, :c], kb[:, c:]
         vA, vB = vb[:, :c], vb[:, c:]
-        mA, lA, oA = _block_attention(qA, kA, vA, tri, *acc0(), scale)
-        mB, lB, oB = _block_attention(qB, kA, vA, None, *acc0(), scale)
-        mB, lB, oB = _block_attention(qB, kB, vB, tri, mB, lB, oB, scale)
+        mA, lA, oA = attend(qA, kA, vA, tri, *acc0())
+        mB, lB, oB = attend(qB, kA, vA, None, *acc0())
+        mB, lB, oB = attend(qB, kB, vB, tri, mB, lB, oB)
 
         ring_perm = [(j, (j - 1) % n) for j in range(n)]
 
@@ -312,7 +321,7 @@ def _zigzag_ring_attention(q, k, v, mesh, axis, head_axis, scale):
             kA, kB = kc[:, :c], kc[:, c:]
             vA, vB = vc[:, :c], vc[:, c:]
             # late Q x early K: live and fully visible for every s != idx
-            mB, lB, oB = _block_attention(qB, kA, vA, None, mB, lB, oB, scale)
+            mB, lB, oB = attend(qB, kA, vA, None, mB, lB, oB)
             # the direction-dependent pair: early x early when the sender
             # is behind us, late x late when ahead — same shapes either
             # way, so select inputs and accumulator instead of branching
@@ -323,8 +332,7 @@ def _zigzag_ring_attention(q, k, v, mesh, axis, head_axis, scale):
             m2p = jnp.where(early, mA, mB)
             l2p = jnp.where(early, lA, lB)
             o2p = jnp.where(early, oA, oB)
-            m2, l2, o2 = _block_attention(q2, k2, v2, None, m2p, l2p, o2p,
-                                          scale)
+            m2, l2, o2 = attend(q2, k2, v2, None, m2p, l2p, o2p)
             mA = jnp.where(early, m2, mA)
             lA = jnp.where(early, l2, lA)
             oA = jnp.where(early, o2, oA)
@@ -383,22 +391,40 @@ def ulysses_attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n = mesh.shape[axis]
-    heads = q.shape[2]
+    heads, hkv = q.shape[2], k.shape[2]
     if heads % n != 0:
         raise ValueError(
             f"ulysses attention needs heads ({heads}) divisible by the "
             f"{axis!r} axis size ({n}); use ring_attention otherwise"
         )
+    if hkv != heads and hkv % n != 0:
+        raise ValueError(
+            f"ulysses attention needs KV heads ({hkv}) divisible by the "
+            f"{axis!r} axis size ({n}); use ring_attention otherwise"
+        )
 
     def local(qb, kb, vb):
-        # one collective for all three tensors: stack to [3, b, s/n, h, d]
-        # and all_to_all seq -> heads (axes shifted +1 by the stack dim)
-        qkv = jax.lax.all_to_all(
-            jnp.stack((qb, kb, vb)), axis, split_axis=3, concat_axis=2,
-            tiled=True,
-        )  # [3, b, s, h/n, d]
+        if kb.shape[2] == qb.shape[2]:
+            # one collective for all three tensors: stack to
+            # [3, b, s/n, h, d] and all_to_all seq -> heads (axes shifted
+            # +1 by the stack dim)
+            qkv = jax.lax.all_to_all(
+                jnp.stack((qb, kb, vb)), axis, split_axis=3, concat_axis=2,
+                tiled=True,
+            )  # [3, b, s, h/n, d]
+            q_, k_, v_ = qkv[0], qkv[1], qkv[2]
+        else:
+            # GQA: K/V carry fewer heads than Q so all three can't stack,
+            # but K and V still share one collective; both move the SMALL
+            # tensors (the heads broadcast happens locally, in the impl)
+            q_ = jax.lax.all_to_all(
+                qb, axis, split_axis=2, concat_axis=1, tiled=True)
+            kv = jax.lax.all_to_all(
+                jnp.stack((kb, vb)), axis, split_axis=3, concat_axis=2,
+                tiled=True)
+            k_, v_ = kv[0], kv[1]
         impl = attention_impl or full_attention
-        out = impl(qkv[0], qkv[1], qkv[2], causal=causal, scale=scale)
+        out = impl(q_, k_, v_, causal=causal, scale=scale)
         # [b, s, h/n, d] -> [b, s/n, h, d]
         return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
                                   tiled=True)
@@ -664,11 +690,28 @@ def moe_ffn(
     return y, {"load_balance": load_balance, "router_z": router_z}
 
 
+def _gqa_repeat(q, k, v):
+    """Broadcast K/V heads up to the query heads (grouped-query
+    attention): every attention path accepts k/v with h_kv | h heads and
+    repeats at the latest possible point — after collectives, so ring
+    rotation and Ulysses all-to-alls move the SMALL tensors."""
+    rep = q.shape[2] // k.shape[2]
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"query heads {q.shape[2]} must be a multiple of KV heads "
+            f"{k.shape[2]}")
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
 def full_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
     """Reference dense attention (same layout) for parity tests and the
-    unsharded path."""
+    unsharded path.  Accepts grouped-query K/V (fewer heads)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    k, v = _gqa_repeat(q, k, v)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         sq, sk = q.shape[1], k.shape[1]
