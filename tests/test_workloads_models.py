@@ -956,6 +956,34 @@ class TestGpt:
         cached = np.asarray(gptlib.generate_cached(model2, v2, prompt, 4))
         np.testing.assert_array_equal(gen, cached)
 
+    def test_sample_next_topk_topp(self, tmp_path):
+        """The shared sampling policy: top-k truncation, nucleus top-p
+        with the crossing token included, greedy ignoring both."""
+        from tpujob.workloads import gpt as gptlib
+
+        logit = jnp.log(jnp.array([[0.6, 0.3, 0.1]]))
+        keys = jax.random.split(jax.random.PRNGKey(0), 300)
+
+        def draws(**kw):
+            d = jax.vmap(lambda k: gptlib.sample_next(
+                logit, k, temperature=1.0, **kw)[0])(keys)
+            return set(np.unique(np.asarray(d)).tolist())
+
+        # preceding-mass rule: token 1 (preceding 0.6) is OUT at p=0.5,
+        # IN at p=0.7; token 2 (preceding 0.9) is always out here
+        assert draws(top_p=0.5) == {0}
+        assert draws(top_p=0.7) == {0, 1}
+        assert draws(top_k=2) == {0, 1}
+        assert draws(top_k=1) == {0}
+        assert draws() == {0, 1, 2}  # plain temperature sampling
+        np.testing.assert_array_equal(
+            np.asarray(gptlib.sample_next(logit, keys[0], temperature=0.0,
+                                          top_k=2, top_p=0.1)), [0])
+        # the CLI refuses top-k/top-p under greedy decode (silent-drop ban)
+        with pytest.raises(ValueError, match="generate-temperature"):
+            gptlib.run(tiny_gpt_args(tmp_path, generate=4,
+                                     generate_top_p=0.9))
+
     def test_generate_sampling_and_bounds(self, tmp_path):
         gptlib, model, v, prompt = self._gen_setup(tmp_path)
         a = gptlib.generate(model, v, prompt, 4, temperature=0.8,

@@ -53,6 +53,38 @@ def lm_loss(model, aux_coef: float = 0.01, z_coef: float = 1e-3,
     return loss_fn
 
 
+def sample_next(logit: jax.Array, key, *, temperature: float = 0.0,
+                top_k: int = 0, top_p: float = 0.0) -> jax.Array:
+    """One sampling decision over [batch, vocab] logits — the shared
+    policy for both decode paths.
+
+    ``temperature`` 0 = greedy argmax (top_k/top_p ignored); otherwise
+    softmax sampling, optionally truncated to the ``top_k`` highest
+    logits and/or the smallest prefix of the sorted distribution whose
+    probability mass reaches ``top_p`` (nucleus sampling — the first
+    token crossing the threshold stays in).  All static-shape masking,
+    so the decode still compiles to one executable.
+    """
+    if temperature <= 0.0:
+        return jnp.argmax(logit, axis=-1)
+    logit = logit / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logit, top_k)[0][..., -1:]
+        logit = jnp.where(logit < kth, -jnp.inf, logit)
+    if top_p > 0.0:
+        sorted_logit = jnp.sort(logit, axis=-1)[..., ::-1]
+        csum = jnp.cumsum(jax.nn.softmax(sorted_logit, axis=-1), axis=-1)
+        # keep every token whose PRECEDING mass is < top_p (the token
+        # that crosses the threshold is included, per the original paper)
+        keep = jnp.concatenate(
+            [jnp.ones_like(csum[..., :1], bool), csum[..., :-1] < top_p],
+            axis=-1)
+        cutoff = jnp.min(jnp.where(keep, sorted_logit, jnp.inf), axis=-1,
+                         keepdims=True)
+        logit = jnp.where(logit < cutoff, -jnp.inf, logit)
+    return jax.random.categorical(key, logit)
+
+
 def generate(
     model,
     params,
@@ -60,6 +92,8 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
     rng: Optional[jax.Array] = None,
 ):
     """Autoregressive decode, TPU-style: static shapes, one compile, a
@@ -103,11 +137,9 @@ def generate(
             # token i is written at position p+i, predicted from p+i-1
             logit = jax.lax.dynamic_slice_in_dim(
                 logits, p + i - 1, 1, axis=1)[:, 0]
-            if temperature > 0:
-                rng, key = jax.random.split(rng)
-                nxt = jax.random.categorical(key, logit / temperature)
-            else:
-                nxt = jnp.argmax(logit, axis=-1)
+            rng, key = jax.random.split(rng)
+            nxt = sample_next(logit, key, temperature=temperature,
+                              top_k=top_k, top_p=top_p)
             buf = jax.lax.dynamic_update_slice_in_dim(
                 buf, nxt[:, None].astype(jnp.int32), p + i, axis=1)
             return (buf, rng), None
@@ -126,6 +158,8 @@ def generate_cached(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
     rng: Optional[jax.Array] = None,
 ):
     """KV-cached autoregressive decode: O(1) recompute per token.
@@ -155,7 +189,8 @@ def generate_cached(
         # tokens while a full forward may, so cached decode would not be
         # the same function — use the exact re-forward path instead
         return generate(model, params, prompt, max_new_tokens,
-                        temperature=temperature, rng=rng)
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        rng=rng)
     dm = model.clone(decode=total, attention_fn=None, remat=False)
     # only the cache SHAPES are wanted: eval_shape avoids materializing
     # (and then discarding) a full parameter tree
@@ -173,11 +208,9 @@ def generate_cached(
                 {**params, "cache": cache}, tok, mutable=["cache"])
             cache = mut["cache"]
             logit = logits[:, 0]
-            if temperature > 0:
-                rng, key = jax.random.split(rng)
-                sampled = jax.random.categorical(key, logit / temperature)
-            else:
-                sampled = jnp.argmax(logit, axis=-1)
+            rng, key = jax.random.split(rng)
+            sampled = sample_next(logit, key, temperature=temperature,
+                                  top_k=top_k, top_p=top_p)
             # within the prompt the next token is already known
             known = jax.lax.dynamic_slice_in_dim(buf, i + 1, 1, axis=1)[:, 0]
             nxt = jnp.where(i + 1 < p, known, sampled).astype(jnp.int32)
@@ -199,8 +232,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.description = "TPU-native GPT (decoder-only) causal-LM pretrain"
     p.set_defaults(vocab=50257, seq_len=1024)
     p.add_argument("--generate", type=int, default=0, metavar="N",
-                   help="after training, greedily decode N tokens from a "
+                   help="after training, decode N tokens from a "
                         "training-batch prefix and print the ids")
+    p.add_argument("--generate-temperature", type=float, default=0.0,
+                   help="0 = greedy; > 0 samples from the softmax")
+    p.add_argument("--generate-top-k", type=int, default=0,
+                   help="sample only from the k highest logits (0 = off)")
+    p.add_argument("--generate-top-p", type=float, default=0.0,
+                   help="nucleus sampling: smallest prefix of the sorted "
+                        "distribution reaching this mass (0 = off)")
     return p
 
 
@@ -219,6 +259,14 @@ def run(args, mesh=None) -> Dict[str, Any]:
         raise ValueError(
             f"--generate {n_gen} must leave room for a prompt within "
             f"--seq-len {args.seq_len} (need generate <= seq-len - 1)")
+    if getattr(args, "generate_temperature", 0.0) <= 0.0 and (
+            getattr(args, "generate_top_k", 0)
+            or getattr(args, "generate_top_p", 0.0)):
+        # never drop a requested behavior silently: greedy decode ignores
+        # the truncation flags
+        raise ValueError(
+            "--generate-top-k/--generate-top-p need "
+            "--generate-temperature > 0 (greedy decode samples nothing)")
     if mesh is None:
         mesh = make_mesh_for(args, pe)
     model = build_model(args, mesh)
@@ -253,7 +301,13 @@ def run(args, mesh=None) -> Dict[str, Any]:
         # (global row 0, not this host's local slice); only the print is
         # rank-gated
         prompt = jnp.asarray(sample[:, : min(8, args.seq_len - n_gen)])
-        out = generate_cached(model, result["state"]["params"], prompt, n_gen)
+        temp = getattr(args, "generate_temperature", 0.0)
+        out = generate_cached(
+            model, result["state"]["params"], prompt, n_gen,
+            temperature=temp,
+            top_k=getattr(args, "generate_top_k", 0),
+            top_p=getattr(args, "generate_top_p", 0.0),
+            rng=jax.random.PRNGKey(args.seed) if temp > 0 else None)
         if pe.process_id == 0:
             print(f"generated ids: {jax.device_get(out)[0].tolist()}")
     return result
