@@ -597,13 +597,26 @@ class TestBert:
             bertlib.run(tiny_bert_args(tmp_path, steps=1, moe_experts=4,
                                        moe_k=0))
 
-    def test_fsdp_rejects_sp_and_pp(self, tmp_path):
-        with pytest.raises(ValueError, match="fsdp"):
-            bertlib.run(tiny_bert_args(tmp_path, steps=1, fsdp=2,
-                                       sequence_parallel=2))
+    def test_fsdp_rejects_pp(self, tmp_path):
         with pytest.raises(ValueError, match="fsdp"):
             bertlib.run(tiny_bert_args(tmp_path, steps=1, fsdp=2,
                                        pipeline_parallel=2))
+
+    def test_fsdp_composes_with_ring_sp(self, tmp_path):
+        """fsdp x sequence: the SP manual region wraps only activations —
+        params never enter it, so ZeRO-3's per-layer gather is untouched.
+        Exact parity with pure DP."""
+        r_dp = bertlib.run(tiny_bert_args(tmp_path, steps=2))
+        r = bertlib.run(tiny_bert_args(tmp_path, steps=2, fsdp=2,
+                                       sequence_parallel=2))
+        assert abs(r_dp["final_loss"] - r["final_loss"]) < 1e-3
+
+    def test_fsdp_composes_with_ulysses_sp(self, tmp_path):
+        r_dp = bertlib.run(tiny_bert_args(tmp_path, steps=2))
+        r = bertlib.run(tiny_bert_args(tmp_path, steps=2, fsdp=2,
+                                       sequence_parallel=2,
+                                       sp_mode="ulysses"))
+        assert abs(r_dp["final_loss"] - r["final_loss"]) < 1e-3
 
     def test_pipeline_path_matches(self, tmp_path):
         """GPipe staging is a schedule, not an algorithm change: loss

@@ -497,8 +497,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fsdp", type=int, default=1,
                    help="size of the fsdp mesh axis: ZeRO-3-style sharding "
                         "of params and optimizer moments (batch also splits "
-                        "over it; composes with --tensor-parallel and "
-                        "--moe-experts)")
+                        "over it; composes with --tensor-parallel, "
+                        "--sequence-parallel, and --moe-experts)")
     p.add_argument("--no-remat", dest="remat", action="store_false", default=True)
     p.add_argument("--log-interval", type=int, default=20)
     train_lib.add_profile_flags(p)
@@ -683,16 +683,16 @@ def validate_parallel_flags(args) -> int:
     pp = validate_pipeline_flags(args)
     fsdp = getattr(args, "fsdp", 1)
     if fsdp > 1:
-        if args.sequence_parallel > 1:
-            raise ValueError(
-                "--fsdp does not compose with --sequence-parallel in this "
-                "release (the SP manual region would re-gather the sharded "
-                "params every layer)")
+        # fsdp composes with sequence parallelism: the SP manual region
+        # wraps only the q/k/v activations — params never enter it, so
+        # ZeRO-3 keeps its per-layer gather at the jit level unchanged
+        # (parity pinned by test_fsdp_composes_with_{ring,ulysses}_sp)
         if getattr(args, "pipeline_parallel", 1) > 1:
             raise ValueError(
                 "--fsdp does not compose with --pipeline-parallel (the "
-                "stage param stacks would be re-gathered whole); pair "
-                "--fsdp with --tensor-parallel or --moe-experts instead")
+                "stage param stacks enter the pipeline's manual region "
+                "and would be re-gathered whole); pair --fsdp with "
+                "--tensor-parallel or --moe-experts instead")
     return pp
 
 
