@@ -1011,6 +1011,68 @@ class TestGpt:
             gptlib.generate(model, v, prompt, 2, temperature=1.0)
 
 
+class TestRoPE:
+    """Rotary position embedding (--position rope)."""
+
+    def test_rotation_preserves_norm_and_relativity(self):
+        """RoPE's two defining properties: per-vector norms are preserved
+        (it is a rotation), and q·k depends on positions only through
+        their DIFFERENCE (shift both -> identical scores)."""
+        ks = jax.random.split(jax.random.PRNGKey(0), 2)
+        q = jax.random.normal(ks[0], (1, 8, 2, 16))
+        k = jax.random.normal(ks[1], (1, 8, 2, 16))
+        pos = jnp.arange(8)
+        qr = bertlib.rope(q, pos)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(qr), axis=-1),
+            np.linalg.norm(np.asarray(q), axis=-1), rtol=1e-5)
+        s0 = jnp.einsum("bqhd,bkhd->bhqk", bertlib.rope(q, pos),
+                        bertlib.rope(k, pos))
+        s7 = jnp.einsum("bqhd,bkhd->bhqk", bertlib.rope(q, pos + 7),
+                        bertlib.rope(k, pos + 7))
+        np.testing.assert_allclose(np.asarray(s0), np.asarray(s7),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gpt_rope_trains_and_decodes(self, tmp_path):
+        from tpujob.workloads import gpt as gptlib
+
+        res = gptlib.run(tiny_gpt_args(tmp_path, steps=30, lr=0.003,
+                                       position="rope"))
+        assert res["final_loss"] < 4.5, res
+        assert "pos_embed" not in res["state"]["params"]["params"]
+        args = tiny_gpt_args(tmp_path, seq_len=32, vocab=97,
+                             position="rope", kv_heads=2)
+        mesh = dist.make_mesh({"data": -1}, env=cpu_env())
+        model = gptlib.build_model(args, mesh)
+        v = {"params": model.init(jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 32), jnp.int32))["params"]}
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 97)
+        full = gptlib.generate(model, v, prompt, 4)
+        cached = gptlib.generate_cached(model, v, prompt, 4)
+        np.testing.assert_array_equal(np.asarray(full), np.asarray(cached))
+
+    def test_rope_parity_across_attention_paths(self, tmp_path):
+        """RoPE is applied before the attention fn, so ring SP and flash
+        must train to the identical loss as dense."""
+        from tpujob.workloads import gpt as gptlib
+
+        r_dense = gptlib.run(tiny_gpt_args(tmp_path, steps=2,
+                                           position="rope"))
+        r_sp = gptlib.run(tiny_gpt_args(tmp_path, steps=2, position="rope",
+                                        sequence_parallel=4))
+        assert abs(r_dense["final_loss"] - r_sp["final_loss"]) < 1e-3
+        r_fl = gptlib.run(tiny_gpt_args(tmp_path, steps=2, position="rope",
+                                        seq_len=128, attention="flash"))
+        r_dn = gptlib.run(tiny_gpt_args(tmp_path, steps=2, position="rope",
+                                        seq_len=128))
+        assert abs(r_fl["final_loss"] - r_dn["final_loss"]) < 1e-3
+
+    def test_rope_needs_even_head_dim(self, tmp_path):
+        with pytest.raises(ValueError, match="even head dim"):
+            bertlib.run(tiny_bert_args(tmp_path, steps=1, hidden=60,
+                                       heads=4, position="rope"))
+
+
 class TestGQA:
     """Grouped-query attention (--kv-heads): fewer K/V heads, same query
     heads; KV cache and ring K/V traffic shrink by heads/kv_heads."""

@@ -114,6 +114,30 @@ class MoEMlp(nn.Module):
         return y
 
 
+def rope(x: jax.Array, positions: jax.Array, theta: float = 10000.0):
+    """Rotary position embedding (GPT-NeoX half-split convention).
+
+    ``x``: [batch, seq, heads, head_dim]; ``positions``: [seq] absolute
+    token positions.  Each head-dim pair (i, i + d/2) rotates by
+    pos · theta^(-2i/d) — relative offsets then appear as phase
+    differences inside q·k, which is why RoPE extrapolates and composes
+    with every attention path here: it is applied to q/k BEFORE the
+    attention fn, so ring/Ulysses sharding and the flash kernel see
+    ordinary tensors.
+    """
+    d = x.shape[-1]
+    half = d // 2
+    freqs = positions[:, None].astype(jnp.float32) * (
+        theta ** (-jnp.arange(half, dtype=jnp.float32) / half))  # [s, d/2]
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(
+        jnp.float32)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
 class Attention(nn.Module):
     hidden: int
     heads: int
@@ -128,6 +152,9 @@ class Attention(nn.Module):
     # The KV cache and the ring-rotated K/V shrink by the same factor;
     # compute paths see full heads via a broadcast repeat.  None = MHA.
     kv_heads: Optional[int] = None
+    # rotary position embedding on q/k (positions come from the decode
+    # cursor in cached mode; the cache stores rotated keys)
+    use_rope: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -141,6 +168,9 @@ class Attention(nn.Module):
         q = q.reshape(b, s, self.heads, d)
         k = k.reshape(b, s, hkv, d)
         v = v.reshape(b, s, hkv, d)
+        if self.use_rope and self.cache_len == 0:
+            pos = jnp.arange(s)
+            q, k = rope(q, pos), rope(k, pos)
         if self.cache_len > 0:
             if s != 1:
                 raise ValueError(
@@ -154,6 +184,12 @@ class Attention(nn.Module):
             ci = self.variable("cache", "cache_index",
                                lambda: jnp.zeros((), jnp.int32))
             i = ci.value
+            if self.use_rope:
+                # rotate at the decode cursor; the cache then holds
+                # already-rotated keys (the standard practice — scores
+                # need only the query's rotation at read time)
+                pos = jnp.reshape(i, (1,))
+                q, k = rope(q, pos), rope(k, pos)
             ck.value = jax.lax.dynamic_update_slice_in_dim(ck.value, k, i, 1)
             cv.value = jax.lax.dynamic_update_slice_in_dim(cv.value, v, i, 1)
             ci.value = i + 1
@@ -192,12 +228,13 @@ class Block(nn.Module):
     # sharding constraint would be illegal
     mesh: Any = None
     kv_heads: Optional[int] = None
+    use_rope: bool = False
 
     @nn.compact
     def __call__(self, x, valid=None):
         a = Attention(self.hidden, self.heads, self.dtype,
                       self.attention_fn, self.cache_len, self.kv_heads,
-                      name="attn")(x)
+                      self.use_rope, name="attn")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x + a)
         if self.moe is not None:
             h = MoEMlp(self.hidden, self.intermediate, self.moe,
@@ -242,6 +279,10 @@ class Bert(nn.Module):
     mesh: Any = None
     # grouped-query attention: KV heads per layer (None = heads)
     kv_heads: Optional[int] = None
+    # rotary position embedding on q/k instead of the learned pos_embed
+    # (--position rope): relative offsets as phase differences, the
+    # modern long-context default; no pos_embed parameter exists then
+    use_rope: bool = False
 
     def setup(self):
         # vocab padded to a multiple of 128 so the vocab-sharded embedding
@@ -249,13 +290,15 @@ class Bert(nn.Module):
         # logits are sliced back to the true vocab before the loss
         vocab_padded = -(-self.vocab // 128) * 128
         self.token_embed = nn.Embed(vocab_padded, self.hidden, dtype=self.dtype)
-        self.pos_embed = self.param(
-            "pos_embed", nn.initializers.normal(0.02), (self.max_seq, self.hidden)
-        )
+        if not self.use_rope:
+            self.pos_embed = self.param(
+                "pos_embed", nn.initializers.normal(0.02),
+                (self.max_seq, self.hidden)
+            )
         self.ln_embed = nn.LayerNorm(dtype=self.dtype)
         if self.final_ln:
             self.ln_f = nn.LayerNorm(dtype=self.dtype)
-        if self.decode:
+        if self.decode and not self.use_rope:
             # decode cursor for the positional embedding (layer caches
             # track their own index; this one belongs to the trunk)
             self.position = self.variable(
@@ -270,11 +313,13 @@ class Bert(nn.Module):
             setattr(self, f"layer_{i}", block_cls(
                 self.hidden, self.heads, self.intermediate, self.dtype,
                 self.attention_fn, self.moe, cache_len, self.mesh,
-                self.kv_heads))
+                self.kv_heads, self.use_rope))
 
     def embed(self, ids):
         x = self.token_embed(ids)
-        if self.decode:
+        if self.use_rope:
+            pass  # positions enter at the attention q/k rotation
+        elif self.decode:
             # one position per call: index pos_embed at the decode cursor
             pos = jax.lax.dynamic_slice_in_dim(
                 self.pos_embed, self.position.value, 1, 0)
@@ -313,7 +358,8 @@ def pipeline_apply(model: Bert, params, ids, mesh, num_microbatches: int):
         *(params["params"][f"layer_{i}"] for i in range(model.layers)),
     )
     blk = Block(model.hidden, model.heads, model.intermediate, model.dtype,
-                model.attention_fn, model.moe, kv_heads=model.kv_heads)
+                model.attention_fn, model.moe, kv_heads=model.kv_heads,
+                use_rope=model.use_rope)
     apply_one = lambda p, xb: blk.apply({"params": p}, xb)
     if model.remat:
         apply_one = jax.checkpoint(apply_one)
@@ -345,7 +391,8 @@ def make_1f1b_value_and_grad(model: Bert, mesh, num_microbatches: int,
     from tpujob.workloads import pipeline_schedule
 
     blk = Block(model.hidden, model.heads, model.intermediate, model.dtype,
-                model.attention_fn, model.moe, kv_heads=model.kv_heads)
+                model.attention_fn, model.moe, kv_heads=model.kv_heads,
+                use_rope=model.use_rope)
 
     def stage_fn(local_stack, xb):
         # no remat wrapper: the 1F1B backward tick already recomputes its
@@ -442,6 +489,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "many heads (must divide --heads; 0 = MHA). The "
                         "KV cache and ring-rotated K/V shrink by "
                         "heads/kv-heads")
+    p.add_argument("--position", choices=["learned", "rope"],
+                   default="learned",
+                   help="positional encoding: learned absolute embedding "
+                        "(BERT/GPT-2 style) or rotary on q/k (RoPE - "
+                        "relative phases, the long-context default; "
+                        "composes with every attention path since it is "
+                        "applied before the attention fn)")
     p.add_argument("--intermediate", type=int, default=4096)
     p.add_argument("--seq-len", type=int, default=512)
     p.add_argument("--batch-size", type=int, default=32, help="global batch")
@@ -665,6 +719,12 @@ def validate_parallel_flags(args) -> int:
     """All strategy-flag coherence rules in one place; returns the
     pipeline stage count."""
     moe_config_from(args)
+    if getattr(args, "position", "learned") == "rope" \
+            and (args.hidden // args.heads) % 2 != 0:
+        raise ValueError(
+            f"--position rope needs an even head dim, got "
+            f"{args.hidden // args.heads} (hidden {args.hidden} / heads "
+            f"{args.heads})")
     kvh = getattr(args, "kv_heads", 0)
     if kvh:
         if kvh < 0:
@@ -772,6 +832,7 @@ def build_model(args, mesh, *, causal: bool = False,
         attention_fn=attention_fn, moe=moe, remat=args.remat,
         final_ln=final_ln, mesh=mesh,
         kv_heads=getattr(args, "kv_heads", 0) or None,
+        use_rope=getattr(args, "position", "learned") == "rope",
     )
 
 
