@@ -155,6 +155,9 @@ class Attention(nn.Module):
     # rotary position embedding on q/k (positions come from the decode
     # cursor in cached mode; the cache stores rotated keys)
     use_rope: bool = False
+    # causal sliding window (0 = full context); the cached decode masks
+    # slots behind the window so it matches windowed training exactly
+    window: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -199,8 +202,12 @@ class Attention(nn.Module):
             # so the decode-memory win actually holds per step
             qg = q.reshape(b, s, hkv, rep, d)
             sc = jnp.einsum("bqhrd,bkhd->bhrqk", qg, ck.value) * scale
-            # causal: only filled cache slots (<= i) are visible
-            vis = jnp.arange(self.cache_len)[None, None, None, None, :] <= i
+            # causal: only filled cache slots (<= i) are visible — and,
+            # with a sliding window, only the trailing `window` of them
+            slots = jnp.arange(self.cache_len)[None, None, None, None, :]
+            vis = slots <= i
+            if self.window:
+                vis = jnp.logical_and(vis, i - slots < self.window)
             sc = jnp.where(vis, sc, -1e30)
             p = jax.nn.softmax(sc, axis=-1)
             o = jnp.einsum("bhrqk,bkhd->bqhrd", p, cv.value)
@@ -229,12 +236,13 @@ class Block(nn.Module):
     mesh: Any = None
     kv_heads: Optional[int] = None
     use_rope: bool = False
+    window: int = 0
 
     @nn.compact
     def __call__(self, x, valid=None):
         a = Attention(self.hidden, self.heads, self.dtype,
                       self.attention_fn, self.cache_len, self.kv_heads,
-                      self.use_rope, name="attn")(x)
+                      self.use_rope, self.window, name="attn")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x + a)
         if self.moe is not None:
             h = MoEMlp(self.hidden, self.intermediate, self.moe,
@@ -283,6 +291,8 @@ class Bert(nn.Module):
     # (--position rope): relative offsets as phase differences, the
     # modern long-context default; no pos_embed parameter exists then
     use_rope: bool = False
+    # causal sliding-window width (0 = full context)
+    window: int = 0
 
     def setup(self):
         # vocab padded to a multiple of 128 so the vocab-sharded embedding
@@ -313,7 +323,7 @@ class Bert(nn.Module):
             setattr(self, f"layer_{i}", block_cls(
                 self.hidden, self.heads, self.intermediate, self.dtype,
                 self.attention_fn, self.moe, cache_len, self.mesh,
-                self.kv_heads, self.use_rope))
+                self.kv_heads, self.use_rope, self.window))
 
     def embed(self, ids):
         x = self.token_embed(ids)
@@ -524,6 +534,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attention", choices=["dense", "flash"], default="dense",
                    help="local attention kernel: dense (XLA) or flash "
                         "(Pallas, VMEM-resident softmax; non-SP path)")
+    p.add_argument("--attention-window", type=int, default=0,
+                   help="causal sliding-window attention: each query sees "
+                        "at most this many trailing positions (0 = full "
+                        "context). O(S*window) attention FLOPs - with "
+                        "--attention flash whole out-of-window blocks are "
+                        "skipped. Causal (gpt) family only; not with "
+                        "--sequence-parallel")
     p.add_argument("--moe-experts", type=int, default=0,
                    help="replace each FFN with a sparse MoE of this many "
                         "experts (0 = dense)")
@@ -782,9 +799,24 @@ def build_model(args, mesh, *, causal: bool = False,
     machine with masked attention and ln_f."""
     attention_fn = None
     use_flash = getattr(args, "attention", "dense") == "flash"
+    window = getattr(args, "attention_window", 0)
+    sp_active = "sequence" in mesh.axis_names and mesh.shape["sequence"] > 1
+    if window:
+        if window < 0:
+            raise ValueError(
+                f"--attention-window must be >= 1, got {window}")
+        if not causal:
+            raise ValueError(
+                "--attention-window (causal sliding window) applies to "
+                "the causal family (gpt), not the bidirectional MLM")
+        if sp_active:
+            raise ValueError(
+                "--attention-window does not compose with "
+                "--sequence-parallel in this release (the ring/Ulysses "
+                "schedules assume full causal visibility)")
     if use_flash:
         from tpujob.workloads import flash
-    if "sequence" in mesh.axis_names and mesh.shape["sequence"] > 1:
+    if sp_active:
         if getattr(args, "sp_mode", "ring") == "ulysses":
             if "tensor" in mesh.axis_names:
                 raise ValueError(
@@ -819,11 +851,11 @@ def build_model(args, mesh, *, causal: bool = False,
                 "--attention=flash does not compose with --tensor-parallel "
                 "(no GSPMD rule for the Pallas call); use dense attention "
                 "with TP, or flash without TP")
-        attention_fn = lambda q, k, v: flash.flash_attention(q, k, v,
-                                                            causal=causal)
+        attention_fn = lambda q, k, v: flash.flash_attention(
+            q, k, v, causal=causal, window=window)
     elif causal:
-        attention_fn = lambda q, k, v: parallel.full_attention(q, k, v,
-                                                               causal=True)
+        attention_fn = lambda q, k, v: parallel.full_attention(
+            q, k, v, causal=True, window=window)
     moe = moe_config_from(args, mesh)
     return Bert(
         vocab=args.vocab, hidden=args.hidden, layers=args.layers,
@@ -833,6 +865,7 @@ def build_model(args, mesh, *, causal: bool = False,
         final_ln=final_ln, mesh=mesh,
         kv_heads=getattr(args, "kv_heads", 0) or None,
         use_rope=getattr(args, "position", "learned") == "rope",
+        window=window,
     )
 
 

@@ -33,10 +33,13 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30  # finite: a fully-masked row must not NaN the running max
 
 
-def _block_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k):
+def _block_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k,
+                  window=0):
     """Masked scaled scores S_ij = mask(scale·Q_i K_j^T) for one block pair
     — THE shared definition across the forward and both backward kernels,
-    so the backward's recomputed P can never drift from the forward's."""
+    so the backward's recomputed P can never drift from the forward's.
+    ``window`` > 0 additionally masks keys more than window-1 positions
+    behind the query (causal sliding-window attention)."""
     q = q_ref[0].astype(jnp.float32)
     k = k_ref[0].astype(jnp.float32)
     s = jax.lax.dot_general(
@@ -48,18 +51,29 @@ def _block_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k):
             jnp.int32, (block_q, block_k), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        vis = q_pos >= k_pos
+        if window:
+            vis = jnp.logical_and(vis, q_pos - k_pos < window)
+        s = jnp.where(vis, s, NEG_INF)
     return s
 
 
-def _causal_live(qi, ki, block_q, block_k):
-    """A K block strictly in the future of every Q row contributes nothing
-    — its matmuls are skipped entirely."""
-    return ki * block_k <= qi * block_q + block_q - 1
+def _causal_live(qi, ki, block_q, block_k, window=0):
+    """A K block strictly in the future of every Q row — or, with a
+    sliding window, entirely behind every Q row's window — contributes
+    nothing; its matmuls are skipped entirely.  With a window the live
+    band is O(window/block_k) blocks per Q row, so attention FLOPs are
+    O(S·window) instead of O(S²)."""
+    live = ki * block_k <= qi * block_q + block_q - 1
+    if window:
+        live = jnp.logical_and(
+            live, ki * block_k + block_k - 1 >= qi * block_q - window + 1)
+    return live
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                  *, scale: float, causal: bool, block_q: int, block_k: int):
+                  *, scale: float, causal: bool, block_q: int, block_k: int,
+                  window: int = 0):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -69,14 +83,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # the ki==0 block is never fully masked, so _init above always runs
-    live = _causal_live(qi, ki, block_q, block_k) if causal else True
+    # _init above runs unconditionally at ki==0, so a dead ki==0 block
+    # (possible under a sliding window) still zeroes the scratch
+    live = _causal_live(qi, ki, block_q, block_k, window) if causal else True
 
     @pl.when(live)
     def _update():
         v = v_ref[0].astype(jnp.float32)
         s = _block_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k)
+                          block_q=block_q, block_k=block_k, window=window)
         # running softmax: m/l replicated across the 128-lane dim so the
         # scratch keeps MXU/VPU-native tiling
         m_prev = m_ref[:, :1]                      # [block_q, 1]
@@ -109,13 +124,14 @@ def _unfold(x, b, h):  # [b*h, s, d] -> [b, s, h, d]
     return x.reshape(b, h, x.shape[1], x.shape[2]).transpose(0, 2, 1, 3)
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                   window=0):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     grid = (b * h, sq // block_q, sk // block_k)
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -146,7 +162,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dq_acc, *, scale: float, causal: bool,
-               block_q: int, block_k: int):
+               block_q: int, block_k: int, window: int = 0):
     """dQ_i = scale * sum_j (P_ij ∘ (dO_i V_j^T − D_i)) K_j, P recomputed
     in VMEM from the saved logsumexp (FlashAttention-2 eq. for dS)."""
     qi = pl.program_id(1)
@@ -156,7 +172,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    live = _causal_live(qi, ki, block_q, block_k) if causal else True
+    live = _causal_live(qi, ki, block_q, block_k, window) if causal else True
 
     @pl.when(live)
     def _update():
@@ -164,7 +180,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _block_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k)
+                          block_q=block_q, block_k=block_k, window=window)
         p = jnp.exp(s - lse_ref[0][:, :1])           # [block_q, block_k]
         dp = jax.lax.dot_general(                    # dO V^T
             do, v, (((1,), (1,)), ((), ())),
@@ -180,7 +196,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                causal: bool, block_q: int, block_k: int):
+                causal: bool, block_q: int, block_k: int, window: int = 0):
     """dV_j = sum_i P_ij^T dO_i;  dK_j = scale * sum_i dS_ij^T Q_i — one
     K/V block accumulates over the (sequentially iterated) Q blocks."""
     ki = pl.program_id(1)
@@ -191,7 +207,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    live = _causal_live(qi, ki, block_q, block_k) if causal else True
+    live = _causal_live(qi, ki, block_q, block_k, window) if causal else True
 
     @pl.when(live)
     def _update():
@@ -199,7 +215,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _block_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k)
+                          block_q=block_q, block_k=block_k, window=window)
         p = jnp.exp(s - lse_ref[0][:, :1])           # [block_q, block_k]
         dv_acc[:] += jax.lax.dot_general(            # P^T dO
             p, do, (((0,), (0,)), ((), ())),
@@ -222,7 +238,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, window=0):
     """FlashAttention-2 backward: two Pallas passes (dQ; then dK+dV), each
     recomputing its P blocks in VMEM from the forward's logsumexp — no
     [seq, seq] tensor ever reaches HBM, so long-context *training* has the
@@ -243,7 +259,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     r_spec3 = pl.BlockSpec((1, block_q, 128), lambda bh, qi, ki: (bh, qi, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, window=window),
         grid=(b * h, sq // block_q, sk // block_k),
         in_specs=[q_spec3, k_spec3, k_spec3, q_spec3, r_spec3, r_spec3],
         out_specs=q_spec3,
@@ -259,7 +275,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     r_specT = pl.BlockSpec((1, block_q, 128), lambda bh, ki, qi: (bh, qi, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, window=window),
         grid=(b * h, sk // block_k, sq // block_q),
         in_specs=[q_specT, k_specT, k_specT, q_specT, r_specT, r_specT],
         out_specs=[k_specT, k_specT],
@@ -277,23 +293,23 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     return (_unfold(dq, b, h), _unfold(dk, b, h), _unfold(dv, b, h))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret, window):
     out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                            interpret)
+                            interpret, window)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, window):
     out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                              interpret)
+                              interpret, window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res, g):
     q, k, v, out, lse = res
     return _flash_backward(q, k, v, out, lse, g, causal, scale,
-                           block_q, block_k, interpret)
+                           block_q, block_k, interpret, window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -306,6 +322,7 @@ def flash_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    window: int = 0,
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
@@ -321,6 +338,11 @@ def flash_attention(
     """
     from tpujob.workloads.parallel import _gqa_repeat, full_attention
 
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window (sliding-window attention) requires "
+                         "causal=True")
     if scale is None:
         scale = q.shape[-1] ** -0.5
     # grouped-query K/V broadcast up to the query heads before tiling
@@ -331,10 +353,12 @@ def flash_attention(
     # 128-row blocks takes the dense path rather than handing Mosaic an
     # unaligned block (sub-128 sequences are cheap densely anyway)
     if sq % block_q or sk % block_k:
-        return full_attention(q, k, v, causal=causal, scale=scale)
+        return full_attention(q, k, v, causal=causal, scale=scale,
+                              window=window)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, float(scale), block_q, block_k, interpret)
+    return _flash(q, k, v, causal, float(scale), block_q, block_k, interpret,
+                  int(window))
 
 
 def pltpu_vmem(shape, dtype):

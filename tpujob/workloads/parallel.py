@@ -706,16 +706,27 @@ def _gqa_repeat(q, k, v):
     return k, v
 
 
-def full_attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
+def full_attention(q, k, v, *, causal: bool = False,
+                   scale: Optional[float] = None, window: int = 0):
     """Reference dense attention (same layout) for parity tests and the
-    unsharded path.  Accepts grouped-query K/V (fewer heads)."""
+    unsharded path.  Accepts grouped-query K/V (fewer heads) and a causal
+    sliding ``window`` (keys more than window-1 positions behind the
+    query are masked)."""
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window (sliding-window attention) requires "
+                         "causal=True")
     if scale is None:
         scale = q.shape[-1] ** -0.5
     k, v = _gqa_repeat(q, k, v)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         sq, sk = q.shape[1], k.shape[1]
-        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        delta = jnp.arange(sq)[:, None] - jnp.arange(sk)[None, :]
+        mask = delta >= 0
+        if window:
+            mask = jnp.logical_and(mask, delta < window)
         s = jnp.where(mask[None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
