@@ -4,12 +4,14 @@
 # test/workflows/components/workflows.libsonnet:292-345).
 
 PY ?= python
+# the test recipe needs pipefail/PIPESTATUS; /bin/sh is dash on Debian
+SHELL := /bin/bash
 # hermetic JAX config for CPU-only CI hosts (tests/conftest.py sets the
 # same for pytest; exported here for the e2e/bench targets)
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci ci-fast native lint codegen-verify unit unit-fast e2e bench-smoke dryrun images clean
+.PHONY: ci ci-fast native lint codegen-verify unit unit-fast test e2e bench-smoke bench-controller dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
@@ -33,6 +35,11 @@ lint:
 unit:
 	$(PY) -m pytest tests/ -q
 
+# the tier-1 command from ROADMAP.md, verbatim (modulo $$-escaping for
+# make), so local and CI invocations agree on what "the tests pass" means
+test:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
 # the operator/controller/kube/api tests only — the model-path suites
 # (workload models + mnist + e2e harness) dominate full-unit wall time,
 # and test_graft_entry re-runs the dryrun subprocesses that `make ci`
@@ -55,6 +62,14 @@ dryrun:
 bench-smoke:
 	$(PY) bench.py
 	$(PY) bench_models.py --quick
+
+# control-plane reconcile throughput, small JxW matrix: the indexed+batched
+# controller vs the scan+serial control (one JSON line per run)
+bench-controller:
+	$(PY) bench_controller.py --jobs 10 --workers 4
+	$(PY) bench_controller.py --jobs 10 --workers 4 --mode scan --serial
+	$(PY) bench_controller.py --jobs 50 --workers 8
+	$(PY) bench_controller.py --jobs 50 --workers 8 --mode scan --serial
 
 images:
 	scripts/build_image.sh

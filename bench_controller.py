@@ -1,0 +1,268 @@
+"""Control-plane benchmark: reconcile throughput on the in-memory cluster.
+
+Spins an ``InMemoryAPIServer`` + ``TPUJobController``, creates J jobs of
+1 master + W workers each, drives every pod to Running via a simulated
+kubelet hook, and measures the wall time until every job carries the
+Running condition.  Prints exactly ONE JSON line:
+
+    {"metric": "controller_reconcile", "jobs_per_sec": ...,
+     "pod_creates_per_sec": ..., "sync_p50_ms": ..., "sync_p99_ms": ..., ...}
+
+Modes (for before/after comparison on the same machine):
+
+    --mode indexed   indexed informer-cache claim path (default)
+    --mode scan      the pre-indexer full-store scan per sync
+    --serial         replica creates issued one at a time (pre-batching)
+
+``--create-latency`` models the apiserver round trip one create costs
+(default 2 ms).  Both modes pay it; slow-start batching overlaps it.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tpujob.api import constants as c
+from tpujob.controller.job_base import ControllerConfig
+from tpujob.controller.reconciler import TPUJobController
+from tpujob.kube.client import RESOURCE_PODS, RESOURCE_SERVICES, RESOURCE_TPUJOBS, ClientSet
+from tpujob.kube.control import gen_labels
+from tpujob.kube.memserver import ADDED, InMemoryAPIServer
+from tpujob.kube.objects import Pod, Service
+
+
+class LatencyServer(InMemoryAPIServer):
+    """In-memory apiserver whose creates cost a simulated network round trip
+    (slept before the lock, so concurrent creates overlap it like real
+    in-flight requests)."""
+
+    def __init__(self, create_latency: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.create_latency = create_latency
+
+    def create(self, resource, obj):
+        if self.create_latency > 0:
+            time.sleep(self.create_latency)
+        return super().create(resource, obj)
+
+
+def install_kubelet(server: InMemoryAPIServer) -> None:
+    """Drive every created pod straight to Running (simulated kubelet)."""
+
+    def hook(ev_type: str, resource: str, obj: Dict) -> None:
+        if resource != RESOURCE_PODS or ev_type != ADDED:
+            return
+        meta = obj.get("metadata") or {}
+        server.update_status(RESOURCE_PODS, {
+            "metadata": {"namespace": meta.get("namespace"), "name": meta.get("name")},
+            "status": {
+                "phase": "Running",
+                "containerStatuses": [
+                    {"name": c.DEFAULT_CONTAINER_NAME, "ready": True, "restartCount": 0}
+                ],
+            },
+        })
+
+    server.hooks.append(hook)
+
+
+def use_scan_claims(ctrl: TPUJobController) -> None:
+    """Swap in the pre-indexer claim path: one full namespace-store scan per
+    get_pods_for_job/get_services_for_job call — O(jobs x cluster_pods)."""
+
+    def scan(informer, resource, job, from_dict):
+        ns = job.metadata.namespace or "default"
+        selector = gen_labels(job.metadata.name)
+        out = []
+        for obj in informer.store.list(ns):
+            meta = obj.get("metadata") or {}
+            labels = meta.get("labels") or {}
+            refs = meta.get("ownerReferences") or []
+            owned = any(
+                r.get("controller") and r.get("uid") == job.metadata.uid for r in refs
+            )
+            if owned:
+                out.append(from_dict(obj))
+            elif all(labels.get(k) == v for k, v in selector.items()) and not any(
+                r.get("controller") for r in refs
+            ):
+                adopted = ctrl._adopt(resource, job, meta)
+                if adopted is not None:
+                    out.append(from_dict(adopted))
+        return out
+
+    ctrl.get_pods_for_job = lambda job: scan(
+        ctrl.pod_informer, RESOURCE_PODS, job, Pod.from_dict)
+    ctrl.get_services_for_job = lambda job: scan(
+        ctrl.service_informer, RESOURCE_SERVICES, job, Service.from_dict)
+
+
+def use_serial_creates(ctrl: TPUJobController) -> None:
+    """Swap the slow-start parallel batch for one-at-a-time creates."""
+
+    def serial(items, create_one) -> Tuple[int, Optional[Exception]]:
+        done = 0
+        for item in items:
+            try:
+                create_one(item)
+                done += 1
+            except Exception as e:  # noqa: BLE001 - contract mirrors create_pods
+                return done, e
+        return done, None
+
+    pc, sc = ctrl.pod_control, ctrl.service_control
+    ctrl.pod_control.create_pods = lambda ns, pods, owner: serial(
+        pods, lambda p: pc.create_pod(ns, p, owner))
+    ctrl.service_control.create_services = lambda ns, svcs, owner: serial(
+        svcs, lambda s: sc.create_service(ns, s, owner))
+
+
+def job_dict(name: str, workers: int) -> Dict:
+    tmpl = {"spec": {"containers": [{"name": c.DEFAULT_CONTAINER_NAME,
+                                     "image": "bench:latest"}]}}
+    return {
+        "apiVersion": c.API_VERSION,
+        "kind": c.KIND,
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"tpuReplicaSpecs": {
+            c.REPLICA_TYPE_MASTER: {"replicas": 1, "template": tmpl},
+            c.REPLICA_TYPE_WORKER: {"replicas": workers, "template": tmpl},
+        }},
+    }
+
+
+def _is_running(obj: Dict) -> bool:
+    for cond in (obj.get("status") or {}).get("conditions") or []:
+        if cond.get("type") == c.JOB_RUNNING and cond.get("status") == "True":
+            return True
+    return False
+
+
+def _percentile(sorted_values: List[float], q: float) -> float:
+    if not sorted_values:
+        return 0.0
+    idx = min(len(sorted_values) - 1, int(q * (len(sorted_values) - 1) + 0.5))
+    return sorted_values[idx]
+
+
+def run_bench(jobs: int, workers: int, threadiness: int, mode: str,
+              serial: bool, create_latency: float, timeout: float,
+              background_pods: int = 1000) -> Dict:
+    server = LatencyServer(create_latency=create_latency)
+    # a busy cluster: pods the operator does not own and must not touch.
+    # The indexed claim path never sees them; the scan control walks them
+    # on every sync (the O(jobs x cluster_pods) term this bench exists to
+    # measure).  Created before the controller starts so they arrive via
+    # the initial LIST, not watch events.
+    for i in range(background_pods):
+        server.create(RESOURCE_PODS, {
+            "metadata": {"name": f"noise-{i:05d}", "namespace": "default",
+                         "labels": {"app": "unrelated"}},
+            "spec": {"containers": [{"name": "app", "image": "noise"}]},
+            "status": {"phase": "Running"},
+        })
+    install_kubelet(server)
+    clients = ClientSet(server)
+    ctrl = TPUJobController(
+        clients,
+        config=ControllerConfig(threadiness=threadiness, resync_period=0),
+    )
+    if mode == "scan":
+        use_scan_claims(ctrl)
+    if serial:
+        use_serial_creates(ctrl)
+
+    latencies: List[float] = []
+    lat_lock = threading.Lock()
+    inner_sync = ctrl.sync_handler
+
+    def timed_sync(key: str) -> bool:
+        t0 = time.perf_counter()
+        try:
+            return inner_sync(key)
+        finally:
+            with lat_lock:
+                latencies.append(time.perf_counter() - t0)
+
+    ctrl.sync_handler = timed_sync
+
+    stop = threading.Event()
+    ctrl.run(stop, threadiness)
+    names = [f"bench-{i:04d}" for i in range(jobs)]
+    t0 = time.perf_counter()
+    for name in names:
+        server.create(RESOURCE_TPUJOBS, job_dict(name, workers))
+    pending = set(names)
+    deadline = time.monotonic() + timeout
+    while pending and time.monotonic() < deadline:
+        pending = {n for n in pending
+                   if not _is_running(server.get(RESOURCE_TPUJOBS, "default", n))}
+        if pending:
+            time.sleep(0.005)
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    ctrl.factory.stop()
+    if pending:
+        raise TimeoutError(
+            f"{len(pending)}/{jobs} jobs not Running after {timeout:.0f}s")
+
+    pod_count = len(server.list(RESOURCE_PODS)) - background_pods
+    with lat_lock:
+        lat = sorted(latencies)
+    return {
+        "metric": "controller_reconcile",
+        "mode": mode,
+        "serial": serial,
+        "jobs": jobs,
+        "workers": workers,
+        "threadiness": threadiness,
+        "background_pods": background_pods,
+        "create_latency_s": create_latency,
+        "elapsed_s": round(elapsed, 4),
+        "jobs_per_sec": round(jobs / elapsed, 2),
+        "pod_creates_per_sec": round(pod_count / elapsed, 2),
+        "pods": pod_count,
+        "syncs": len(lat),
+        "sync_p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+        "sync_p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+    }
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--jobs", type=int, default=50, help="J: number of TPUJobs")
+    p.add_argument("--workers", type=int, default=8, help="W: workers per job")
+    p.add_argument("--threadiness", type=int, default=4)
+    p.add_argument("--mode", choices=("indexed", "scan"), default="indexed")
+    p.add_argument("--serial", action="store_true",
+                   help="one-at-a-time replica creates (pre-batching control)")
+    p.add_argument("--create-latency", type=float, default=0.002,
+                   help="simulated apiserver round trip per create, seconds")
+    p.add_argument("--background-pods", type=int, default=1000,
+                   help="unowned pods pre-loaded into the cluster")
+    p.add_argument("--timeout", type=float, default=120.0)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        result = run_bench(args.jobs, args.workers, args.threadiness, args.mode,
+                           args.serial, args.create_latency, args.timeout,
+                           background_pods=args.background_pods)
+    except TimeoutError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,3 +23,10 @@ except ImportError:  # operator-layer tests run fine without jax
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running benchmarks/suites (tier-1 excludes them via -m 'not slow')",
+    )

@@ -1,0 +1,145 @@
+"""Slow-start parallel replica creation and its expectation bookkeeping."""
+import threading
+import time
+
+import pytest
+
+from tpujob.api import constants as c
+from tpujob.controller.job_base import ControllerConfig, expectation_key
+from tpujob.controller.reconciler import TPUJobController
+from tpujob.kube.client import ClientSet
+from tpujob.kube.control import FakePodControl, FakeServiceControl, slow_start_batch
+from tpujob.kube.memserver import ADDED, InMemoryAPIServer
+
+from jobtestutil import Harness, new_tpujob
+
+
+def test_slow_start_runs_every_call_once():
+    calls = []
+    lock = threading.Lock()
+
+    def fn(i):
+        with lock:
+            calls.append(i)
+
+    successes, err = slow_start_batch(10, fn)
+    assert successes == 10 and err is None
+    assert sorted(calls) == list(range(10))
+
+
+def test_slow_start_zero_count_noop():
+    successes, err = slow_start_batch(0, lambda i: 1 / 0)
+    assert successes == 0 and err is None
+
+
+def test_slow_start_first_batch_failure_halts_everything():
+    """A systemic failure costs ONE call, not count (client-go slowStartBatch)."""
+    calls = []
+
+    def fn(i):
+        calls.append(i)
+        raise RuntimeError("quota exhausted")
+
+    successes, err = slow_start_batch(64, fn)
+    assert successes == 0
+    assert isinstance(err, RuntimeError)
+    assert calls == [0]  # batches 2, 4, 8, ... never ran
+
+
+def test_slow_start_mid_batch_failure_finishes_batch_skips_rest():
+    calls = []
+    lock = threading.Lock()
+
+    def fn(i):
+        with lock:
+            calls.append(i)
+        if i == 1:
+            raise RuntimeError("boom")
+
+    successes, err = slow_start_batch(10, fn)
+    # batch 1 = {0} ok; batch 2 = {1, 2}: 1 fails, 2 still runs; batch 4 skipped
+    assert sorted(calls) == [0, 1, 2]
+    assert successes == 2
+    assert isinstance(err, RuntimeError)
+
+
+def test_failed_batch_lowers_expectations_for_uncreated_pods():
+    """Expectations are raised for every intended create up front and lowered
+    for every create that did not happen, so the next sync is not gated on
+    pods that will never arrive (controller.go:430-470 semantics)."""
+    h = Harness()
+    h.submit(new_tpujob(workers=3))
+    fake_pods = FakePodControl()
+    fake_pods.create_limit = 2
+    h.controller.pod_control = fake_pods
+    h.controller.service_control = FakeServiceControl()
+    h.controller.factory.sync_all()
+    with pytest.raises(RuntimeError):
+        h.controller.sync_handler("default/test-job")
+    # master (1) + first worker batch (1) landed; worker batch {1,2} failed
+    assert len(fake_pods.templates) == 2
+    ekey = expectation_key("default/test-job", c.REPLICA_TYPE_WORKER, "pods")
+    # 3 raised, 2 lowered (1 created of 3): exactly ONE outstanding add
+    assert not h.controller.expectations.satisfied(ekey)
+    h.controller.expectations.observe_add(ekey)
+    assert h.controller.expectations.satisfied(ekey)
+
+
+def _running_kubelet(server):
+    def hook(ev_type, resource, obj):
+        if resource != "pods" or ev_type != ADDED:
+            return
+        meta = obj.get("metadata") or {}
+        server.update_status("pods", {
+            "metadata": {"namespace": meta.get("namespace"), "name": meta.get("name")},
+            "status": {"phase": "Running",
+                       "containerStatuses": [{"name": c.DEFAULT_CONTAINER_NAME,
+                                              "ready": True, "restartCount": 0}]},
+        })
+
+    server.hooks.append(hook)
+
+
+def test_threadiness_4_never_double_creates():
+    """4 workers + expectations + the workqueue's no-concurrent-key guarantee:
+    every replica is created exactly once."""
+    server = InMemoryAPIServer()
+    _running_kubelet(server)
+    clients = ClientSet(server)
+    ctrl = TPUJobController(
+        clients, config=ControllerConfig(threadiness=4, resync_period=0))
+
+    creates = []
+    lock = threading.Lock()
+    inner = ctrl.pod_control.create_pod
+
+    def counting_create(namespace, pod, owner):
+        with lock:
+            creates.append(pod.metadata.name)
+        return inner(namespace, pod, owner)
+
+    ctrl.pod_control.create_pod = counting_create
+
+    stop = threading.Event()
+    ctrl.run(stop, 4)
+    jobs = 6
+    for i in range(jobs):
+        clients.tpujobs.create(new_tpujob(name=f"tj-{i}", workers=3))
+    ok = False
+    end = time.monotonic() + 30
+    expected = jobs * 4  # 1 master + 3 workers each
+    while time.monotonic() < end:
+        if len(server.list("pods")) == expected and all(
+            any(cond.get("type") == c.JOB_RUNNING and cond.get("status") == "True"
+                for cond in (j.get("status") or {}).get("conditions") or [])
+            for j in server.list("tpujobs")
+        ):
+            ok = True
+            break
+        time.sleep(0.01)
+    stop.set()
+    ctrl.factory.stop()
+    assert ok, "jobs did not all reach Running"
+    with lock:
+        assert sorted(creates) == sorted(set(creates)), "a replica was created twice"
+        assert len(creates) == expected

@@ -1,0 +1,41 @@
+"""Smoke tests for the control-plane benchmark."""
+import json
+
+import pytest
+
+import bench_controller
+
+
+def test_bench_smoke_indexed(capsys):
+    rc = bench_controller.main([
+        "--jobs", "3", "--workers", "2", "--threadiness", "2",
+        "--create-latency", "0", "--background-pods", "50", "--timeout", "60",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "bench must print exactly one JSON line"
+    result = json.loads(out[0])
+    assert result["metric"] == "controller_reconcile"
+    assert result["pods"] == 3 * 3  # 1 master + 2 workers per job
+    assert result["jobs_per_sec"] > 0
+    assert result["pod_creates_per_sec"] > 0
+    assert result["sync_p99_ms"] >= result["sync_p50_ms"] >= 0
+
+
+def test_bench_smoke_scan_serial_control():
+    result = bench_controller.run_bench(
+        jobs=2, workers=1, threadiness=1, mode="scan", serial=True,
+        create_latency=0.0, timeout=60, background_pods=20)
+    assert result["mode"] == "scan" and result["serial"] is True
+    assert result["pods"] == 4
+
+
+@pytest.mark.slow
+def test_bench_acceptance_shape():
+    """The J=50 x W=8 acceptance shape completes and reports sane numbers."""
+    result = bench_controller.run_bench(
+        jobs=50, workers=8, threadiness=4, mode="indexed", serial=False,
+        create_latency=0.002, timeout=300, background_pods=1000)
+    assert result["pods"] == 450
+    assert result["syncs"] >= 50
+    assert result["jobs_per_sec"] > 0

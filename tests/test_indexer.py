@@ -1,0 +1,161 @@
+"""Store indexer consistency and the indexed (no-full-scan) claim path."""
+from tpujob.api import constants as c
+from tpujob.kube.control import gen_labels
+from tpujob.kube.informers import (
+    INDEX_JOB_NAME,
+    INDEX_NAMESPACE,
+    INDEX_OWNER_UID,
+    Store,
+)
+from tpujob.kube.objects import ObjectMeta, Pod
+
+from jobtestutil import Harness, new_tpujob
+
+
+def obj(name, ns="default", labels=None, owner_uid=None, controller=True):
+    meta = {"name": name, "namespace": ns}
+    if labels is not None:
+        meta["labels"] = dict(labels)
+    if owner_uid is not None:
+        meta["ownerReferences"] = [
+            {"uid": owner_uid, "controller": controller, "kind": c.KIND, "name": "j"}
+        ]
+    return {"metadata": meta}
+
+
+def names(objs):
+    return sorted(o["metadata"]["name"] for o in objs)
+
+
+def test_upsert_populates_all_indices():
+    s = Store()
+    s.upsert(obj("p0", labels={c.LABEL_JOB_NAME: "j1"}, owner_uid="u1"))
+    s.upsert(obj("p1", ns="other", labels={c.LABEL_JOB_NAME: "j1"}))
+    assert names(s.by_index(INDEX_OWNER_UID, "u1")) == ["p0"]
+    assert names(s.by_index(INDEX_JOB_NAME, "j1")) == ["p0", "p1"]
+    assert names(s.by_index(INDEX_NAMESPACE, "other")) == ["p1"]
+    assert s.by_index(INDEX_OWNER_UID, "nope") == []
+
+
+def test_update_changing_labels_and_owner_moves_buckets():
+    s = Store()
+    s.upsert(obj("p0", labels={c.LABEL_JOB_NAME: "j1"}, owner_uid="u1"))
+    # label now points at j2, controller owner at u2
+    s.upsert(obj("p0", labels={c.LABEL_JOB_NAME: "j2"}, owner_uid="u2"))
+    assert s.by_index(INDEX_JOB_NAME, "j1") == []
+    assert names(s.by_index(INDEX_JOB_NAME, "j2")) == ["p0"]
+    assert s.by_index(INDEX_OWNER_UID, "u1") == []
+    assert names(s.by_index(INDEX_OWNER_UID, "u2")) == ["p0"]
+    # empty buckets are pruned, not left as empty dicts
+    assert "j1" not in s.index_keys(INDEX_JOB_NAME)
+    assert "u1" not in s.index_keys(INDEX_OWNER_UID)
+
+
+def test_update_dropping_index_values_unindexes():
+    s = Store()
+    s.upsert(obj("p0", labels={c.LABEL_JOB_NAME: "j1"}, owner_uid="u1"))
+    s.upsert(obj("p0"))  # labels and owner refs removed
+    assert s.by_index(INDEX_JOB_NAME, "j1") == []
+    assert s.by_index(INDEX_OWNER_UID, "u1") == []
+    assert names(s.list()) == ["p0"]
+
+
+def test_non_controller_owner_ref_not_indexed():
+    s = Store()
+    s.upsert(obj("p0", owner_uid="u1", controller=False))
+    assert s.by_index(INDEX_OWNER_UID, "u1") == []
+
+
+def test_remove_clears_indices():
+    s = Store()
+    o = obj("p0", labels={c.LABEL_JOB_NAME: "j1"}, owner_uid="u1")
+    s.upsert(o)
+    s.remove(o)
+    assert s.list() == []
+    assert s.by_index(INDEX_JOB_NAME, "j1") == []
+    assert s.by_index(INDEX_OWNER_UID, "u1") == []
+    assert s.index_keys(INDEX_NAMESPACE) == []
+
+
+def test_replace_rebuilds_indices():
+    s = Store()
+    s.upsert(obj("old", labels={c.LABEL_JOB_NAME: "j1"}, owner_uid="u1"))
+    s.replace([
+        obj("new1", labels={c.LABEL_JOB_NAME: "j2"}, owner_uid="u2"),
+        obj("new2", ns="other"),
+    ])
+    assert s.by_index(INDEX_JOB_NAME, "j1") == []
+    assert s.by_index(INDEX_OWNER_UID, "u1") == []
+    assert names(s.by_index(INDEX_JOB_NAME, "j2")) == ["new1"]
+    assert names(s.by_index(INDEX_NAMESPACE, "other")) == ["new2"]
+    assert names(s.list()) == ["new1", "new2"]
+
+
+def test_list_returns_snapshot():
+    s = Store()
+    s.upsert(obj("p0"))
+    snapshot = s.list()
+    snapshot.clear()
+    assert names(s.list()) == ["p0"]
+    by_ns = s.by_index(INDEX_NAMESPACE, "default")
+    by_ns.append(obj("phantom"))
+    assert names(s.list("default")) == ["p0"]
+
+
+def test_get_pods_for_job_owned_path_does_no_full_scan():
+    """Acceptance: the owned-object path never walks the whole store."""
+    h = Harness()
+    h.submit(new_tpujob())
+    h.sync()
+    job = h.get_job()
+
+    def boom(namespace=None):
+        raise AssertionError("full-store scan on the claim path")
+
+    h.controller.pod_informer.store.list = boom
+    h.controller.service_informer.store.list = boom
+    pods = h.controller.get_pods_for_job(job)
+    svcs = h.controller.get_services_for_job(job)
+    assert len(pods) == 4 and len(svcs) == 1
+
+
+def test_orphan_adoption_via_label_index():
+    h = Harness()
+    h.submit(new_tpujob(workers=1))
+    h.sync()
+    job = h.get_job()
+    labels = gen_labels(job.metadata.name)
+    labels[c.LABEL_REPLICA_TYPE] = "worker"
+    labels[c.LABEL_REPLICA_INDEX] = "5"
+    orphan = Pod(metadata=ObjectMeta(name="orphan", labels=labels))
+    h.clients.pods.create(orphan)
+    h.controller.factory.sync_all()
+    pods = h.controller.get_pods_for_job(job)
+    assert "orphan" in {p.metadata.name for p in pods}
+    adopted = h.clients.pods.get("default", "orphan")
+    ref = adopted.metadata.owner_references[0]
+    assert ref.uid == job.metadata.uid and ref.controller
+
+
+def test_foreign_owned_pod_with_matching_labels_not_claimed():
+    h = Harness()
+    h.submit(new_tpujob(workers=1))
+    h.sync()
+    job = h.get_job()
+    labels = gen_labels(job.metadata.name)
+    labels[c.LABEL_REPLICA_TYPE] = "worker"
+    labels[c.LABEL_REPLICA_INDEX] = "0"
+    foreign = {
+        "metadata": {"name": "foreign", "namespace": "default",
+                     "labels": labels,
+                     "ownerReferences": [{"uid": "someone-else",
+                                          "controller": True,
+                                          "kind": c.KIND, "name": "other"}]},
+    }
+    h.server.create("pods", foreign)
+    h.controller.factory.sync_all()
+    pods = h.controller.get_pods_for_job(job)
+    assert "foreign" not in {p.metadata.name for p in pods}
+    # and it was not adopted
+    refs = (h.server.get("pods", "default", "foreign")["metadata"]["ownerReferences"])
+    assert refs[0]["uid"] == "someone-else"

@@ -1,0 +1,72 @@
+"""Metrics registry: Histogram semantics and hot-path recording."""
+from tpujob.server import metrics
+from tpujob.server.metrics import Counter, Gauge, Histogram, Registry
+
+from jobtestutil import Harness, new_tpujob
+
+
+def test_histogram_buckets_sum_count():
+    reg = Registry()
+    h = Histogram("x_seconds", "test", reg, buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    samples = dict(h.samples())
+    assert samples['x_seconds_bucket{le="0.1"}'] == 1
+    assert samples['x_seconds_bucket{le="1"}'] == 2
+    assert samples['x_seconds_bucket{le="+Inf"}'] == 3
+    assert samples["x_seconds_count"] == 3
+    assert abs(samples["x_seconds_sum"] - 5.55) < 1e-9
+
+
+def test_histogram_le_is_inclusive():
+    reg = Registry()
+    h = Histogram("y_seconds", "test", reg, buckets=(0.1, 1.0))
+    h.observe(0.1)
+    assert dict(h.samples())['y_seconds_bucket{le="0.1"}'] == 1
+
+
+def test_histogram_quantile_interpolates():
+    reg = Registry()
+    h = Histogram("z_seconds", "test", reg, buckets=(0.1, 1.0, 10.0))
+    assert h.quantile(0.5) == 0.0  # no observations
+    for _ in range(100):
+        h.observe(0.5)
+    q = h.quantile(0.5)
+    assert 0.1 < q <= 1.0
+    h2 = Histogram("w_seconds", "test", reg, buckets=(0.1,))
+    h2.observe(99.0)  # beyond the last finite bucket: clamps
+    assert h2.quantile(0.99) == 0.1
+
+
+def test_exposition_format():
+    reg = Registry()
+    Counter("a_total", "a help", reg)
+    Gauge("b", "b help", reg)
+    hist = Histogram("c_seconds", "c help", reg, buckets=(0.5,))
+    hist.observe(0.1)
+    text = reg.expose()
+    assert "# TYPE a_total counter" in text
+    assert "# TYPE b gauge" in text
+    assert "# TYPE c_seconds histogram" in text
+    assert 'c_seconds_bucket{le="0.5"} 1' in text
+    assert 'c_seconds_bucket{le="+Inf"} 1' in text
+    assert "c_seconds_count 1" in text
+
+
+def test_process_next_item_records_duration_and_queue_depth():
+    h = Harness()
+    h.submit(new_tpujob())
+    h.controller.factory.sync_all()
+    before = metrics.reconcile_duration.value
+    h.controller.enqueue_job("default/test-job")
+    assert h.controller.process_next_item(timeout=1.0)
+    assert metrics.reconcile_duration.value == before + 1
+    assert metrics.queue_depth.value >= 0
+
+
+def test_pod_control_counts_creates():
+    before = metrics.pods_created.value
+    h = Harness()
+    h.submit(new_tpujob())  # 1 master + 3 workers
+    h.sync()
+    assert metrics.pods_created.value == before + 4

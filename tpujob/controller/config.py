@@ -6,8 +6,10 @@ a file-based override.
 """
 from __future__ import annotations
 
+import copy
 import os
 import string
+from functools import lru_cache
 from typing import Dict, List
 
 DEFAULT_INIT_CONTAINER_TEMPLATE = """\
@@ -34,10 +36,23 @@ def get_init_container_template(override_path: str = CONFIG_OVERRIDE_PATH) -> st
     return DEFAULT_INIT_CONTAINER_TEMPLATE
 
 
-def render_init_containers(master_addr: str, init_image: str, template: str | None = None) -> List[Dict]:
-    """Render the init-container template (util.go:61-87 equivalent)."""
+@lru_cache(maxsize=1024)
+def _render_cached(master_addr: str, init_image: str, template: str):
     import yaml
 
-    tpl = string.Template(template or get_init_container_template())
+    tpl = string.Template(template)
     rendered = tpl.safe_substitute(master_addr=master_addr, init_image=init_image)
     return yaml.safe_load(rendered)
+
+
+def render_init_containers(master_addr: str, init_image: str, template: str | None = None) -> List[Dict]:
+    """Render the init-container template (util.go:61-87 equivalent).
+
+    The YAML parse is memoized per (addr, image, template) — it sat on the
+    reconcile hot path at ~5 ms per pod build; a template-file override still
+    takes effect because the template text is part of the cache key.  The
+    result is deep-copied so callers can mutate it freely.
+    """
+    parsed = _render_cached(master_addr, init_image,
+                            template or get_init_container_template())
+    return copy.deepcopy(parsed)

@@ -10,8 +10,9 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tpujob.api import constants as c
 from tpujob.api.types import TPUJob
@@ -28,11 +29,50 @@ from tpujob.kube.control import (
     gen_labels,
 )
 from tpujob.kube.errors import NotFoundError
-from tpujob.kube.informers import InformerFactory
+from tpujob.kube.informers import (
+    INDEX_JOB_NAME,
+    INDEX_OWNER_UID,
+    InformerFactory,
+    SharedInformer,
+)
 from tpujob.kube.objects import Pod, Service
 from tpujob.runtime import ExpectationsCache, WorkQueue
+from tpujob.server import metrics
 
 log = logging.getLogger("tpujob.controller")
+
+
+class _DedupWarner:
+    """Rate-limits duplicate warnings keyed by (object, reason).
+
+    A stuck out-of-range pod re-warned on every sync would flood the log at
+    high resync rates; one line per interval carries the same information.
+    """
+
+    def __init__(self, interval: float = 300.0, max_entries: int = 4096):
+        self._interval = interval
+        self._max = max_entries
+        self._lock = threading.Lock()
+        self._last: Dict[Tuple, float] = {}
+
+    def warning(self, logger: logging.Logger, key: Tuple, msg: str, *args) -> None:
+        now = time.monotonic()
+        with self._lock:
+            last = self._last.get(key)
+            if last is not None and now - last < self._interval:
+                return
+            if len(self._last) >= self._max:
+                self._last = {
+                    k: t for k, t in self._last.items() if now - t < self._interval
+                }
+                if len(self._last) >= self._max:
+                    # bounded memory beats perfect dedup under key churn
+                    self._last.clear()
+            self._last[key] = now
+        logger.warning(msg, *args)
+
+
+_slice_warner = _DedupWarner()
 
 
 @dataclass
@@ -182,41 +222,51 @@ class JobController:
     # claim / adopt / orphan (jobcontroller/pod.go:165-196)
     # ------------------------------------------------------------------
 
-    def get_pods_for_job(self, job: TPUJob) -> List[Pod]:
+    def _claim_for_job(
+        self,
+        informer: SharedInformer,
+        resource: str,
+        job: TPUJob,
+        from_dict: Callable[[Dict[str, Any]], Any],
+    ) -> List[Any]:
+        """Indexed claim loop shared by pods and services.
+
+        Owned objects resolve through the controller-owner-UID index and
+        adoption candidates through the job-name label index restricted to
+        orphans, so the cost is O(objects-of-job) regardless of cluster size
+        — no full-store scan on either path.  Objects controller-owned by
+        someone else are never touched (pod.go:165-196 semantics).
+        """
         ns = job.metadata.namespace or "default"
         selector = gen_labels(job.metadata.name)
-        out: List[Pod] = []
-        for obj in self.pod_informer.store.list(ns):
+        store = informer.store
+        out: List[Any] = []
+        for obj in store.by_index(INDEX_OWNER_UID, job.metadata.uid):
             meta = obj.get("metadata") or {}
+            if (meta.get("namespace") or "default") != ns:
+                continue
+            out.append(from_dict(obj))
+        for obj in store.by_index(INDEX_JOB_NAME, selector[c.LABEL_JOB_NAME]):
+            meta = obj.get("metadata") or {}
+            if (meta.get("namespace") or "default") != ns:
+                continue
+            if any(r.get("controller") for r in meta.get("ownerReferences") or []):
+                continue  # owned (by us: already collected; by another: skip)
             labels = meta.get("labels") or {}
-            refs = meta.get("ownerReferences") or []
-            owned = any(r.get("controller") and r.get("uid") == job.metadata.uid for r in refs)
-            matches = all(labels.get(k) == v for k, v in selector.items())
-            if owned:
-                out.append(Pod.from_dict(obj))
-            elif matches and not any(r.get("controller") for r in refs):
-                adopted = self._adopt(RESOURCE_PODS, job, meta)
-                if adopted is not None:
-                    out.append(Pod.from_dict(adopted))
+            if not all(labels.get(k) == v for k, v in selector.items()):
+                continue
+            adopted = self._adopt(resource, job, meta)
+            if adopted is not None:
+                out.append(from_dict(adopted))
         return out
 
+    def get_pods_for_job(self, job: TPUJob) -> List[Pod]:
+        return self._claim_for_job(self.pod_informer, RESOURCE_PODS, job, Pod.from_dict)
+
     def get_services_for_job(self, job: TPUJob) -> List[Service]:
-        ns = job.metadata.namespace or "default"
-        selector = gen_labels(job.metadata.name)
-        out: List[Service] = []
-        for obj in self.service_informer.store.list(ns):
-            meta = obj.get("metadata") or {}
-            labels = meta.get("labels") or {}
-            refs = meta.get("ownerReferences") or []
-            owned = any(r.get("controller") and r.get("uid") == job.metadata.uid for r in refs)
-            matches = all(labels.get(k) == v for k, v in selector.items())
-            if owned:
-                out.append(Service.from_dict(obj))
-            elif matches and not any(r.get("controller") for r in refs):
-                adopted = self._adopt(RESOURCE_SERVICES, job, meta)
-                if adopted is not None:
-                    out.append(Service.from_dict(adopted))
-        return out
+        return self._claim_for_job(
+            self.service_informer, RESOURCE_SERVICES, job, Service.from_dict
+        )
 
     def _adopt(self, resource: str, job: TPUJob, meta: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         """Adopt an orphan by patching a controller owner ref onto it, with an
@@ -264,12 +314,18 @@ class JobController:
             try:
                 idx = int(idx_s)
             except (TypeError, ValueError):
-                log.warning("object %s has no/invalid replica index %r", o.metadata.name, idx_s)
+                _slice_warner.warning(
+                    log,
+                    (o.metadata.namespace, o.metadata.name, "invalid-index", idx_s),
+                    "object %s has no/invalid replica index %r", o.metadata.name, idx_s)
                 continue
             if 0 <= idx < replicas:
                 slices[idx].append(o)
             else:
-                log.warning("object %s index %d out of range [0,%d)", o.metadata.name, idx, replicas)
+                _slice_warner.warning(
+                    log,
+                    (o.metadata.namespace, o.metadata.name, "out-of-range", idx),
+                    "object %s index %d out of range [0,%d)", o.metadata.name, idx, replicas)
         return slices
 
     # ------------------------------------------------------------------
@@ -300,6 +356,8 @@ class JobController:
             return False
         if key is None:
             return True
+        metrics.queue_depth.set(len(self.queue))
+        start = time.monotonic()
         try:
             forget = self.sync_handler(key)
             if forget:
@@ -310,6 +368,7 @@ class JobController:
             log.exception("error syncing job %s", key)
             self.queue.add_rate_limited(key)
         finally:
+            metrics.reconcile_duration.observe(time.monotonic() - start)
             self.queue.done(key)
         return True
 

@@ -277,6 +277,7 @@ class TPUJobController(JobController):
         st.initialize_replica_statuses(job.status, rtype)
         slices = self.get_slices(pods, replicas)
         restarting = False
+        missing: List[int] = []
         for index in range(replicas):
             pod_slice = slices[index]
             if len(pod_slice) > 1:
@@ -284,7 +285,7 @@ class TPUJobController(JobController):
                     "%d pods share index %d", len(pod_slice), index)
                 continue
             if not pod_slice:
-                self._create_new_pod(job, rtype, rspec, index)
+                missing.append(index)
                 continue
             pod = pod_slice[0]
             # ExitCode restart policy (pod.go:91-109)
@@ -355,7 +356,26 @@ class TPUJobController(JobController):
                     # status machine emits Restarting (reference pod.go:91-109
                     # deletes async and the pod is still counted)
             st.update_replica_statuses(job.status, rtype, pod)
+        if missing:
+            # all missing replicas of this type launch concurrently (a v4-32
+            # job's 8 hosts cost ~1 API round trip, not 8 sequential ones)
+            self._create_pods_batch(job, rtype, rspec, missing)
         return restarting
+
+    def _create_pods_batch(self, job: TPUJob, rtype: str, rspec, indices: List[int]) -> None:
+        """Slow-start parallel create with reference expectation bookkeeping
+        (controller.go:430-470): raise for every intended create up front,
+        lower for every create that did not happen — failed or skipped after
+        a failing batch — then surface the first error to the workqueue."""
+        ekey = expectation_key(job.key, rtype, "pods")
+        pods = [self._build_pod(job, rtype, rspec, index) for index in indices]
+        self.expectations.expect(ekey, adds=len(pods), dels=0)
+        created, err = self.pod_control.create_pods(
+            job.metadata.namespace or "default", pods, job)
+        for _ in range(len(pods) - created):
+            self.expectations.observe_add(ekey)
+        if err is not None:
+            raise err
 
     @staticmethod
     def _managed_exit_code(pod: Pod) -> Optional[int]:
@@ -364,8 +384,8 @@ class TPUJobController(JobController):
                 return cs.state.terminated.exit_code
         return None
 
-    def _create_new_pod(self, job: TPUJob, rtype: str, rspec, index: int) -> None:
-        key = job.key
+    def _build_pod(self, job: TPUJob, rtype: str, rspec, index: int) -> Pod:
+        """Render the pod for one replica index (no API writes)."""
         name = gen_general_name(job.metadata.name, rtype, index)
         template = rspec.template.deepcopy()
         labels = gen_labels(job.metadata.name)
@@ -401,14 +421,7 @@ class TPUJobController(JobController):
                     name, pod.spec.scheduler_name, self.config.gang_scheduler_name)
             pod.spec.scheduler_name = self.config.gang_scheduler_name
             pod.metadata.annotations[c.POD_GROUP_ANNOTATION] = gen_pod_group_name(job.metadata.name)
-
-        self.expectations.expect(expectation_key(key, rtype, "pods"), adds=1, dels=0)
-        try:
-            self.pod_control.create_pod(pod.metadata.namespace, pod, job)
-        except Exception:
-            # roll back the expectation so the next sync isn't blocked
-            self.expectations.observe_add(expectation_key(key, rtype, "pods"))
-            raise
+        return pod
 
     @staticmethod
     def _set_restart_policy(pod: Pod, rspec) -> None:
@@ -449,12 +462,24 @@ class TPUJobController(JobController):
     def _reconcile_services(self, job: TPUJob, services: List[Service], rtype: str, rspec) -> None:
         replicas = 1  # master-only
         slices = self.get_slices(services, replicas)
-        for index in range(replicas):
-            if not slices[index]:
-                self._create_new_service(job, rtype, index)
+        missing = [index for index in range(replicas) if not slices[index]]
+        if missing:
+            self._create_services_batch(job, rtype, missing)
 
-    def _create_new_service(self, job: TPUJob, rtype: str, index: int) -> None:
-        key = job.key
+    def _create_services_batch(self, job: TPUJob, rtype: str, indices: List[int]) -> None:
+        """Mirror of _create_pods_batch for the headless service(s)."""
+        ekey = expectation_key(job.key, rtype, "services")
+        services = [self._build_service(job, rtype, index) for index in indices]
+        self.expectations.expect(ekey, adds=len(services), dels=0)
+        created, err = self.service_control.create_services(
+            job.metadata.namespace or "default", services, job)
+        for _ in range(len(services) - created):
+            self.expectations.observe_add(ekey)
+        if err is not None:
+            raise err
+
+    def _build_service(self, job: TPUJob, rtype: str, index: int) -> Service:
+        """Render the headless rendezvous service (no API writes)."""
         port = get_port_from_job(job, rtype)
         labels = gen_labels(job.metadata.name)
         labels[c.LABEL_REPLICA_TYPE] = rtype.lower()
@@ -466,7 +491,7 @@ class TPUJobController(JobController):
             # MEGASCALE_COORDINATOR_ADDRESS (host:MEGASCALE_PORT) matches
             # a named ServicePort (tpu_env.py contract)
             ports.append(ServicePort(name="megascale", port=tpu_env.MEGASCALE_PORT))
-        service = Service(
+        return Service(
             metadata=ObjectMeta(
                 name=gen_general_name(job.metadata.name, rtype, index),
                 namespace=job.metadata.namespace or "default",
@@ -478,12 +503,6 @@ class TPUJobController(JobController):
                 ports=ports,
             ),
         )
-        self.expectations.expect(expectation_key(key, rtype, "services"), adds=1, dels=0)
-        try:
-            self.service_control.create_service(service.metadata.namespace, service, job)
-        except Exception:
-            self.expectations.observe_add(expectation_key(key, rtype, "services"))
-            raise
 
     # ------------------------------------------------------------------
     # status convergence (status.go:63-152)

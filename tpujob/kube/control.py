@@ -9,13 +9,78 @@ and client-go's EventRecorder.
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Tuple
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional, Tuple
 
 from tpujob.api import constants as c
 from tpujob.api.types import TPUJob
 from tpujob.kube.client import ClientSet
 from tpujob.kube.memserver import now_iso
 from tpujob.kube.objects import Event, ObjectMeta, OwnerReference, Pod, Service
+from tpujob.server import metrics
+
+# client-go kubecontroller.SlowStartInitialBatchSize; the pool bound keeps a
+# huge replica count from occupying unbounded threads in one batch.
+SLOW_START_INITIAL_BATCH_SIZE = 1
+MAX_BATCH_CONCURRENCY = 16
+
+# One shared daemon pool for every batch in the process: spawning a pool per
+# batch put thread startup on the reconcile hot path.  Batch fns must never
+# call slow_start_batch themselves (they are plain API creates).
+_batch_pool_lock = threading.Lock()
+_batch_pool: Optional[ThreadPoolExecutor] = None
+
+
+def _batch_executor() -> ThreadPoolExecutor:
+    global _batch_pool
+    with _batch_pool_lock:
+        if _batch_pool is None:
+            _batch_pool = ThreadPoolExecutor(
+                max_workers=MAX_BATCH_CONCURRENCY, thread_name_prefix="tpujob-batch"
+            )
+        return _batch_pool
+
+
+def slow_start_batch(
+    count: int,
+    fn: Callable[[int], None],
+    initial_batch_size: int = SLOW_START_INITIAL_BATCH_SIZE,
+) -> Tuple[int, Optional[Exception]]:
+    """Run ``fn(i)`` for i in range(count) in exponentially growing parallel
+    batches of size 1, 2, 4, ... (client-go ``slowStartBatch``,
+    controller_utils.go): a systemic failure — quota exhausted, admission
+    webhook down — costs one call instead of ``count``.
+
+    The calls of a failing batch run to completion; subsequent batches are
+    skipped.  Returns ``(successes, first_error)``.
+    """
+    successes = 0
+    position = 0
+    remaining = count
+    batch = min(remaining, initial_batch_size)
+    while batch > 0:
+        errors: List[Exception] = []
+        if batch == 1:
+            try:
+                fn(position)
+                successes += 1
+            except Exception as e:  # noqa: BLE001 - caller rethrows
+                errors.append(e)
+        else:
+            pool = _batch_executor()
+            futures = [pool.submit(fn, i) for i in range(position, position + batch)]
+            for future in futures:
+                try:
+                    future.result()
+                    successes += 1
+                except Exception as e:  # noqa: BLE001 - caller rethrows
+                    errors.append(e)
+        position += batch
+        remaining -= batch
+        if errors:
+            return successes, errors[0]
+        batch = min(remaining, batch * 2)
+    return successes, None
 
 
 def gen_owner_reference(job: TPUJob) -> OwnerReference:
@@ -105,11 +170,25 @@ class PodControl:
         if not any(r.uid == ref.uid for r in pod.metadata.owner_references):
             pod.metadata.owner_references.append(ref)
         created = self.clients.pods.create(pod)
+        metrics.pods_created.inc()
         self.recorder.event(
             controller_object, "Normal", "SuccessfulCreatePod",
             f"Created pod: {created.metadata.name}",
         )
         return created
+
+    def create_pods(
+        self, namespace: str, pods: List[Pod], controller_object: TPUJob
+    ) -> Tuple[int, Optional[Exception]]:
+        """Create all ``pods`` concurrently in slow-start batches.
+
+        Returns ``(created, first_error)`` — the caller owns expectation
+        bookkeeping for the ``len(pods) - created`` creates that failed or
+        were skipped after a failing batch.
+        """
+        return slow_start_batch(
+            len(pods), lambda i: self.create_pod(namespace, pods[i], controller_object)
+        )
 
     def delete_pod(self, namespace: str, name: str, controller_object: TPUJob) -> None:
         self.clients.pods.delete(namespace, name)
@@ -137,6 +216,15 @@ class ServiceControl:
         )
         return created
 
+    def create_services(
+        self, namespace: str, services: List[Service], controller_object: TPUJob
+    ) -> Tuple[int, Optional[Exception]]:
+        """Slow-start parallel create; see ``PodControl.create_pods``."""
+        return slow_start_batch(
+            len(services),
+            lambda i: self.create_service(namespace, services[i], controller_object),
+        )
+
     def delete_service(self, namespace: str, name: str, controller_object: TPUJob) -> None:
         self.clients.services.delete(namespace, name)
         self.recorder.event(
@@ -153,13 +241,18 @@ class FakePodControl(PodControl):
         self.templates: List[Pod] = []
         self.deleted: List[Tuple[str, str]] = []
         self.create_limit: Optional[int] = None
+        # create_pods runs creates concurrently on the slow-start pool, so
+        # the limit check-then-append must be atomic
+        self._lock = threading.Lock()
 
     def create_pod(self, namespace, pod, controller_object):
-        if self.create_limit is not None and len(self.templates) >= self.create_limit:
-            raise RuntimeError("fake pod control: create limit exceeded")
         pod.metadata.namespace = namespace
         pod.metadata.owner_references.append(gen_owner_reference(controller_object))
-        self.templates.append(pod)
+        with self._lock:
+            if (self.create_limit is not None
+                    and len(self.templates) >= self.create_limit):
+                raise RuntimeError("fake pod control: create limit exceeded")
+            self.templates.append(pod)
         return pod
 
     def delete_pod(self, namespace, name, controller_object):

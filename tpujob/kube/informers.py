@@ -12,42 +12,129 @@ import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from tpujob.api import constants as c
 from tpujob.kube.errors import GoneError
 from tpujob.kube.memserver import ADDED, DELETED, MODIFIED, InMemoryAPIServer
 
 log = logging.getLogger("tpujob.informers")
 
+# Well-known index names (client-go cache.Indexers; the reference relies on
+# the generated informers' NamespaceIndex plus label-selector listers).  The
+# controller's hot path resolves a job's pods/services through these instead
+# of scanning the whole store, so sync cost is O(objects-of-job), not
+# O(cluster).
+INDEX_NAMESPACE = "namespace"
+INDEX_OWNER_UID = "owner-uid"  # controller ownerReference UIDs
+INDEX_JOB_NAME = "job-name"  # the tpu-job-name label
+
+IndexFunc = Callable[[Dict[str, Any]], List[str]]
+
+
+def _index_namespace(obj: Dict[str, Any]) -> List[str]:
+    return [(obj.get("metadata") or {}).get("namespace") or "default"]
+
+
+def _index_owner_uid(obj: Dict[str, Any]) -> List[str]:
+    meta = obj.get("metadata") or {}
+    return [
+        ref["uid"]
+        for ref in meta.get("ownerReferences") or []
+        if ref.get("controller") and ref.get("uid")
+    ]
+
+
+def _index_job_name(obj: Dict[str, Any]) -> List[str]:
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    value = labels.get(c.LABEL_JOB_NAME)
+    return [value] if value else []
+
+
+DEFAULT_INDEXERS: Dict[str, IndexFunc] = {
+    INDEX_NAMESPACE: _index_namespace,
+    INDEX_OWNER_UID: _index_owner_uid,
+    INDEX_JOB_NAME: _index_job_name,
+}
+
 
 class Store:
-    """Thread-safe object cache keyed namespace/name with namespace index."""
+    """Thread-safe indexed object cache keyed namespace/name.
 
-    def __init__(self):
+    Cached objects are shared read-only: ``list``/``by_index``/``get`` return
+    the cached dicts themselves (inside fresh snapshot lists), so callers must
+    not mutate them — copy first to modify, exactly as with client-go lister
+    results.
+    """
+
+    def __init__(self, indexers: Optional[Dict[str, IndexFunc]] = None):
         self._lock = threading.RLock()
         self._objects: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._indexers = dict(DEFAULT_INDEXERS if indexers is None else indexers)
+        # index name -> index key -> {store key -> obj}; the inner dict gives
+        # O(1) removal while preserving insertion order for stable listings
+        self._indices: Dict[str, Dict[str, Dict[Tuple[str, str], Dict[str, Any]]]] = {
+            name: {} for name in self._indexers
+        }
+
+    def _index_insert(self, key: Tuple[str, str], obj: Dict[str, Any]) -> None:
+        for name, fn in self._indexers.items():
+            index = self._indices[name]
+            for ikey in fn(obj):
+                index.setdefault(ikey, {})[key] = obj
+
+    def _index_remove(self, key: Tuple[str, str], obj: Dict[str, Any]) -> None:
+        for name, fn in self._indexers.items():
+            index = self._indices[name]
+            for ikey in fn(obj):
+                bucket = index.get(ikey)
+                if bucket is None:
+                    continue
+                bucket.pop(key, None)
+                if not bucket:
+                    del index[ikey]
 
     def replace(self, objs: List[Dict[str, Any]]) -> None:
         with self._lock:
             self._objects = {self._key(o): o for o in objs}
+            self._indices = {name: {} for name in self._indexers}
+            for key, obj in self._objects.items():
+                self._index_insert(key, obj)
 
     def upsert(self, obj: Dict[str, Any]) -> None:
         with self._lock:
-            self._objects[self._key(obj)] = obj
+            key = self._key(obj)
+            old = self._objects.get(key)
+            if old is not None:
+                self._index_remove(key, old)
+            self._objects[key] = obj
+            self._index_insert(key, obj)
 
     def remove(self, obj: Dict[str, Any]) -> None:
         with self._lock:
-            self._objects.pop(self._key(obj), None)
+            key = self._key(obj)
+            old = self._objects.pop(key, None)
+            if old is not None:
+                self._index_remove(key, old)
 
     def get(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
         with self._lock:
             return self._objects.get((namespace or "default", name))
 
     def list(self, namespace: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Snapshot list (objects shared read-only, see class docstring)."""
         with self._lock:
-            return [
-                o
-                for (ns, _), o in self._objects.items()
-                if namespace is None or ns == namespace
-            ]
+            if namespace is None:
+                return list(self._objects.values())
+            return list((self._indices[INDEX_NAMESPACE].get(namespace) or {}).values())
+
+    def by_index(self, index: str, key: str) -> List[Dict[str, Any]]:
+        """Snapshot of the objects indexed under ``key`` (cache.Indexer.ByIndex)."""
+        with self._lock:
+            return list((self._indices[index].get(key) or {}).values())
+
+    def index_keys(self, index: str) -> List[str]:
+        """The non-empty keys of one index (cache.Indexer.ListIndexFuncValues)."""
+        with self._lock:
+            return list(self._indices[index].keys())
 
     @staticmethod
     def _key(obj: Dict[str, Any]) -> Tuple[str, str]:

@@ -8,8 +8,13 @@ exposition format.
 """
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Dict
+from typing import Dict, List, Tuple
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if v == int(v) else repr(v)
 
 
 class Counter:
@@ -32,6 +37,10 @@ class Counter:
     def kind(self) -> str:
         return "counter"
 
+    def samples(self) -> List[Tuple[str, float]]:
+        """(series name incl. labels, value) pairs for exposition."""
+        return [(self.name, self.value)]
+
 
 class Gauge(Counter):
     def set(self, value: float) -> None:
@@ -40,6 +49,89 @@ class Gauge(Counter):
 
     def kind(self) -> str:
         return "gauge"
+
+
+# Latency-oriented default buckets (prometheus DefBuckets shifted one decade
+# down: controller syncs against a local cache are sub-millisecond).
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Histogram:
+    """Cumulative-bucket histogram (the promauto.NewHistogram equivalent)."""
+
+    def __init__(self, name: str, help_text: str, registry: "Registry",
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_text
+        self._buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self._buckets) + 1)  # per-bucket + overflow
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+        registry._register(self)
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self._buckets, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def value(self) -> float:
+        """Observation count (the scalar a Counter-shaped caller expects)."""
+        with self._lock:
+            return float(self._count)
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile estimate (promql histogram_quantile).
+
+        Values beyond the last finite bucket clamp to that bucket's bound.
+        Returns 0.0 with no observations.
+        """
+        with self._lock:
+            counts, total = list(self._counts), self._count
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0.0
+        for i, n in enumerate(counts):
+            prev_cum = cum
+            cum += n
+            if cum < rank:
+                continue
+            if i >= len(self._buckets):
+                return self._buckets[-1]
+            lo = self._buckets[i - 1] if i > 0 else 0.0
+            hi = self._buckets[i]
+            if n == 0:
+                return hi
+            return lo + (hi - lo) * (rank - prev_cum) / n
+        return self._buckets[-1]
+
+    def kind(self) -> str:
+        return "histogram"
+
+    def samples(self) -> List[Tuple[str, float]]:
+        with self._lock:
+            counts, total, s = list(self._counts), self._count, self._sum
+        out: List[Tuple[str, float]] = []
+        cum = 0
+        for ub, n in zip(self._buckets, counts):
+            cum += n
+            out.append((f'{self.name}_bucket{{le="{_fmt(ub)}"}}', cum))
+        out.append((f'{self.name}_bucket{{le="+Inf"}}', total))
+        out.append((f"{self.name}_sum", s))
+        out.append((f"{self.name}_count", total))
+        return out
 
 
 class Registry:
@@ -59,8 +151,8 @@ class Registry:
         for m in metrics:
             lines.append(f"# HELP {m.name} {m.help}")
             lines.append(f"# TYPE {m.name} {m.kind()}")
-            v = m.value
-            lines.append(f"{m.name} {int(v) if v == int(v) else v}")
+            for series, v in m.samples():
+                lines.append(f"{series} {_fmt(v)}")
         return "\n".join(lines) + "\n"
 
 
@@ -83,4 +175,22 @@ jobs_restarted = Counter(
 )
 is_leader = Gauge(
     "tpujob_operator_is_leader", "Whether this operator instance is the leader", REGISTRY
+)
+
+# Control-plane hot-path series (this port's addition; the reference exposes
+# only the job-lifecycle totals above).  Recorded by JobController.
+reconcile_duration = Histogram(
+    "tpujob_operator_reconcile_duration_seconds",
+    "Latency of one sync_handler call (workqueue item processing)",
+    REGISTRY,
+)
+queue_depth = Gauge(
+    "tpujob_operator_queue_depth",
+    "Workqueue depth sampled at dequeue time",
+    REGISTRY,
+)
+pods_created = Counter(
+    "tpujob_operator_pods_created_total",
+    "Counts pods created by the operator's pod control",
+    REGISTRY,
 )
