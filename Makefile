@@ -11,7 +11,7 @@ SHELL := /bin/bash
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci ci-fast native lint codegen-verify unit unit-fast test e2e bench-smoke bench-controller dryrun images clean
+.PHONY: ci ci-fast native lint codegen-verify unit unit-fast test e2e soak bench-smoke bench-controller dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
@@ -53,6 +53,12 @@ e2e:
 	scripts/run-defaults.sh
 	scripts/run-cleanpodpolicy-all.sh
 	scripts/run-preemption.sh
+
+# chaos soak: the full job matrix under 5 seeded fault schedules (25 jobs;
+# API faults + watch kills + compaction + preemption storms), asserting the
+# system invariants after every convergence (docs/failure-handling)
+soak:
+	$(PY) soak.py --seeds 1,2,3,4,5
 
 # driver-contract smoke: the multi-chip sharding dryrun on 8 virtual devices
 dryrun:

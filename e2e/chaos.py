@@ -1,0 +1,520 @@
+"""Chaos soak harness: jobs to completion under injected faults + invariants.
+
+The scenario tier the reference can only approximate with flaky real
+clusters: a matrix of jobs (master+worker, master-less, multislice,
+ExitCode/OnFailure, backoff-limit exhaustion, TTL cleanup) runs to a
+terminal state while the operator's API transport injects 500s, lost
+responses, spurious conflicts, watch kills, history compaction and
+duplicate events (``tpujob.kube.chaos``) and a seeded preemption storm
+kills/preempts running pods through the kubelet's own connection.  After
+convergence the harness asserts the system invariants that define
+"correct under adversity":
+
+1. at most one pod per (job, replica type, replica index)
+2. ``restarts`` never exceeds ``backoffLimit`` + bounded in-flight slack
+3. every job reaches exactly one terminal condition, and Succeeded never
+   flips to Failed (nor Failed to Succeeded)
+4. the reconciler's ``_restart_deltas`` ledger drains and every
+   expectation is satisfied once the cluster is quiet
+5. no orphaned pods/services survive a finished (or TTL-deleted) job
+
+Runnable:  python -m e2e.chaos --seed 7
+(or the full seeded matrix via the repo-root ``soak.py`` / ``make soak``)
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from e2e.cluster import E2ECluster
+from e2e.kubelet import PodScript
+from tpujob.api import constants as c
+from tpujob.api.types import TPUJob
+from tpujob.controller.job_base import expectation_key
+from tpujob.kube.chaos import (
+    FAULT_TIMEOUT_DROPPED,
+    FAULT_TIMEOUT_LOST,
+    ChaosConfig,
+    FaultInjectingAPIServer,
+)
+from tpujob.kube.client import RESOURCE_TPUJOBS, ClientSet
+from tpujob.kube.errors import ConflictError, NotFoundError
+from tpujob.kube.memserver import InMemoryAPIServer
+
+
+# ---------------------------------------------------------------------------
+# job matrix
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class JobCase:
+    """One matrix entry: the job, its kubelet scripts, and what to expect."""
+
+    job: TPUJob
+    scripts: List[PodScript] = field(default_factory=list)
+    # "Succeeded" | "Failed" | "any" (a storm can legitimately fail an
+    # OnFailure job by downing its node)
+    expect_terminal: str = "any"
+    expect_deleted: bool = False  # TTL reaps the job itself
+    clean_all: bool = False  # cleanPodPolicy All: no pods may survive
+
+
+def _job(name: str, spec: Dict[str, Any]) -> TPUJob:
+    return TPUJob.from_dict({
+        "apiVersion": f"{c.GROUP_NAME}/{c.VERSION}", "kind": c.KIND,
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    })
+
+
+def _tmpl() -> Dict[str, Any]:
+    return {"spec": {"containers": [{
+        "name": c.DEFAULT_CONTAINER_NAME, "image": "tpujob/chaos:latest",
+    }]}}
+
+
+def matrix(prefix: str) -> List[JobCase]:
+    """The soak's job matrix; ``prefix`` keeps per-seed runs disjoint."""
+    cases: List[JobCase] = []
+
+    # master+worker, OnFailure, cleanPodPolicy All + TTL: the defaults-E2E
+    # shape plus full cleanup — TTL then reaps the job object itself, so the
+    # delete/GC path also runs under faults
+    cases.append(JobCase(
+        job=_job(f"{prefix}-mw", {
+            "runPolicy": {"cleanPodPolicy": c.CLEAN_POD_POLICY_ALL,
+                          "ttlSecondsAfterFinished": 1, "backoffLimit": 60},
+            "tpuReplicaSpecs": {
+                "Master": {"replicas": 1, "restartPolicy": "OnFailure", "template": _tmpl()},
+                "Worker": {"replicas": 2, "restartPolicy": "OnFailure", "template": _tmpl()},
+            },
+        }),
+        expect_deleted=True, clean_all=True,
+    ))
+
+    # master-less ExitCode worker: one retryable preemption (137), then
+    # success — the controller-owned restart path
+    cases.append(JobCase(
+        job=_job(f"{prefix}-wonly", {
+            "runPolicy": {"backoffLimit": 30},
+            "tpuReplicaSpecs": {
+                "Worker": {"replicas": 1, "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                           "template": _tmpl()},
+            },
+        }),
+        scripts=[PodScript(match=f"{prefix}-wonly-worker-0", exit_codes=[137])],
+    ))
+
+    # multislice v4-16 x2: master + 3 workers across 2 slices (4 hosts
+    # total, MEGASCALE env injected)
+    cases.append(JobCase(
+        job=_job(f"{prefix}-multi", {
+            "runPolicy": {"backoffLimit": 60},
+            "tpuReplicaSpecs": {
+                "Master": {"replicas": 1, "restartPolicy": "OnFailure",
+                           "tpu": {"accelerator": "v4-16", "numSlices": 2},
+                           "template": _tmpl()},
+                "Worker": {"replicas": 3, "restartPolicy": "OnFailure",
+                           "template": _tmpl()},
+            },
+        }),
+    ))
+
+    # OnFailure flake: one in-place kubelet container restart, then success
+    cases.append(JobCase(
+        job=_job(f"{prefix}-flaky", {
+            "runPolicy": {"backoffLimit": 60},
+            "tpuReplicaSpecs": {
+                "Worker": {"replicas": 1, "restartPolicy": "OnFailure", "template": _tmpl()},
+            },
+        }),
+        scripts=[PodScript(match=f"{prefix}-flaky-worker-0", exit_codes=[1])],
+    ))
+
+    # crash loop to backoff-limit exhaustion: must end exactly Failed, with
+    # the restart count bounded by the limit + in-flight slack
+    cases.append(JobCase(
+        job=_job(f"{prefix}-exhaust", {
+            "runPolicy": {"backoffLimit": 2},
+            "tpuReplicaSpecs": {
+                "Worker": {"replicas": 1, "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                           "template": _tmpl()},
+            },
+        }),
+        scripts=[PodScript(match=f"{prefix}-exhaust-worker-0", exit_codes=[137] * 50)],
+        expect_terminal="Failed",
+    ))
+    return cases
+
+
+# ---------------------------------------------------------------------------
+# status-history tracking (terminal-flip detection)
+# ---------------------------------------------------------------------------
+
+
+class StatusTracker:
+    """Watches every TPUJob status write and records terminal transitions.
+
+    Registered as a hook on the INNER server, so it sees the committed
+    stream — including writes whose responses the chaos layer then lost.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._terminal: Dict[str, str] = {}  # job name -> first terminal type
+        self.flips: List[str] = []
+
+    def hook(self, ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
+        if resource != RESOURCE_TPUJOBS:
+            return
+        name = (obj.get("metadata") or {}).get("name") or ""
+        conds = ((obj.get("status") or {}).get("conditions")) or []
+        state = {cond.get("type") for cond in conds
+                 if cond.get("status") == "True"
+                 and cond.get("type") in (c.JOB_SUCCEEDED, c.JOB_FAILED)}
+        with self._lock:
+            prev = self._terminal.get(name)
+            if prev is None:
+                if len(state) == 1:
+                    self._terminal[name] = next(iter(state))
+                elif len(state) > 1:
+                    self.flips.append(f"{name}: both terminal conditions True")
+            elif len(state) > 1:
+                # prev is still in state, but a second terminal type joined
+                # it — a flip even if a later write scrubs the bogus one
+                self.flips.append(f"{name}: both terminal conditions True")
+            elif state and prev not in state:
+                self.flips.append(
+                    f"{name}: terminal condition flipped {prev} -> {sorted(state)}")
+
+
+# ---------------------------------------------------------------------------
+# preemption storm (kubelet-level faults)
+# ---------------------------------------------------------------------------
+
+
+class PreemptionStorm:
+    """Seeded pod killer speaking the kubelet's (fault-free) connection.
+
+    Each strike picks a Running pod and either deletes it (the node
+    vanished: VM preempted under the pod) or — for ExitCode pods, whose
+    restart decision belongs to the controller — marks it Failed with exit
+    137, the SIGKILL signature of TPU preemption.
+    """
+
+    def __init__(self, clients: ClientSet, seed: int, kills: int = 6,
+                 interval: float = 0.05, prefix: str = ""):
+        self.clients = clients
+        self.rng = random.Random(f"{seed}:storm")
+        self.kills = kills
+        self.interval = interval
+        self.prefix = prefix
+        self.struck: List[Tuple[str, str]] = []  # (pod name, action)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PreemptionStorm":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="preemption-storm")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        remaining = self.kills
+        while remaining > 0 and not self._stop.wait(self.interval):
+            try:
+                pods = self.clients.pods.list()
+            except Exception:
+                continue
+            running = sorted(
+                (p for p in pods
+                 if p.status.phase == "Running"
+                 and p.metadata.name.startswith(self.prefix)),
+                key=lambda p: p.metadata.name,
+            )
+            if not running:
+                continue
+            victim = self.rng.choice(running)
+            try:
+                if victim.spec.restart_policy == "Never":
+                    # ExitCode pod: the kubelet reports the SIGKILLed
+                    # container; the controller decides the restart
+                    victim.status.phase = "Failed"
+                    victim.status.container_statuses = type(victim.status).from_dict(
+                        {"containerStatuses": [{
+                            "name": c.DEFAULT_CONTAINER_NAME,
+                            "state": {"terminated": {"exitCode": 137}},
+                        }]}
+                    ).container_statuses
+                    self.clients.pods.update_status(victim)
+                    self.struck.append((victim.metadata.name, "preempt-137"))
+                else:
+                    # node gone: the pod object disappears outright
+                    self.clients.pods.delete(
+                        victim.metadata.namespace or "default", victim.metadata.name)
+                    self.struck.append((victim.metadata.name, "node-loss"))
+            except (ConflictError, NotFoundError):
+                continue  # raced the kubelet or the controller; next tick
+            remaining -= 1
+
+
+# ---------------------------------------------------------------------------
+# invariants
+# ---------------------------------------------------------------------------
+
+
+def check_invariants(
+    admin: ClientSet,
+    controller,
+    cases: List[JobCase],
+    tracker: StatusTracker,
+    chaos: Optional[FaultInjectingAPIServer] = None,
+) -> List[str]:
+    """Return a list of invariant violations (empty = all hold)."""
+    problems: List[str] = []
+    jobs = {j.metadata.name: j for j in admin.tpujobs.list()}
+    pods = admin.pods.list()
+    services = admin.services.list()
+
+    # 1. at most one pod per (job, rtype, index)
+    seen: Dict[Tuple[str, str, str], str] = {}
+    for p in pods:
+        labels = p.metadata.labels or {}
+        slot = (labels.get(c.LABEL_JOB_NAME, ""),
+                labels.get(c.LABEL_REPLICA_TYPE, ""),
+                labels.get(c.LABEL_REPLICA_INDEX, ""))
+        if slot in seen:
+            problems.append(
+                f"duplicate pod for {slot}: {seen[slot]} and {p.metadata.name}")
+        seen[slot] = p.metadata.name
+
+    # at-least-once accounting overcounts, one per ambiguous occurrence: a
+    # lost update_status response re-folds its deltas; an ambiguous 504 on a
+    # restart's pod delete keeps the count even when the pod survived
+    ambiguous_writes = (
+        chaos.fault_count(FAULT_TIMEOUT_LOST, "update_status")
+        + chaos.fault_count(FAULT_TIMEOUT_LOST, "delete")
+        + chaos.fault_count(FAULT_TIMEOUT_DROPPED, "delete")
+    ) if chaos else 0
+    for case in cases:
+        name = case.job.metadata.name
+        job = jobs.get(name)
+        if case.expect_deleted:
+            if job is not None:
+                problems.append(f"{name}: TTL should have deleted the job")
+            if any(p.metadata.labels.get(c.LABEL_JOB_NAME) == name for p in pods):
+                problems.append(f"{name}: pods survived the TTL-deleted job")
+            if any(s.metadata.labels.get(c.LABEL_JOB_NAME) == name for s in services):
+                problems.append(f"{name}: services survived the TTL-deleted job")
+            continue
+        if job is None:
+            problems.append(f"{name}: job vanished without a TTL")
+            continue
+
+        # 2. restart bound: backoffLimit + in-flight slack (one concurrent
+        # restart per replica, plus the at-least-once overcount a lost
+        # status-write response can introduce per occurrence)
+        limit = job.spec.run_policy.backoff_limit
+        total_replicas = sum(
+            (r.replicas if r.replicas is not None else 1)
+            for r in job.spec.tpu_replica_specs.values())
+        restarts = sum(rs.restarts for rs in job.status.replica_statuses.values())
+        if limit is not None:
+            slack = total_replicas + 2 * ambiguous_writes
+            if restarts > limit + slack:
+                problems.append(
+                    f"{name}: restarts {restarts} > backoffLimit {limit} + slack {slack}")
+
+        # 3. exactly one terminal condition
+        terminal = {cond.type for cond in job.status.conditions
+                    if cond.status == "True"
+                    and cond.type in (c.JOB_SUCCEEDED, c.JOB_FAILED)}
+        if len(terminal) != 1:
+            problems.append(f"{name}: terminal conditions {sorted(terminal)} != exactly 1")
+        elif case.expect_terminal != "any" and case.expect_terminal not in terminal:
+            problems.append(
+                f"{name}: expected terminal {case.expect_terminal}, got {sorted(terminal)}")
+
+        # 5a. cleanPodPolicy All: nothing survives
+        if case.clean_all and terminal:
+            leftovers = [p.metadata.name for p in pods
+                         if p.metadata.labels.get(c.LABEL_JOB_NAME) == name]
+            if leftovers:
+                problems.append(f"{name}: cleanPodPolicy All left pods {leftovers}")
+
+        # 4. expectations satisfied for every replica type
+        for rtype in case.job.spec.tpu_replica_specs:
+            for kind in ("pods", "services"):
+                key = expectation_key(f"default/{name}", rtype, kind)
+                if not controller.expectations.satisfied(key):
+                    problems.append(f"{name}: expectation {key} unsatisfied")
+
+    # 3b. no terminal state ever flipped
+    problems.extend(tracker.flips)
+
+    # 4b. the restart-delta ledger drained
+    if controller._restart_deltas:
+        problems.append(f"restart-delta ledger not drained: {controller._restart_deltas}")
+
+    # 5b. no orphans: every controller-owned pod/service resolves to a live
+    # job with the matching uid
+    job_uids = {j.metadata.uid for j in jobs.values()}
+    for obj in list(pods) + list(services):
+        for ref in obj.metadata.owner_references:
+            if ref.controller and ref.kind == c.KIND and ref.uid not in job_uids:
+                problems.append(
+                    f"orphan {obj.metadata.name}: owner uid {ref.uid} has no live job")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# soak driver
+# ---------------------------------------------------------------------------
+
+# one seeded run's fault mix: every fault kind fires within a few hundred
+# API calls, yet transient enough that retries converge
+SOAK_CHAOS = ChaosConfig(
+    error_rate=0.04,
+    timeout_rate=0.04,
+    conflict_rate=0.03,
+    latency_rate=0.10,
+    max_latency_s=0.002,
+    kill_watch_every=20,
+    compact_every=45,
+    duplicate_event_rate=0.05,
+)
+
+# controller knobs for the soak: healing must be observable within seconds,
+# not the production 12h resync / 20min workqueue ceiling
+SOAK_OPT_OVERRIDES = dict(
+    threadiness=2,
+    resync_period_s=1.0,
+    workqueue_max_backoff_s=0.25,
+    restart_backoff_s=0.05,
+    restart_backoff_max_s=0.4,
+)
+
+
+def run_soak(
+    seed: int,
+    config: Optional[ChaosConfig] = None,
+    cases: Optional[List[JobCase]] = None,
+    storm_kills: int = 6,
+    timeout: float = 60.0,
+    opt_overrides: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """One seeded chaos run: submit the matrix, storm it, converge, assert.
+
+    Returns a report dict; raises AssertionError listing every violated
+    invariant.  The fault schedule is a pure function of ``seed`` — rerun
+    with the same seed to reproduce the same injection schedule.
+    """
+    prefix = f"s{seed}"
+    cases = cases if cases is not None else matrix(prefix)
+    inner = InMemoryAPIServer()
+    chaos = FaultInjectingAPIServer(inner, seed=seed, config=config or SOAK_CHAOS)
+    admin = ClientSet(inner)
+    tracker = StatusTracker()
+    inner.hooks.append(tracker.hook)
+    scripts = [s for case in cases for s in case.scripts]
+    started = time.monotonic()
+
+    with E2ECluster(
+        scripts=scripts,
+        transport=chaos,
+        kubelet_clients=admin,
+        opt_overrides={**SOAK_OPT_OVERRIDES, **(opt_overrides or {})},
+    ) as cluster:
+        controller = cluster.app.controller
+        for case in cases:
+            admin.tpujobs.create(case.job)
+        storm = PreemptionStorm(admin, seed, kills=storm_kills,
+                                prefix=prefix).start()
+
+        def converged() -> bool:
+            jobs = {j.metadata.name: j for j in admin.tpujobs.list()}
+            for case in cases:
+                job = jobs.get(case.job.metadata.name)
+                if case.expect_deleted:
+                    if job is not None:
+                        return False
+                    continue
+                if job is None:
+                    return False
+                if not any(cond.status == "True"
+                           and cond.type in (c.JOB_SUCCEEDED, c.JOB_FAILED)
+                           for cond in job.status.conditions):
+                    return False
+            return True
+
+        deadline = started + timeout
+        while time.monotonic() < deadline and not converged():
+            time.sleep(0.05)
+        storm.stop()
+        if not converged():
+            jobs = {j.metadata.name: j.status.to_dict() for j in admin.tpujobs.list()}
+            raise AssertionError(
+                f"seed {seed}: jobs did not converge within {timeout}s: {jobs}")
+
+        # quiescence: wait for the ledger, cleanup deletes and TTL reaps to
+        # settle (they retry through injected faults), then hold the
+        # invariants for two consecutive observations
+        stable = 0
+        while time.monotonic() < deadline and stable < 2:
+            problems = check_invariants(admin, controller, cases, tracker, chaos)
+            stable = stable + 1 if not problems else 0
+            if stable < 2:
+                # sleep between observations even when clean — back-to-back
+                # checks microseconds apart are one observation, not two, and
+                # would miss an in-flight cleanup landing moments later
+                time.sleep(0.1)
+        problems = check_invariants(admin, controller, cases, tracker, chaos)
+        if problems:
+            raise AssertionError(
+                f"seed {seed}: invariants violated:\n  " + "\n  ".join(problems))
+
+        report = {
+            "seed": seed,
+            "jobs": len(cases),
+            "duration_s": round(time.monotonic() - started, 3),
+            "api_faults": len(chaos.injected),
+            "faults_by_kind": {
+                kind: chaos.fault_count(kind)
+                for kind in sorted({k for _, _, _, k in chaos.injected})
+            },
+            "storm_strikes": storm.struck,
+            "invariants": "ok",
+        }
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description="one seeded chaos soak run")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--storm-kills", type=int, default=6)
+    parser.add_argument("--timeout", type=float, default=60.0)
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    if not args.verbose:
+        import logging
+
+        logging.disable(logging.CRITICAL)
+    report = run_soak(args.seed, storm_kills=args.storm_kills, timeout=args.timeout)
+    print(json.dumps(report, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

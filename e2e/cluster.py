@@ -25,16 +25,24 @@ class E2ECluster:
         run_seconds: float = 0.05,
         transport=None,
         kubelet_clients=None,
+        opt_overrides=None,
     ):
         """``transport`` swaps the operator's API-server transport (e.g. a
         ``KubeApiTransport`` against the K8s-REST shim); ``kubelet_clients``
         lets the simulated kubelet talk to the cluster store directly, the
-        way a real kubelet bypasses the operator's client path."""
+        way a real kubelet bypasses the operator's client path;
+        ``opt_overrides`` sets additional ``ServerOption`` fields (the chaos
+        soak tightens workqueue/restart backoffs so healing is observable
+        within a short run)."""
         opt = ServerOption(
             monitoring_port=0,
             enable_leader_election=leader_election,
             lease_duration_s=1.0, renew_deadline_s=0.4, retry_period_s=0.1,
         )
+        for k, v in (opt_overrides or {}).items():
+            if not hasattr(opt, k):
+                raise TypeError(f"unknown ServerOption override {k!r}")
+            setattr(opt, k, v)
         self.app = OperatorApp(opt, transport=transport)
         self.sdk = TPUJobClient(self.app.transport)
         self.kubelet = KubeletSim(kubelet_clients or self.app.clients,

@@ -1,0 +1,507 @@
+"""Chaos harness: deterministic fault schedules, injection semantics,
+restart-backoff churn bounds, and the invariant soak smoke."""
+import time
+
+import pytest
+
+from e2e.chaos import (
+    SOAK_CHAOS,
+    JobCase,
+    StatusTracker,
+    check_invariants,
+    matrix,
+    run_soak,
+)
+from jobtestutil import Harness, new_tpujob
+from tpujob.api import constants as c
+from tpujob.controller.job_base import ControllerConfig
+from tpujob.kube.chaos import (
+    FAULT_COMPACT,
+    FAULT_CONFLICT,
+    FAULT_DUPLICATE_EVENT,
+    FAULT_ERROR,
+    FAULT_KILL_WATCH,
+    FAULT_TIMEOUT_DROPPED,
+    FAULT_TIMEOUT_LOST,
+    MUTATING_VERBS,
+    ChaosConfig,
+    FaultInjectingAPIServer,
+    FaultSchedule,
+)
+from tpujob.kube.client import ClientSet
+from tpujob.kube.errors import ApiError, ConflictError, GoneError, ServerTimeoutError
+from tpujob.kube.memserver import ADDED, InMemoryAPIServer
+
+
+def _pod(name, ns="default"):
+    return {"metadata": {"name": name, "namespace": ns}, "spec": {}}
+
+
+# ---------------------------------------------------------------------------
+# schedule determinism
+# ---------------------------------------------------------------------------
+
+
+def test_fault_schedule_same_seed_reproduces_byte_for_byte():
+    cfg = ChaosConfig(kill_watch_every=5, compact_every=7, duplicate_event_rate=0.2)
+    verbs = MUTATING_VERBS + ("get", "list")
+    a = FaultSchedule(42, cfg).describe(verbs, 300)
+    b = FaultSchedule(42, cfg).describe(verbs, 300)
+    assert a == b
+    assert FaultSchedule(43, cfg).describe(verbs, 300) != a
+    # schedules are call-indexed, not time- or thread-ordered: asking out of
+    # order answers identically
+    s = FaultSchedule(42, cfg)
+    later = [s.decision("create", n) for n in (5, 1, 3)]
+    assert later == [s.decision("create", n) for n in (5, 1, 3)]
+
+
+def test_fault_schedule_covers_every_kind():
+    cfg = ChaosConfig(error_rate=0.1, timeout_rate=0.1, conflict_rate=0.1,
+                      kill_watch_every=3, compact_every=5, duplicate_event_rate=0.3)
+    s = FaultSchedule(7, cfg)
+    kinds = {s.decision("create", n).kind for n in range(400)}
+    assert {FAULT_ERROR, FAULT_TIMEOUT_LOST, FAULT_TIMEOUT_DROPPED,
+            FAULT_CONFLICT, None} <= kinds
+    stream = {k for n in range(1, 40) for k in s.stream_faults(n)}
+    assert {FAULT_KILL_WATCH, FAULT_COMPACT, FAULT_DUPLICATE_EVENT} <= stream
+    # reads are never failed, only slowed
+    assert {s.decision("list", n).kind for n in range(400)} == {None}
+
+
+# ---------------------------------------------------------------------------
+# injection semantics
+# ---------------------------------------------------------------------------
+
+
+def test_injected_500_is_not_executed():
+    chaos = FaultInjectingAPIServer(seed=1, config=ChaosConfig(
+        error_rate=1.0, timeout_rate=0, conflict_rate=0, latency_rate=0))
+    with pytest.raises(ApiError):
+        chaos.create("pods", _pod("a"))
+    assert chaos.inner.list("pods") == []
+    assert chaos.fault_count(FAULT_ERROR, "create") == 1
+
+
+def test_injected_conflict_is_not_executed():
+    chaos = FaultInjectingAPIServer(seed=1, config=ChaosConfig(
+        error_rate=0, timeout_rate=0, conflict_rate=1.0, latency_rate=0))
+    with pytest.raises(ConflictError):
+        chaos.create("pods", _pod("a"))
+    assert chaos.inner.list("pods") == []
+
+
+def test_timeout_lost_executes_dropped_does_not():
+    cfg = ChaosConfig(error_rate=0, timeout_rate=1.0, conflict_rate=0, latency_rate=0)
+    chaos = FaultInjectingAPIServer(seed=5, config=cfg)
+    schedule = FaultSchedule(5, cfg)
+    lost = dropped = 0
+    for n in range(20):
+        kind = schedule.decision("create", n).kind
+        with pytest.raises(ServerTimeoutError):
+            chaos.create("pods", _pod(f"p{n}"))
+        exists = any(
+            o["metadata"]["name"] == f"p{n}" for o in chaos.inner.list("pods"))
+        if kind == FAULT_TIMEOUT_LOST:
+            assert exists, "lost-response timeout must execute server-side"
+            lost += 1
+        else:
+            assert kind == FAULT_TIMEOUT_DROPPED
+            assert not exists, "dropped timeout must not execute"
+            dropped += 1
+    assert lost and dropped
+    assert chaos.fault_count(FAULT_TIMEOUT_LOST) == lost
+    assert chaos.fault_count(FAULT_TIMEOUT_DROPPED) == dropped
+
+
+def test_real_server_errors_pass_through_untouched():
+    chaos = FaultInjectingAPIServer(seed=1, config=ChaosConfig(
+        error_rate=0, timeout_rate=0, conflict_rate=0, latency_rate=0))
+    chaos.create("pods", _pod("a"))
+    from tpujob.kube.errors import AlreadyExistsError, NotFoundError
+
+    with pytest.raises(AlreadyExistsError):
+        chaos.create("pods", _pod("a"))
+    with pytest.raises(NotFoundError):
+        chaos.delete("pods", "default", "nope")
+    assert chaos.injected == []
+
+
+def test_stream_faults_kill_compact_duplicate():
+    # every committed mutation kills a watch
+    chaos = FaultInjectingAPIServer(seed=2, config=ChaosConfig(
+        error_rate=0, timeout_rate=0, conflict_rate=0, latency_rate=0,
+        kill_watch_every=1))
+    w = chaos.watch("pods")
+    chaos.create("pods", _pod("a"))
+    assert w.closed
+    assert chaos.fault_count(FAULT_KILL_WATCH) == 1
+
+    # every committed mutation compacts history: resume -> 410 Gone
+    chaos = FaultInjectingAPIServer(seed=2, config=ChaosConfig(
+        error_rate=0, timeout_rate=0, conflict_rate=0, latency_rate=0,
+        compact_every=1))
+    chaos.create("pods", _pod("a"))
+    chaos.create("pods", _pod("b"))
+    with pytest.raises(GoneError):
+        chaos.watch("pods", resource_version="1")
+
+    # duplicate events are replayed to subscribers
+    chaos = FaultInjectingAPIServer(seed=2, config=ChaosConfig(
+        error_rate=0, timeout_rate=0, conflict_rate=0, latency_rate=0,
+        duplicate_event_rate=1.0))
+    w = chaos.watch("pods")
+    chaos.create("pods", _pod("a"))
+    first, second = w.poll(), w.poll()
+    assert first and second
+    assert first.type == second.type == ADDED
+    assert first.object["metadata"]["name"] == second.object["metadata"]["name"] == "a"
+
+
+def test_fault_metrics_and_exposition():
+    from tpujob.server import metrics
+
+    before = metrics.api_faults_injected.value
+    chaos = FaultInjectingAPIServer(seed=1, config=ChaosConfig(
+        error_rate=1.0, timeout_rate=0, conflict_rate=0, latency_rate=0))
+    with pytest.raises(ApiError):
+        chaos.create("pods", _pod("a"))
+    assert metrics.api_faults_injected.value == before + 1
+    text = metrics.REGISTRY.expose()
+    for series in ("tpujob_operator_api_faults_injected_total",
+                   "tpujob_operator_watch_reconnects_total",
+                   "tpujob_operator_relists_total"):
+        assert series in text
+
+
+# ---------------------------------------------------------------------------
+# restart backoff: crash-loop churn is bounded, transient failures are prompt
+# ---------------------------------------------------------------------------
+
+
+def _count_creates(server: InMemoryAPIServer):
+    created = []
+    server.hooks.append(
+        lambda ev, res, obj: created.append(obj["metadata"]["name"])
+        if ev == ADDED and res == "pods" else None)
+    return created
+
+
+def _churn(backoff_base: float, duration: float = 0.9) -> int:
+    """Run a persistently crash-looping ExitCode replica for ``duration``
+    and return how many pod incarnations the controller launched."""
+    h = Harness(config=ControllerConfig(
+        restart_backoff_seconds=backoff_base, restart_backoff_max_seconds=2.0))
+    created = _count_creates(h.server)
+    h.submit(new_tpujob(master=None, workers=1,
+                        restart_policy=c.RESTART_POLICY_EXIT_CODE,
+                        backoff_limit=10_000))
+    end = time.monotonic() + duration
+    while time.monotonic() < end:
+        h.sync(rounds=1)
+        try:
+            h.set_pod_phase("test-job", c.REPLICA_TYPE_WORKER, 0, "Failed",
+                            exit_code=137)
+        except Exception:
+            pass  # pod between incarnations; next sync recreates it
+        time.sleep(0.005)
+    return len(created)
+
+
+def test_restart_backoff_bounds_crash_loop_churn():
+    unbounded = _churn(backoff_base=0.0)
+    bounded = _churn(backoff_base=0.15)
+    # 0 + 0.15 + 0.3 + 0.6 ... of enforced idleness caps the bounded run at
+    # a handful of incarnations while instant recreate churns per-sync
+    assert bounded < unbounded / 2, (bounded, unbounded)
+    assert bounded <= 8, bounded
+
+
+def test_restart_backoff_first_failure_restarts_promptly():
+    h = Harness(config=ControllerConfig(
+        restart_backoff_seconds=30.0, restart_backoff_max_seconds=60.0))
+    h.submit(new_tpujob(master=None, workers=1,
+                        restart_policy=c.RESTART_POLICY_EXIT_CODE,
+                        backoff_limit=10))
+    h.sync()
+    h.set_pod_phase("test-job", c.REPLICA_TYPE_WORKER, 0, "Failed", exit_code=137)
+    h.sync()  # no waiting: the first strike carries no delay
+    pods = h.clients.pods.list()
+    assert len(pods) == 1 and pods[0].status.phase != "Failed"
+    assert h.get_job().status.replica_statuses[c.REPLICA_TYPE_WORKER].restarts == 1
+
+
+def test_restart_backoff_gates_second_failure():
+    h = Harness(config=ControllerConfig(
+        restart_backoff_seconds=30.0, restart_backoff_max_seconds=60.0))
+    h.submit(new_tpujob(master=None, workers=1,
+                        restart_policy=c.RESTART_POLICY_EXIT_CODE,
+                        backoff_limit=10))
+    h.sync()
+    for _ in range(2):
+        h.set_pod_phase("test-job", c.REPLICA_TYPE_WORKER, 0, "Failed", exit_code=137)
+        h.sync()
+    # second strike: 30 s of backoff — the replacement must NOT exist yet
+    assert h.clients.pods.list() == []
+    key = ("default/test-job", c.REPLICA_TYPE_WORKER, 0)
+    strikes, _, not_before = h.controller._restart_backoff[key]
+    assert strikes == 2 and not_before > time.monotonic() + 25
+
+
+def test_restart_backoff_escalates_across_realistic_crash_cycles():
+    """A crash cycle of several seconds (schedule + start + crash) must NOT
+    decay the strike count — only a healthy run past the fixed threshold
+    (2x the cap + base) resets the damper."""
+    h = Harness(config=ControllerConfig(
+        restart_backoff_seconds=1.0, restart_backoff_max_seconds=300.0))
+    ctl = h.controller
+    slot = ("default/test-job", c.REPLICA_TYPE_WORKER, 0)
+    ctl._note_restart(*slot)
+    # pretend the replica crashed again 30 s later — a realistic cycle, far
+    # beyond any early strike's (tiny) delay but far under the decay window
+    strikes, last, not_before = ctl._restart_backoff[slot]
+    ctl._restart_backoff[slot] = (strikes, last - 30.0, not_before - 30.0)
+    ctl._note_restart(*slot)
+    strikes, _, not_before = ctl._restart_backoff[slot]
+    assert strikes == 2  # escalated, not reset
+    assert not_before > time.monotonic() + 0.5  # 1 s base delay armed
+    # a healthy run past the fixed threshold (2*300 + 1 s) decays to clean
+    strikes, last, not_before = ctl._restart_backoff[slot]
+    ctl._restart_backoff[slot] = (strikes, last - 700.0, not_before - 700.0)
+    ctl._note_restart(*slot)
+    assert ctl._restart_backoff[slot][0] == 1  # fresh first strike, no delay
+
+
+def test_restart_backoff_exponent_capped_no_overflow():
+    """A job with no backoffLimit can accumulate unbounded strikes; the
+    exponential must saturate at the cap instead of overflowing floats."""
+    h = Harness(config=ControllerConfig(
+        restart_backoff_seconds=1.0, restart_backoff_max_seconds=60.0))
+    ctl = h.controller
+    slot = ("default/test-job", c.REPLICA_TYPE_WORKER, 0)
+    for _ in range(1200):  # > 1026 would OverflowError without the cap
+        ctl._note_restart(*slot)
+    strikes, _, not_before = ctl._restart_backoff[slot]
+    assert strikes == 1200
+    assert not_before - time.monotonic() <= 60.0 + 0.1  # saturated at cap
+
+
+def test_status_tracker_flags_second_terminal_joining_the_first():
+    """A write that adds Failed=True while Succeeded stays True is a flip
+    even though the previously recorded type is still present."""
+    tracker = StatusTracker()
+    from tpujob.kube.client import RESOURCE_TPUJOBS
+
+    def status(*types):
+        return {"metadata": {"name": "j"}, "status": {"conditions": [
+            {"type": t, "status": "True"} for t in types]}}
+
+    tracker.hook("MODIFIED", RESOURCE_TPUJOBS, status(c.JOB_SUCCEEDED))
+    assert tracker.flips == []
+    tracker.hook("MODIFIED", RESOURCE_TPUJOBS,
+                 status(c.JOB_SUCCEEDED, c.JOB_FAILED))
+    assert any("both terminal" in f for f in tracker.flips)
+
+
+def test_restart_backoff_disabled_recreates_instantly():
+    h = Harness(config=ControllerConfig(restart_backoff_seconds=0.0))
+    h.submit(new_tpujob(master=None, workers=1,
+                        restart_policy=c.RESTART_POLICY_EXIT_CODE,
+                        backoff_limit=10))
+    h.sync()
+    for _ in range(3):
+        h.set_pod_phase("test-job", c.REPLICA_TYPE_WORKER, 0, "Failed", exit_code=137)
+        h.sync()
+        assert len(h.clients.pods.list()) == 1  # instant replacement every time
+    assert h.controller._restart_backoff == {}
+
+
+# ---------------------------------------------------------------------------
+# status-timestamp hardening
+# ---------------------------------------------------------------------------
+
+
+def test_corrupted_status_timestamps_do_not_crash_sync():
+    h = Harness()
+    h.submit(new_tpujob(workers=1, active_deadline=3600, ttl=10))
+    h.sync()
+    job = h.get_job()
+    job.status.start_time = "garbage-timestamp"
+    job.status.completion_time = "also-garbage"
+    h.clients.tpujobs.update_status(job)
+    h.sync()  # must neither raise nor fail the job on a bogus deadline
+    job = h.get_job()
+    assert not any(cond.type == c.JOB_FAILED and cond.status == "True"
+                   for cond in job.status.conditions)
+
+
+# ---------------------------------------------------------------------------
+# invariant checker can actually fire
+# ---------------------------------------------------------------------------
+
+
+def test_check_invariants_flags_violations():
+    server = InMemoryAPIServer()
+    admin = ClientSet(server)
+    h = Harness()  # unrelated controller: empty ledger/expectations
+    case = JobCase(job=new_tpujob(name="cj", workers=1), expect_terminal="Succeeded")
+    admin.tpujobs.create(case.job)
+    labels = {c.LABEL_JOB_NAME: "cj", c.LABEL_REPLICA_TYPE: "worker",
+              c.LABEL_REPLICA_INDEX: "0"}
+    for name in ("cj-worker-0", "cj-worker-0-dup"):
+        server.create("pods", {"metadata": {"name": name, "namespace": "default",
+                                            "labels": dict(labels)}})
+    problems = check_invariants(admin, h.controller, [case], StatusTracker())
+    assert any("duplicate pod" in p for p in problems)
+    assert any("!= exactly 1" in p for p in problems)  # no terminal condition
+
+
+# ---------------------------------------------------------------------------
+# the soak itself
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_smoke_soak_converges_with_invariants():
+    """Tier-1 smoke: the full 5-job matrix under one seeded schedule —
+    API faults, watch kills, compaction, duplicates, preemption storm —
+    converges with every invariant intact in a few seconds."""
+    report = run_soak(seed=11, storm_kills=4, timeout=45.0)
+    assert report["invariants"] == "ok"
+    assert report["jobs"] == len(matrix("s11")) == 5
+    assert report["api_faults"] > 0
+
+
+@pytest.mark.slow
+def test_chaos_soak_many_seeds():
+    """The long randomized soak (make soak shape): >= 20 jobs across >= 5
+    seeded schedules."""
+    total = 0
+    for seed in range(21, 26):
+        report = run_soak(seed, storm_kills=6, timeout=60.0)
+        assert report["invariants"] == "ok"
+        total += report["jobs"]
+    assert total >= 20
+
+
+def test_soak_chaos_config_exercises_all_fault_classes():
+    # the default soak schedule must actually contain every fault class the
+    # acceptance criteria name (API faults + watch kills + compaction)
+    assert SOAK_CHAOS.kill_watch_every and SOAK_CHAOS.compact_every
+    assert SOAK_CHAOS.error_rate and SOAK_CHAOS.timeout_rate
+    assert SOAK_CHAOS.duplicate_event_rate
+
+
+# ---------------------------------------------------------------------------
+# review regressions: ambiguous 504 on restart delete, TTL vs corrupt
+# timestamp, resume-point monotonicity
+# ---------------------------------------------------------------------------
+
+
+def test_restart_delete_lost_response_keeps_count_and_backoff():
+    """A 504 whose delete actually executed must still count the restart
+    (and arm the damper) — rolling back would leave a crash loop uncounted
+    and undamped every time the transport drops a delete response."""
+    h = Harness(config=ControllerConfig(restart_backoff_seconds=30.0))
+    h.submit(new_tpujob(master=None, workers=1,
+                        restart_policy=c.RESTART_POLICY_EXIT_CODE,
+                        backoff_limit=10))
+    h.sync()
+    h.set_pod_phase("test-job", c.REPLICA_TYPE_WORKER, 0, "Failed", exit_code=137)
+
+    real_delete = h.controller.pod_control.delete_pod
+
+    def lost_response_delete(ns, name, job):
+        real_delete(ns, name, job)  # executes server-side...
+        raise ServerTimeoutError("chaos: response lost")  # ...response lost
+
+    h.controller.pod_control.delete_pod = lost_response_delete
+    h.sync(rounds=1)
+    h.controller.pod_control.delete_pod = real_delete
+    job = h.get_job()
+    assert job.status.replica_statuses[c.REPLICA_TYPE_WORKER].restarts == 1
+    # the damper saw the strike and expectations aren't left dangling
+    assert ("default/test-job", c.REPLICA_TYPE_WORKER, 0) in h.controller._restart_backoff
+    from tpujob.controller.job_base import expectation_key
+
+    assert h.controller.expectations.satisfied(
+        expectation_key("default/test-job", c.REPLICA_TYPE_WORKER, "pods"))
+
+
+def test_restart_delete_dropped_timeout_retries_next_sync():
+    """A 504 whose delete did NOT execute keeps the count (at-least-once)
+    and clears the expectation, so the retry sync re-deletes the surviving
+    pod instead of gating on a DELETED event that will never come."""
+    h = Harness(config=ControllerConfig(restart_backoff_seconds=0.0))
+    h.submit(new_tpujob(master=None, workers=1,
+                        restart_policy=c.RESTART_POLICY_EXIT_CODE,
+                        backoff_limit=10))
+    h.sync()
+    h.set_pod_phase("test-job", c.REPLICA_TYPE_WORKER, 0, "Failed", exit_code=137)
+
+    real_delete = h.controller.pod_control.delete_pod
+
+    def dropped_delete(ns, name, job):
+        raise ServerTimeoutError("chaos: request dropped")
+
+    h.controller.pod_control.delete_pod = dropped_delete
+    h.sync(rounds=1)
+    assert len(h.clients.pods.list()) == 1  # pod survived the dropped delete
+    h.controller.pod_control.delete_pod = real_delete
+    h.sync()  # retry sync re-deletes and recreates
+    pods = h.clients.pods.list()
+    assert len(pods) == 1 and pods[0].status.phase != "Failed"
+    # overcount bounded to the one ambiguous occurrence (1 real + 1 retried)
+    assert h.get_job().status.replica_statuses[c.REPLICA_TYPE_WORKER].restarts == 2
+
+
+def test_ttl_reaps_job_with_corrupted_completion_time():
+    """An unparseable completion_time must not re-anchor the TTL clock on
+    every sync (never reaping): the clock falls back to the server-set
+    creationTimestamp, so collection stays guaranteed and bounded without
+    reaping a long TTL early on one bad status write."""
+    h = Harness()
+    job = new_tpujob(master=None, workers=1, ttl=3600)
+    # backdated creation: once completion_time is corrupted, the
+    # creation-anchored TTL has long expired and the job must be reaped
+    job.metadata.creation_timestamp = "2000-01-01T00:00:00Z"
+    h.submit(job)
+    h.sync()
+    h.set_pod_phase("test-job", c.REPLICA_TYPE_WORKER, 0, "Succeeded", exit_code=0)
+    h.sync()
+    job = h.get_job()
+    assert any(cond.type == c.JOB_SUCCEEDED and cond.status == "True"
+               for cond in job.status.conditions)
+    # valid completion_time: the 1h TTL is measured from completion, so the
+    # old creationTimestamp alone must NOT reap the job
+    assert h.get_job() is not None
+    job.status.completion_time = "corrupted"
+    h.clients.tpujobs.update_status(job)
+    h.sync()
+    from tpujob.kube.errors import NotFoundError
+
+    with pytest.raises(NotFoundError):
+        h.clients.tpujobs.get("default", "test-job")
+
+
+def test_informer_resume_point_survives_duplicate_events():
+    """A replayed old event must not move the informer's resume point
+    backwards — the next reconnect would re-replay the whole gap or 410
+    into a needless relist."""
+    from tpujob.kube.informers import InformerFactory
+
+    server = InMemoryAPIServer()
+    informer = InformerFactory(server).informer("pods")
+    informer.sync_once()
+    for i in range(5):
+        server.create("pods", _pod(f"p{i}"))
+    informer.sync_once()
+    latest = informer._last_rv
+    server.replay_last(1)  # duplicate of p4's ADDED: rv unchanged, fine
+    # replay an OLD event by hand: p0's ADDED carries a stale rv
+    w = informer._watch
+    old = server.get("pods", "default", "p0")
+    from tpujob.kube.memserver import WatchEvent
+
+    w._put(WatchEvent(ADDED, "pods", old))
+    informer.sync_once()
+    assert int(informer._last_rv) >= int(latest)

@@ -160,3 +160,142 @@ def test_owner_reference_generation():
     assert ref.kind == "TPUJob"
     assert ref.uid == "u-123"
     assert ref.controller is True and ref.block_owner_deletion is True
+
+
+# ---------------------------------------------------------------------------
+# informer failure paths (chaos PR): reconnect, relist, resync healing
+# ---------------------------------------------------------------------------
+
+
+def _podd(name, ns="default"):
+    return {"metadata": {"name": name, "namespace": ns}, "spec": {}}
+
+
+def test_informer_resumes_after_stream_kill():
+    """A watch death without compaction costs a resumed stream, not a
+    relist: events during the gap are replayed from history."""
+    from tpujob.server import metrics
+
+    server = InMemoryAPIServer()
+    informer = InformerFactory(server).informer(RESOURCE_PODS)
+    informer.sync_once()
+    server.create("pods", _podd("a"))
+    informer.sync_once()
+    relists0, reconnects0 = metrics.relists.value, metrics.watch_reconnects.value
+    assert server.kill_watch(0)
+    server.create("pods", _podd("b"))  # happens while the stream is dead
+    informer._reconnect()
+    informer.sync_once()
+    assert informer.store.get("default", "b") is not None
+    assert metrics.watch_reconnects.value == reconnects0 + 1
+    assert metrics.relists.value == relists0  # resumed, no relist needed
+
+
+def test_informer_relists_after_compaction():
+    """Reconnect whose resume point was compacted away: 410 Gone forces the
+    full LIST+reconcile path and the cache still heals."""
+    from tpujob.server import metrics
+
+    server = InMemoryAPIServer()
+    informer = InformerFactory(server).informer(RESOURCE_PODS)
+    informer.sync_once()
+    server.create("pods", _podd("a"))
+    informer.sync_once()
+    assert server.kill_watch(0)
+    server.create("pods", _podd("b"))
+    server.compact()  # the gap's events are gone from history: resume -> 410
+    relists0 = metrics.relists.value
+    informer._reconnect()
+    informer.sync_once()
+    assert metrics.relists.value == relists0 + 1
+    assert informer.store.get("default", "a") is not None
+    assert informer.store.get("default", "b") is not None  # healed via LIST
+
+
+def test_relist_and_resync_all_heal_dropped_watch_event():
+    """End-to-end healing: a DELETED pod event is deliberately dropped (the
+    cache goes stale and the controller stops reconciling the job), then a
+    stream death forces a relist and resync_all re-enqueues the job — the
+    missing replica is recreated."""
+    import sys
+
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from jobtestutil import Harness, new_tpujob
+
+    h = Harness()
+    h.submit(new_tpujob(workers=1))
+    h.sync()
+    assert len(h.clients.pods.list()) == 2  # master + worker
+
+    h.clients.pods.delete("default", "test-job-worker-0")
+    # steal every queued pod event before the informer can handle it —
+    # the watch stream "lost" the DELETED event
+    while h.controller.pod_informer._watch.poll() is not None:
+        pass
+    assert h.controller.pod_informer.store.get("default", "test-job-worker-0") is not None
+    h.controller.sync_handler("default/test-job")
+    assert len(h.clients.pods.list()) == 1  # stale cache: nothing recreated
+
+    # stream death -> relist reconciles the stale cache against the server
+    h.controller.pod_informer._watch.stop()
+    h.controller.pod_informer.sync_once()
+    assert h.controller.pod_informer.store.get("default", "test-job-worker-0") is None
+
+    # the periodic resync replays every cached job through the workqueue
+    assert h.controller.resync_all() == 1
+    h.sync()
+    assert sorted(p.metadata.name for p in h.clients.pods.list()) == [
+        "test-job-master-0", "test-job-worker-0"]
+
+
+def test_establish_list_failure_keeps_stream_closed_and_retries():
+    """If the LIST inside _establish fails after the new watch opened, the
+    aborted stream must be stopped (not left live over a stale cache) so the
+    run loop keeps retrying the full establish."""
+    import pytest
+
+    from tpujob.kube.errors import ApiError
+
+    server = InMemoryAPIServer()
+    informer = InformerFactory(server).informer(RESOURCE_PODS)
+    informer.sync_once()
+    server.create("pods", _podd("a"))
+    informer.sync_once()
+    assert server.kill_watch(0)
+    server.create("pods", _podd("gap"))
+    server.compact()  # dead resume point: the reconnect must take relist
+
+    real_list = server.list
+    calls = {"n": 0}
+
+    def flaky_list(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ApiError("apiserver flake")
+        return real_list(*args, **kwargs)
+
+    server.list = flaky_list
+    with pytest.raises(ApiError):
+        informer._reconnect()
+    assert getattr(informer._watch, "closed", False)  # still retryable
+    assert server.active_watch_count() == 0  # the aborted stream was stopped
+    informer._reconnect()  # the retry heals
+    informer.sync_once()
+    assert informer.store.get("default", "gap") is not None
+
+
+def test_resume_replay_overflow_degrades_to_relist():
+    """A resume whose gap replay overflows the stream's bounded queue hands
+    back an already-closed watch; the informer must degrade to a relist
+    instead of busy-looping on resume forever."""
+    server = InMemoryAPIServer(watch_queue_size=3)
+    informer = InformerFactory(server).informer(RESOURCE_PODS)
+    informer.sync_once()
+    assert server.kill_watch(0)
+    for i in range(6):  # gap larger than the stream queue
+        server.create("pods", _podd(f"g{i}"))
+    informer._reconnect()  # resume replay overflows -> relist
+    assert not getattr(informer._watch, "closed", False)
+    informer.sync_once()
+    for i in range(6):
+        assert informer.store.get("default", f"g{i}") is not None

@@ -211,7 +211,9 @@ def test_preemption_churn_counted_and_bounded():
     Recreated pods come back with restartCount 0, so the reference's
     in-place counting (controller.go:520-556) never fires on this loop —
     it would churn forever, invisibly."""
-    h = Harness()
+    # backoff damper off: this test drives back-to-back preemptions through
+    # synchronous syncs (the damper's pacing is covered in test_chaos.py)
+    h = Harness(config=ControllerConfig(restart_backoff_seconds=0.0))
     h.submit(new_tpujob(restart_policy="ExitCode", backoff_limit=3))
     h.sync()
     h.set_all_phases("test-job", "Running")

@@ -3,7 +3,12 @@ import threading
 
 import pytest
 
-from tpujob.kube.errors import AlreadyExistsError, ConflictError, NotFoundError
+from tpujob.kube.errors import (
+    AlreadyExistsError,
+    ConflictError,
+    GoneError,
+    NotFoundError,
+)
 from tpujob.kube.memserver import ADDED, DELETED, MODIFIED, InMemoryAPIServer
 
 
@@ -205,3 +210,71 @@ def test_concurrent_writers():
     assert len(s.list("pods")) == 400
     rvs = [int(p["metadata"]["resourceVersion"]) for p in s.list("pods")]
     assert len(set(rvs)) == 400  # rv strictly monotonic/unique
+
+
+def test_slow_watcher_overflow_drops_stream_not_server():
+    """A subscriber that stops draining must not block _broadcast (and with
+    it every API call): on queue overflow the stream is terminated, like a
+    real apiserver dropping a slow watch connection."""
+    s = InMemoryAPIServer(watch_queue_size=5)
+    slow = s.watch("pods")
+    healthy = s.watch("pods")
+    names = []
+    for i in range(10):  # would deadlock before the overflow fix
+        s.create("pods", pod(f"p{i}"))
+        names.append(healthy.poll().object["metadata"]["name"])  # keeps draining
+    assert slow.closed
+    assert not healthy.closed
+    assert s.active_watch_count() == 1  # the slow stream was dropped
+    # the healthy subscriber missed nothing... and the dropped stream's
+    # iterator terminates instead of hanging
+    assert names == [f"p{i}" for i in range(10)]
+    drained = list(slow)
+    assert len(drained) <= 5
+
+
+def test_overflowed_stop_does_not_raise():
+    s = InMemoryAPIServer(watch_queue_size=2)
+    w = s.watch("pods")
+    for i in range(4):
+        s.create("pods", pod(f"p{i}"))
+    w.stop()  # queue full: the sentinel can't be queued; closed flag suffices
+    drained = list(w)  # terminates via the closed flag, no hang
+    assert w.closed
+    # exactly the two events that fit before the overflow drop
+    assert [e.object["metadata"]["name"] for e in drained] == ["p0", "p1"]
+
+
+def test_kill_watch_and_replay_last():
+    s = InMemoryAPIServer()
+    w = s.watch("pods")
+    assert s.kill_watch(0)
+    assert w.closed
+    assert not s.kill_watch(0)  # nothing left to kill
+    w2 = s.watch("pods")
+    s.create("pods", pod("a"))
+    assert s.replay_last(1) == 1
+    first, dup = w2.poll(), w2.poll()
+    assert first.object["metadata"]["name"] == dup.object["metadata"]["name"] == "a"
+
+
+def test_compact_forces_gone_on_resume():
+    s = InMemoryAPIServer()
+    s.create("pods", pod("a"))
+    rv = s._rv
+    s.create("pods", pod("b"))
+    s.compact()
+    with pytest.raises(GoneError):
+        s.watch("pods", resource_version=str(rv))
+
+
+def test_overflow_during_initial_replay_not_registered():
+    """A watch whose resume/initial replay overflows its queue is handed
+    back terminated and must NOT be registered for live events — it could
+    never be removed and would linger as a dead subscriber."""
+    s = InMemoryAPIServer(watch_queue_size=2)
+    for i in range(5):
+        s.create("pods", pod(f"p{i}"))
+    w = s.watch("pods", resource_version="0")  # 5 synthetic ADDED > queue 2
+    assert w.closed
+    assert s.active_watch_count() == 0

@@ -87,6 +87,14 @@ class ControllerConfig:
     expectations_ttl: float = 300.0
     backoff_base_delay: float = 0.005
     backoff_max_delay: float = 1200.0
+    # decaying delay between a counted ExitCode restart and the replacement
+    # pod's creation: 0 on the first failure (a transient blip restarts
+    # promptly), then restart_backoff_seconds * 2^(n-2) capped at the max —
+    # a crash-looping container churns pods at this pace instead of at full
+    # controller speed until backoffLimit.  <= 0 disables (instant
+    # recreate, the pre-backoff behavior).
+    restart_backoff_seconds: float = 1.0
+    restart_backoff_max_seconds: float = 300.0
     namespace: Optional[str] = None  # None = all namespaces
     extra: Dict[str, Any] = field(default_factory=dict)
 

@@ -32,10 +32,10 @@ from tpujob.controller.joblogger import (
     logger_for_replica,
     logger_for_unstructured,
 )
-from tpujob.controller.job_base import JobController, expectation_key
+from tpujob.controller.job_base import JobController, _DedupWarner, expectation_key
 from tpujob.kube.client import RESOURCE_TPUJOBS
 from tpujob.kube.control import gen_general_name, gen_labels, gen_pod_group_name
-from tpujob.kube.errors import ConflictError, NotFoundError
+from tpujob.kube.errors import ConflictError, NotFoundError, ServerTimeoutError
 from tpujob.kube.objects import (
     Container,
     ObjectMeta,
@@ -53,10 +53,23 @@ from tpujob.server import metrics
 log = logging.getLogger("tpujob.reconciler")
 
 
+_time_warner = _DedupWarner(interval=60.0)
+
+
 def _parse_time(ts: Optional[str]) -> Optional[float]:
+    """Parse a status timestamp, treating garbage as unset: one corrupted
+    ``start_time``/``completion_time`` write must degrade the affected
+    feature (deadline/TTL), not turn every subsequent sync of the job into
+    a permanent ValueError crash-loop."""
     if not ts:
         return None
-    return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+    try:
+        return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+    except ValueError:
+        _time_warner.warning(
+            log, ("unparseable-timestamp", ts),
+            "unparseable status timestamp %r; treating as unset", ts)
+        return None
 
 
 def get_port_from_job(job: TPUJob, rtype: str) -> int:
@@ -96,6 +109,12 @@ class TPUJobController(JobController):
         # across worker threads: the workqueue never runs one key twice
         # concurrently, and keys don't share entries.
         self._restart_deltas: Dict[str, Dict[str, int]] = {}
+        # per-(job key, rtype, replica index) crash-loop damper: (strikes,
+        # last strike monotonic, not-before monotonic).  Keyed per index so
+        # one crash-looping replica never delays a healthy sibling's
+        # replacement.  Written only by the worker holding the job's
+        # workqueue key (same safety argument as _restart_deltas above).
+        self._restart_backoff: Dict[Tuple[str, str, int], Tuple[int, float, float]] = {}
 
     # ------------------------------------------------------------------
     # job event handlers (job.go:35-149)
@@ -137,6 +156,14 @@ class TPUJobController(JobController):
         for rtype in (c.REPLICA_TYPE_MASTER, c.REPLICA_TYPE_WORKER):
             self.expectations.delete(expectation_key(key, rtype, "pods"))
             self.expectations.delete(expectation_key(key, rtype, "services"))
+        # pop in place (like _restart_deltas above) rather than rebinding a
+        # rebuilt dict: a rebind would silently drop a concurrent worker
+        # thread's _note_restart write for an unrelated job.  The snapshot
+        # is list(dict) — a single C-level op that cannot interleave with a
+        # worker's insert the way per-item comprehension iteration can.
+        for k in list(self._restart_backoff):
+            if k[0] == key:
+                self._restart_backoff.pop(k, None)
 
     def _fail_malformed(self, obj: Dict, errs: List[str]) -> None:
         meta = obj.get("metadata") or {}
@@ -339,6 +366,18 @@ class TPUJobController(JobController):
                                 # registered it (it would otherwise gate
                                 # syncs until the TTL)
                                 self.expectations.observe_del(ekey)
+                            except ServerTimeoutError:
+                                # ambiguous 504: the delete may or may not
+                                # have executed (lost response).  Rolling
+                                # back would make an executed delete an
+                                # UNCOUNTED, undamped restart — so keep the
+                                # count, at-least-once style: if the pod
+                                # survives, the retry sync re-deletes it and
+                                # overcounts by one occurrence; if it is
+                                # gone, the count is exactly right.  Clear
+                                # our expectation either way (no DELETED
+                                # event is guaranteed to arrive).
+                                self.expectations.observe_del(ekey)
                             except Exception:
                                 # the restart did not happen: roll back the
                                 # count and the expectation so the retry
@@ -352,15 +391,64 @@ class TPUJobController(JobController):
                             # the delete is what destroys the evidence pod
                             deltas = self._restart_deltas.setdefault(job.key, {})
                             deltas[rtype] = deltas.get(rtype, 0) + 1
+                            self._note_restart(job.key, rtype, index)
                     # fall through: the failure still counts this sync, so the
                     # status machine emits Restarting (reference pod.go:91-109
                     # deletes async and the pod is still counted)
             st.update_replica_statuses(job.status, rtype, pod)
         if missing:
-            # all missing replicas of this type launch concurrently (a v4-32
-            # job's 8 hosts cost ~1 API round trip, not 8 sequential ones)
-            self._create_pods_batch(job, rtype, rspec, missing)
+            waits = {i: self._restart_backoff_remaining(job.key, rtype, i)
+                     for i in missing}
+            delayed = [i for i in missing if waits[i] > 0]
+            ready = [i for i in missing if waits[i] <= 0]
+            if delayed:
+                # crash-loop damper: only the striking replica waits out its
+                # decayed exponential delay instead of relaunching at full
+                # controller speed until backoffLimit; healthy siblings (the
+                # `ready` set) are untouched
+                logger_for_replica(log, job, rtype).info(
+                    "restart backoff: delaying replacement pod(s) %s for %.2fs",
+                    delayed, min(waits[i] for i in delayed))
+                self.queue.add_after(job.key, min(waits[i] for i in delayed))
+            if ready:
+                # all unthrottled missing replicas of this type launch
+                # concurrently (a v4-32 job's 8 hosts cost ~1 API round
+                # trip, not 8 sequential ones)
+                self._create_pods_batch(job, rtype, rspec, ready)
         return restarting
+
+    def _note_restart(self, key: str, rtype: str, index: int) -> None:
+        """Record a counted ExitCode restart in the crash-loop damper.
+
+        First strike carries no delay (a single transient failure restarts
+        promptly); each further strike doubles the wait, capped at the max.
+        A replica that ran healthy well past its previous window decays back
+        to a clean slate (the kubelet's CrashLoopBackOff resets the same
+        way after a long enough run)."""
+        base = self.config.restart_backoff_seconds
+        if base <= 0:
+            return
+        max_delay = self.config.restart_backoff_max_seconds
+        now = time.monotonic()
+        strikes, last, _ = self._restart_backoff.get(
+            (key, rtype, index), (0, 0.0, 0.0))
+        # the healthy-run threshold is fixed (~2x the backoff cap; ~10 min at
+        # the defaults, the kubelet's CrashLoopBackOff reset), NOT a multiple
+        # of the previous strike's tiny delay — early strikes carry 0-delay
+        # windows that any real crash cycle (schedule + start + crash) would
+        # outlast, and the damper would never escalate
+        if strikes and now - last > 2 * max_delay + base:
+            strikes = 0
+        strikes += 1
+        delay = 0.0 if strikes == 1 else min(
+            base * (2 ** min(strikes - 2, 30)), max_delay)
+        self._restart_backoff[(key, rtype, index)] = (strikes, now, now + delay)
+
+    def _restart_backoff_remaining(self, key: str, rtype: str, index: int) -> float:
+        entry = self._restart_backoff.get((key, rtype, index))
+        if entry is None:
+            return 0.0
+        return max(0.0, entry[2] - time.monotonic())
 
     def _create_pods_batch(self, job: TPUJob, rtype: str, rspec, indices: List[int]) -> None:
         """Slow-start parallel create with reference expectation bookkeeping
@@ -654,7 +742,22 @@ class TPUJobController(JobController):
         ttl = job.spec.run_policy.ttl_seconds_after_finished
         if ttl is None:
             return
-        finish = _parse_time(job.status.completion_time) or time.time()
+        finish = _parse_time(job.status.completion_time)
+        if finish is None:
+            if job.status.completion_time:
+                # CORRUPTED completion_time: it can never be measured
+                # against the TTL, but re-anchoring to the current time on
+                # every sync would requeue every ttl seconds forever and
+                # never collect the job.  Anchor at the server-set
+                # creationTimestamp instead — collection stays guaranteed
+                # and bounded without reaping a long TTL early on one bad
+                # write.  If even that is garbage, the object is junk: reap.
+                finish = _parse_time(job.metadata.creation_timestamp)
+                if finish is None:
+                    finish = float("-inf")
+            else:
+                # no timestamp landed yet: anchor at first observation
+                finish = time.time()
         remaining = finish + ttl - time.time()
         if remaining <= 0:
             try:

@@ -1,0 +1,296 @@
+"""Deterministic fault injection over the in-memory API server.
+
+``FaultInjectingAPIServer`` wraps an ``InMemoryAPIServer`` behind the same
+``ApiServer`` surface and injects the failure modes that dominate large TPU
+pods — transient 500s, lost-response timeouts, spurious 409 conflicts, added
+latency, watch-stream kills, etcd history compaction, and duplicate watch
+events — from a **seeded, deterministic schedule**.
+
+Determinism contract: the fault decision for the *n*-th call of each verb is
+a pure function of ``(seed, verb, n)`` (string-seeded ``random.Random``,
+which hashes with SHA-512 and so is stable across processes and
+PYTHONHASHSEED values).  Thread interleavings may change which *object* a
+fault lands on, but never the schedule itself — the same seed reproduces the
+same per-verb decision sequence byte for byte (``FaultSchedule.describe``).
+
+The chaos E2E harness (``e2e/chaos.py``) builds on this; unit tests use it
+directly to force specific error paths without monkeypatching.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tpujob.kube.errors import ApiError, ConflictError, ServerTimeoutError
+from tpujob.kube.memserver import InMemoryAPIServer
+from tpujob.server import metrics
+
+# Fault kinds, in the order a decision's rng draws are consumed (fixed order
+# is part of the determinism contract — never reorder, only append).
+FAULT_ERROR = "error"  # 500 before execution: request never reached etcd
+FAULT_TIMEOUT_LOST = "timeout-lost"  # executed, response lost (504 after)
+FAULT_TIMEOUT_DROPPED = "timeout-dropped"  # 504 before execution
+FAULT_CONFLICT = "conflict"  # spurious 409 (e.g. a racing writer won)
+FAULT_KILL_WATCH = "kill-watch"
+FAULT_COMPACT = "compact"
+FAULT_DUPLICATE_EVENT = "duplicate-event"
+
+MUTATING_VERBS = ("create", "update", "update_status", "patch", "delete")
+
+
+@dataclass
+class ChaosConfig:
+    """Fault rates and cadences (all probabilities per call, in [0, 1]).
+
+    Defaults are a moderate storm of per-call API faults — frequent enough
+    that a few hundred calls hit every error kind, sparse enough that the
+    controller's retry machinery converges.  Stream-level faults (watch
+    kills, compaction, duplicate events) default OFF; enable them
+    explicitly (see ``SOAK_CHAOS`` in ``e2e/chaos.py`` for a mix that
+    exercises everything).
+    """
+
+    error_rate: float = 0.05  # 500 on mutating verbs, not executed
+    timeout_rate: float = 0.05  # 504; half executed-then-lost, half dropped
+    conflict_rate: float = 0.03  # spurious 409 on mutating verbs
+    latency_rate: float = 0.10  # added latency on mutating verbs
+    max_latency_s: float = 0.005
+    # stream-level faults keyed to the global mutation counter: every N
+    # committed mutations (0 disables)
+    kill_watch_every: int = 0
+    compact_every: int = 0
+    duplicate_event_rate: float = 0.0  # replay the newest event per mutation
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One verb call's fate.  ``latency_s`` applies before any outcome."""
+
+    kind: Optional[str] = None  # None = no fault
+    latency_s: float = 0.0
+
+
+class FaultSchedule:
+    """Pure ``(seed, verb, n) -> Decision`` schedule.
+
+    Stateless: two instances with equal seed and config agree on every
+    decision, regardless of when or from which thread they are asked.
+    """
+
+    def __init__(self, seed: int, config: Optional[ChaosConfig] = None):
+        self.seed = seed
+        self.config = config or ChaosConfig()
+
+    def decision(self, verb: str, n: int) -> Decision:
+        cfg = self.config
+        rng = random.Random(f"{self.seed}:{verb}:{n}")
+        # fixed draw order (see module docstring)
+        r_fault = rng.random()
+        r_latency = rng.random()
+        r_latency_amount = rng.random()
+        latency = (
+            r_latency_amount * cfg.max_latency_s
+            if r_latency < cfg.latency_rate
+            else 0.0
+        )
+        if verb not in MUTATING_VERBS:
+            return Decision(None, latency)
+        threshold = 0.0
+        for kind, rate in (
+            (FAULT_ERROR, cfg.error_rate),
+            (FAULT_TIMEOUT_LOST, cfg.timeout_rate / 2.0),
+            (FAULT_TIMEOUT_DROPPED, cfg.timeout_rate / 2.0),
+            (FAULT_CONFLICT, cfg.conflict_rate),
+        ):
+            threshold += rate
+            if r_fault < threshold:
+                return Decision(kind, latency)
+        return Decision(None, latency)
+
+    def stream_faults(self, mutation_n: int) -> List[str]:
+        """Stream-level faults to apply after the mutation_n-th committed
+        mutation (1-based), in application order."""
+        cfg = self.config
+        out: List[str] = []
+        if cfg.kill_watch_every and mutation_n % cfg.kill_watch_every == 0:
+            out.append(FAULT_KILL_WATCH)
+        if cfg.compact_every and mutation_n % cfg.compact_every == 0:
+            out.append(FAULT_COMPACT)
+        if cfg.duplicate_event_rate:
+            rng = random.Random(f"{self.seed}:dup:{mutation_n}")
+            if rng.random() < cfg.duplicate_event_rate:
+                out.append(FAULT_DUPLICATE_EVENT)
+        return out
+
+    def describe(self, verbs: Tuple[str, ...], n_calls: int) -> str:
+        """Canonical text rendering of the first ``n_calls`` decisions per
+        verb plus stream faults — the byte-for-byte reproducibility witness
+        the soak acceptance check compares across schedule instances."""
+        lines: List[str] = []
+        for verb in verbs:
+            for n in range(n_calls):
+                d = self.decision(verb, n)
+                lines.append(f"{verb}#{n}: kind={d.kind} latency={d.latency_s:.6f}")
+        for n in range(1, n_calls + 1):
+            faults = self.stream_faults(n)
+            if faults:
+                lines.append(f"mutation#{n}: {','.join(faults)}")
+        return "\n".join(lines)
+
+
+class FaultInjectingAPIServer:
+    """``InMemoryAPIServer`` facade that injects scheduled faults.
+
+    Same surface as the wrapped server (the controller, clients and
+    informers are transport-agnostic), so it drops into ``OperatorApp``
+    via the ``transport=`` seam.  Reads (get/list/watch) only suffer
+    latency; every mutating verb can be failed before or after execution.
+    Kubelet-style actors should talk to ``self.inner`` directly — a node
+    agent has its own connection, not the operator's flaky one.
+    """
+
+    def __init__(
+        self,
+        inner: Optional[InMemoryAPIServer] = None,
+        seed: int = 0,
+        config: Optional[ChaosConfig] = None,
+    ):
+        self.inner = inner if inner is not None else InMemoryAPIServer()
+        self.schedule = FaultSchedule(seed, config)
+        self._lock = threading.Lock()
+        self._verb_counts: Dict[str, int] = {}
+        self._mutations = 0
+        # (global fault index, verb, call index, kind) — the injected-fault
+        # log a soak report surfaces next to the invariant results
+        self.injected: List[Tuple[int, str, int, str]] = []
+
+    # -- delegated surface ---------------------------------------------------
+
+    @property
+    def supports_resume(self) -> bool:
+        return getattr(self.inner, "supports_resume", False)
+
+    @property
+    def hooks(self) -> List[Callable[[str, str, Dict[str, Any]], None]]:
+        return self.inner.hooks
+
+    def append_pod_logs(self, namespace: str, name: str, text: str) -> None:
+        self.inner.append_pod_logs(namespace, name, text)
+
+    def pod_logs(self, namespace: str, name: str, follow: bool = False) -> str:
+        return self.inner.pod_logs(namespace, name, follow)
+
+    def compact(self) -> None:
+        self.inner.compact()
+
+    # -- fault plumbing ------------------------------------------------------
+
+    def _next(self, verb: str) -> int:
+        with self._lock:
+            n = self._verb_counts.get(verb, 0)
+            self._verb_counts[verb] = n + 1
+            return n
+
+    def _record(self, verb: str, n: int, kind: str) -> None:
+        metrics.api_faults_injected.inc()
+        with self._lock:
+            self.injected.append((len(self.injected), verb, n, kind))
+
+    def fault_count(self, kind: Optional[str] = None, verb: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(
+                1
+                for _, v, _, k in self.injected
+                if (kind is None or k == kind) and (verb is None or v == verb)
+            )
+
+    def _apply_stream_faults(self) -> None:
+        with self._lock:
+            self._mutations += 1
+            n = self._mutations
+        for kind in self.schedule.stream_faults(n):
+            if kind == FAULT_KILL_WATCH:
+                rng = random.Random(f"{self.schedule.seed}:victim:{n}")
+                if self.inner.kill_watch(rng.randrange(1 << 16)):
+                    self._record("watch", n, FAULT_KILL_WATCH)
+            elif kind == FAULT_COMPACT:
+                self.inner.compact()
+                self._record("history", n, FAULT_COMPACT)
+            elif kind == FAULT_DUPLICATE_EVENT:
+                if self.inner.replay_last(1):
+                    self._record("watch", n, FAULT_DUPLICATE_EVENT)
+
+    def _mutate(self, verb: str, fn: Callable[[], Any]) -> Any:
+        n = self._next(verb)
+        d = self.schedule.decision(verb, n)
+        if d.latency_s:
+            time.sleep(d.latency_s)
+        if d.kind == FAULT_ERROR:
+            self._record(verb, n, d.kind)
+            raise ApiError(f"chaos: injected 500 on {verb} (call {n})")
+        if d.kind == FAULT_TIMEOUT_DROPPED:
+            self._record(verb, n, d.kind)
+            raise ServerTimeoutError(f"chaos: injected 504 on {verb} (call {n}, dropped)")
+        if d.kind == FAULT_CONFLICT:
+            self._record(verb, n, d.kind)
+            raise ConflictError(f"chaos: injected 409 on {verb} (call {n})")
+        result = fn()  # real server errors (404/409/...) propagate untouched
+        self._apply_stream_faults()
+        if d.kind == FAULT_TIMEOUT_LOST:
+            # the op executed server-side; only the response is lost — the
+            # caller must be idempotent against both outcomes
+            self._record(verb, n, d.kind)
+            raise ServerTimeoutError(f"chaos: injected 504 on {verb} (call {n}, executed)")
+        return result
+
+    def _read(self, verb: str, fn: Callable[[], Any]) -> Any:
+        n = self._next(verb)
+        d = self.schedule.decision(verb, n)
+        if d.latency_s:
+            time.sleep(d.latency_s)
+        return fn()
+
+    # -- ApiServer surface ---------------------------------------------------
+
+    def create(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self._mutate("create", lambda: self.inner.create(resource, obj))
+
+    def get(self, resource: str, namespace: str, name: str) -> Dict[str, Any]:
+        return self._read("get", lambda: self.inner.get(resource, namespace, name))
+
+    def list(
+        self,
+        resource: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Dict[str, Any]]:
+        return self._read(
+            "list", lambda: self.inner.list(resource, namespace, label_selector)
+        )
+
+    def update(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self._mutate("update", lambda: self.inner.update(resource, obj))
+
+    def update_status(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self._mutate(
+            "update_status", lambda: self.inner.update_status(resource, obj)
+        )
+
+    def patch(
+        self, resource: str, namespace: str, name: str, patch: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        return self._mutate(
+            "patch", lambda: self.inner.patch(resource, namespace, name, patch)
+        )
+
+    def delete(self, resource: str, namespace: str, name: str) -> None:
+        return self._mutate("delete", lambda: self.inner.delete(resource, namespace, name))
+
+    def watch(self, *args, **kwargs):
+        # watch opens are never faulted directly (a dead stream is injected
+        # via kill_watch, which exercises the same reconnect path without
+        # racing the informers' unguarded first _establish)
+        return self.inner.watch(*args, **kwargs)

@@ -45,6 +45,39 @@ class GoneError(ApiError):
     reason = "Expired"
 
 
+class ServerTimeoutError(ApiError):
+    """The apiserver timed out serving the request (504).  The request may
+    or may not have executed server-side — the classic lost-response fault
+    the chaos harness injects; callers must be idempotent against both."""
+
+    code = 504
+    reason = "Timeout"
+
+
+def error_for_status(status: int, reason: str, message: str) -> ApiError:
+    """Map a K8s Status reason / HTTP code to the matching ApiError subclass.
+
+    The single source of truth for both REST transports (httpclient and
+    kubetransport): a class missing from this table silently degrades into a
+    generic ApiError, breaking every caller that branches on the subtype
+    (e.g. the 504 restart accounting)."""
+    if reason == "NotFound" or status == 404:
+        return NotFoundError(message)
+    if reason == "AlreadyExists":
+        return AlreadyExistsError(message)
+    if reason == "Conflict" or status == 409:
+        return ConflictError(message)
+    if reason == "Invalid" or status == 422:
+        return InvalidError(message)
+    if reason in ("Expired", "Gone") or status == 410:
+        return GoneError(message)
+    if reason == "Timeout" or status == 504:
+        # ambiguous: the request may have executed server-side before the
+        # response was lost — callers branch on this (restart accounting)
+        return ServerTimeoutError(message)
+    return ApiError(message or f"HTTP {status}")
+
+
 def is_not_found(e: Exception) -> bool:
     return isinstance(e, NotFoundError)
 

@@ -19,30 +19,15 @@ from typing import Any, Dict, Iterator, List, Optional
 log = logging.getLogger("tpujob.httpclient")
 
 from tpujob.kube.errors import (
-    AlreadyExistsError,
     ApiError,
-    ConflictError,
-    GoneError,
     InvalidError,
-    NotFoundError,
+    error_for_status,
 )
 from tpujob.kube.memserver import WatchEvent
 
 
 def _raise_for(status: int, payload: Dict[str, Any]) -> None:
-    reason = payload.get("reason", "")
-    message = payload.get("message", "")
-    if reason == "NotFound" or status == 404:
-        raise NotFoundError(message)
-    if reason == "AlreadyExists":
-        raise AlreadyExistsError(message)
-    if reason == "Conflict" or status == 409:
-        raise ConflictError(message)
-    if reason == "Invalid" or status == 422:
-        raise InvalidError(message)
-    if reason in ("Expired", "Gone") or status == 410:
-        raise GoneError(message)
-    raise ApiError(message or f"HTTP {status}")
+    raise error_for_status(status, payload.get("reason", ""), payload.get("message", ""))
 
 
 class HTTPWatch:

@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from tpujob.api import constants as c
 from tpujob.kube.errors import GoneError
 from tpujob.kube.memserver import ADDED, DELETED, MODIFIED, InMemoryAPIServer
+from tpujob.server import metrics
 
 log = logging.getLogger("tpujob.informers")
 
@@ -191,11 +192,24 @@ class SharedInformer:
     def _establish(self) -> None:
         """Open the watch, then LIST (watch-first so no events are lost) and
         reconcile the local cache against the fresh list."""
-        self._watch = self.server.watch(self.resource, namespace=self.namespace)
+        watch = self.server.watch(self.resource, namespace=self.namespace)
         # the stream's opening RV is a valid resume point even before any
         # event is handled (the initial state arrives via LIST, not events)
-        self._last_rv = getattr(self._watch, "last_rv", None)
-        initial = self.server.list(self.resource, namespace=self.namespace)
+        opening_rv = getattr(watch, "last_rv", None)
+        try:
+            initial = self.server.list(self.resource, namespace=self.namespace)
+        except Exception:
+            # a live watch over an unreconciled stale cache is worse than no
+            # watch: the run loop only retries while the stream reads closed,
+            # so stop the new stream and keep the old (dead) one in place
+            watch.stop()
+            raise
+        self._watch = watch
+        self._last_rv = opening_rv
+        # counted only once the watch+LIST both succeeded: a flaky transport
+        # retrying every 0.5s must not inflate the relist ratio with
+        # attempts that never healed anything
+        metrics.relists.inc()
         known = {Store._key(o) for o in initial}
         for stale in [o for o in self.store.list() if Store._key(o) not in known]:
             self.store.remove(stale)
@@ -224,16 +238,29 @@ class SharedInformer:
             or not getattr(self.server, "supports_resume", False)
         ):
             self._establish()
-            return
-        try:
-            self._watch = self.server.watch(
-                self.resource, namespace=self.namespace,
-                resource_version=self._last_rv,
-            )
-        except GoneError:
-            log.info("informer %s: resume point %s expired; relisting",
-                     self.resource, self._last_rv)
-            self._establish()
+        else:
+            try:
+                resumed = self.server.watch(
+                    self.resource, namespace=self.namespace,
+                    resource_version=self._last_rv,
+                )
+            except GoneError:
+                log.info("informer %s: resume point %s expired; relisting",
+                         self.resource, self._last_rv)
+                self._establish()
+            else:
+                if getattr(resumed, "closed", False):
+                    # the replay overflowed the stream's queue before it went
+                    # live: resuming from the same point again would busy-loop
+                    # forever — degrade to a relist like a 410
+                    log.info("informer %s: resume replay overflowed; relisting",
+                             self.resource)
+                    self._establish()
+                else:
+                    self._watch = resumed
+        # a stream counts as re-established only after the resume (or the
+        # relist it degraded to) actually succeeded
+        metrics.watch_reconnects.inc()
 
     def run(self, stop_event: threading.Event) -> None:
         """Start the watch loop in a background thread (client-go Run)."""
@@ -250,7 +277,14 @@ class SharedInformer:
                 ev = self._watch.poll(timeout=0.05)
                 if ev is None:
                     continue
-                self._handle(ev.type, ev.object)
+                try:
+                    self._handle(ev.type, ev.object)
+                except Exception:
+                    # a throwing handler (e.g. a transient API error inside
+                    # an event callback) must not kill the watch loop — the
+                    # stream would silently die and the cache go permanently
+                    # stale.  Skip the event; resync/relist heals the drift.
+                    log.exception("informer %s: event handler failed", self.resource)
 
         self._thread = threading.Thread(target=loop, daemon=True, name=f"informer-{self.resource}")
         self._thread.start()
@@ -284,7 +318,15 @@ class SharedInformer:
     def _handle(self, ev_type: str, obj: Dict[str, Any]) -> None:
         rv = (obj.get("metadata") or {}).get("resourceVersion")
         if rv:
-            self._last_rv = str(rv)
+            # never move the resume point backwards: a duplicate/replayed
+            # event carrying an old RV would otherwise re-replay the whole
+            # gap (or 410 into a full relist) on the next reconnect
+            try:
+                newer = self._last_rv is None or int(rv) > int(self._last_rv)
+            except (TypeError, ValueError):
+                newer = True  # opaque non-numeric RVs: keep last-seen semantics
+            if newer:
+                self._last_rv = str(rv)
         if ev_type == ADDED:
             old = self.store.get(*Store._key(obj))
             self.store.upsert(obj)

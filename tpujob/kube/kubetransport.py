@@ -35,12 +35,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from tpujob.api import constants as c
 from tpujob.kube.errors import (
-    AlreadyExistsError,
     ApiError,
-    ConflictError,
-    GoneError,
     InvalidError,
-    NotFoundError,
+    error_for_status,
 )
 from tpujob.kube.memserver import WatchEvent
 
@@ -197,17 +194,7 @@ def _status_error(status: int, body: bytes) -> ApiError:
         message = payload.get("message") or ""
     except ValueError:
         message = body.decode(errors="replace")[:500]
-    if reason == "NotFound" or status == 404:
-        return NotFoundError(message)
-    if reason == "AlreadyExists":
-        return AlreadyExistsError(message)
-    if reason == "Conflict" or status == 409:
-        return ConflictError(message)
-    if reason == "Invalid" or status == 422:
-        return InvalidError(message)
-    if reason in ("Expired", "Gone") or status == 410:
-        return GoneError(message)
-    return ApiError(message or f"HTTP {status}")
+    return error_for_status(status, reason, message)
 
 
 class _RestWatch:

@@ -77,21 +77,41 @@ class Watch:
         self.opening_rv: Optional[str] = None
 
     def _put(self, ev: WatchEvent) -> None:
-        if not self._stopped:
-            rv = ((ev.object.get("metadata") or {}).get("resourceVersion"))
-            if rv:
-                self.last_rv = str(rv)
-            self._q.put(ev)
+        if self._stopped:
+            return
+        try:
+            self._q.put_nowait(ev)
+        except queue.Full:
+            # Slow watcher: a subscriber that stopped draining must not
+            # block _broadcast (and with it every other API call) on a
+            # blocking put while the server lock is held.  Real apiservers
+            # terminate slow watch streams; do the same — the informer's
+            # reconnect/relist path heals the gap.
+            self._stopped = True
+            self.closed = True
+            self._server._remove_watch(self)
+            return
+        rv = ((ev.object.get("metadata") or {}).get("resourceVersion"))
+        if rv:
+            self.last_rv = str(rv)
 
     def stop(self) -> None:
         self._stopped = True
         self.closed = True
-        self._q.put(None)
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass  # iterators exit via the closed flag once drained
         self._server._remove_watch(self)
 
     def __iter__(self) -> Iterator[WatchEvent]:
         while True:
-            ev = self._q.get()
+            try:
+                ev = self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self.closed:
+                    return  # stream terminated (stopped or overflow-dropped)
+                continue
             if ev is None:
                 return
             yield ev
@@ -110,8 +130,10 @@ class InMemoryAPIServer:
     # resume instead of relisting); see KubeApiTransport.supports_resume
     supports_resume = True
 
-    def __init__(self, enable_gc: bool = True, history_size: int = 4096):
+    def __init__(self, enable_gc: bool = True, history_size: int = 4096,
+                 watch_queue_size: int = 10000):
         self._lock = threading.RLock()
+        self._watch_queue_size = watch_queue_size
         self._stores: Dict[str, _Store] = {}
         # (resource | None=all, namespace | None=all, watch)
         self._watches: List[Tuple[Optional[str], Optional[str], Watch]] = []
@@ -167,6 +189,44 @@ class InMemoryAPIServer:
     def _remove_watch(self, watch: Watch) -> None:
         with self._lock:
             self._watches = [t for t in self._watches if t[2] is not watch]
+
+    def compact(self) -> None:
+        """Drop the buffered event history, like etcd compacting revisions:
+        any subsequent resume-from-resourceVersion older than the current RV
+        gets 410 Gone and must relist.  The chaos harness calls this to force
+        the informers' GoneError → relist path."""
+        with self._lock:
+            self._history.clear()
+
+    def active_watch_count(self) -> int:
+        with self._lock:
+            return len(self._watches)
+
+    def kill_watch(self, index: int) -> bool:
+        """Abruptly terminate the index-th active watch stream (mod the
+        count), like an apiserver dropping a long-lived connection.  The
+        subscriber sees a closed stream and must reconnect (resume) or
+        relist.  Returns False when no stream is active."""
+        with self._lock:
+            if not self._watches:
+                return False
+            _, _, w = self._watches[index % len(self._watches)]
+        w.stop()
+        return True
+
+    def replay_last(self, count: int = 1) -> int:
+        """Re-deliver the newest ``count`` buffered events to every matching
+        watch — duplicate watch events, the at-least-once delivery real watch
+        streams exhibit across reconnects.  Subscribers must treat replays as
+        idempotent updates.  Returns the number of events replayed."""
+        with self._lock:
+            replayed = 0
+            for _, res, ns, ev in list(self._history)[-count:]:
+                for wres, wns, w in list(self._watches):
+                    if (wres is None or wres == res) and (wns is None or wns == ns):
+                        w._put(WatchEvent(ev.type, ev.resource, copy.deepcopy(ev.object)))
+                replayed += 1
+            return replayed
 
     # -- CRUD ---------------------------------------------------------------
 
@@ -320,7 +380,7 @@ class InMemoryAPIServer:
                 # K8s semantics: RV "0" = "any version" — serve the current
                 # state as synthetic ADDED events, then live
                 resource_version, send_initial = None, True
-            w = Watch(self)
+            w = Watch(self, maxsize=self._watch_queue_size)
             # the stream's opening RV: the point the subscriber is synced to
             # BEFORE any replay — the only safe resume point to advertise
             # (last_rv advances as replayed events are queued, but queued
@@ -366,7 +426,11 @@ class InMemoryAPIServer:
                     for (ns, _), obj in self._store(res).objects.items():
                         if namespace is None or ns == namespace:
                             w._put(WatchEvent(ADDED, res, copy.deepcopy(obj)))
-            self._watches.append((resource, namespace, w))
+            if not w.closed:
+                # a replay bigger than the queue overflowed the stream
+                # before it ever went live: hand the (terminated) watch back
+                # without registering it, or it would linger unremovable
+                self._watches.append((resource, namespace, w))
             return w
 
 

@@ -90,6 +90,10 @@ class OperatorApp:
                 gang_scheduler_name=opt.gang_scheduler_name,
                 init_container_image=opt.init_container_image,
                 namespace=opt.namespace or None,
+                restart_backoff_seconds=opt.restart_backoff_s,
+                restart_backoff_max_seconds=opt.restart_backoff_max_s,
+                backoff_base_delay=opt.workqueue_base_backoff_s,
+                backoff_max_delay=opt.workqueue_max_backoff_s,
             ),
         )
         self.monitoring: Optional[MonitoringServer] = None

@@ -194,3 +194,22 @@ pods_created = Counter(
     "Counts pods created by the operator's pod control",
     REGISTRY,
 )
+
+# Fault-visibility series (this PR's chaos/robustness work): how often the
+# transport hurt us and how often the informers had to heal themselves.
+api_faults_injected = Counter(
+    "tpujob_operator_api_faults_injected_total",
+    "API faults injected by the chaos harness (0 outside chaos runs)",
+    REGISTRY,
+)
+watch_reconnects = Counter(
+    "tpujob_operator_watch_reconnects_total",
+    "Watch streams re-established after a stream death",
+    REGISTRY,
+)
+relists = Counter(
+    "tpujob_operator_relists_total",
+    "Full LIST+reconcile operations (initial informer sync and 410-Gone "
+    "forced relists)",
+    REGISTRY,
+)

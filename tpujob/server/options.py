@@ -35,6 +35,13 @@ class ServerOption:
     retry_period_s: float = 3.0
     qps: float = 50.0
     burst: int = 100
+    # crash-loop damper: decaying delay between a counted ExitCode restart
+    # and the replacement pod's creation (<= 0 = instant recreate)
+    restart_backoff_s: float = 1.0
+    restart_backoff_max_s: float = 300.0
+    # workqueue per-key failure backoff (client-go rate limiter bounds)
+    workqueue_base_backoff_s: float = 0.005
+    workqueue_max_backoff_s: float = 1200.0
 
 
 class _LazyVersionAction(argparse.Action):
@@ -78,6 +85,18 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--retry-period", type=float, default=3.0, dest="retry_period_s")
     parser.add_argument("--kube-api-qps", type=float, default=50.0, dest="qps")
     parser.add_argument("--kube-api-burst", type=int, default=100, dest="burst")
+    parser.add_argument("--restart-backoff", type=float, default=1.0,
+                        dest="restart_backoff_s",
+                        help="base delay between a counted ExitCode restart and "
+                             "the replacement pod (exponential, decaying; <=0 "
+                             "recreates instantly)")
+    parser.add_argument("--restart-backoff-max", type=float, default=300.0,
+                        dest="restart_backoff_max_s",
+                        help="cap on the exponential restart backoff delay")
+    parser.add_argument("--workqueue-base-backoff", type=float, default=0.005,
+                        dest="workqueue_base_backoff_s")
+    parser.add_argument("--workqueue-max-backoff", type=float, default=1200.0,
+                        dest="workqueue_max_backoff_s")
 
 
 def parse_options(argv: Optional[List[str]] = None) -> ServerOption:
