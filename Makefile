@@ -11,7 +11,7 @@ SHELL := /bin/bash
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci ci-fast native lint codegen-verify unit unit-fast test e2e soak bench-smoke bench-controller dryrun images clean
+.PHONY: ci ci-fast native lint codegen-verify unit unit-fast test trace-smoke e2e soak bench-smoke bench-controller dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
@@ -35,9 +35,14 @@ lint:
 unit:
 	$(PY) -m pytest tests/ -q
 
+# flight-recorder smoke (~1 s): one traced 1-job sync must yield a
+# well-formed timeline + span trees over the real /debug HTTP surface
+trace-smoke:
+	$(PY) scripts/trace_smoke.py
+
 # the tier-1 command from ROADMAP.md, verbatim (modulo $$-escaping for
 # make), so local and CI invocations agree on what "the tests pass" means
-test:
+test: trace-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # the operator/controller/kube/api tests only — the model-path suites
@@ -75,6 +80,7 @@ bench-controller:
 	$(PY) bench_controller.py --jobs 10 --workers 4
 	$(PY) bench_controller.py --jobs 10 --workers 4 --mode scan --serial
 	$(PY) bench_controller.py --jobs 50 --workers 8
+	$(PY) bench_controller.py --jobs 50 --workers 8 --no-trace
 	$(PY) bench_controller.py --jobs 50 --workers 8 --mode scan --serial
 
 images:

@@ -13,9 +13,16 @@ Modes (for before/after comparison on the same machine):
     --mode indexed   indexed informer-cache claim path (default)
     --mode scan      the pre-indexer full-store scan per sync
     --serial         replica creates issued one at a time (pre-batching)
+    --no-trace       disable per-sync tracing (the pre-flight-recorder
+                     hot path; compare against the default traced run to
+                     measure tracing overhead)
 
 ``--create-latency`` models the apiserver round trip one create costs
 (default 2 ms).  Both modes pay it; slow-start batching overlaps it.
+
+With tracing on, the run also asserts trace completeness: every completed
+sync yielded exactly one CLOSED root span carrying a queue-latency child,
+and every pod-creating sync carries API-call child spans.
 """
 from __future__ import annotations
 
@@ -36,6 +43,7 @@ from tpujob.kube.client import RESOURCE_PODS, RESOURCE_SERVICES, RESOURCE_TPUJOB
 from tpujob.kube.control import gen_labels
 from tpujob.kube.memserver import ADDED, InMemoryAPIServer
 from tpujob.kube.objects import Pod, Service
+from tpujob.obs.trace import TRACER
 
 
 class LatencyServer(InMemoryAPIServer):
@@ -152,9 +160,44 @@ def _percentile(sorted_values: List[float], q: float) -> float:
     return sorted_values[idx]
 
 
+def _check_trace_completeness(ctrl, syncs: int,
+                              started: int, closed: int) -> Dict:
+    """Assert the tentpole's trace invariant on a finished run: every sync
+    produced exactly one closed root span; stored traces carry the
+    queue-latency child and (when the sync created pods) API-call children.
+    """
+    if started != syncs or closed != syncs:
+        raise AssertionError(
+            f"trace completeness: {syncs} syncs but {started} root spans "
+            f"started / {closed} closed")
+    traces = [rec["spans"] for rec in ctrl.flight.traces()]
+    roots_per_trace = [
+        sum(1 for s in spans if s["parent_id"] is None) for spans in traces]
+    if any(n != 1 for n in roots_per_trace):
+        raise AssertionError("trace completeness: a trace without exactly "
+                             "one root span")
+    open_spans = [s for spans in traces for s in spans
+                  if s["duration_ms"] is None]
+    if open_spans:
+        raise AssertionError(f"trace completeness: unclosed spans {open_spans}")
+    with_queue_wait = sum(
+        1 for spans in traces
+        if any(s["name"] == "queue_wait" for s in spans))
+    if with_queue_wait != len(traces):
+        raise AssertionError(
+            f"trace completeness: {len(traces) - with_queue_wait} trace(s) "
+            "missing the queue_wait child span")
+    with_api = sum(1 for spans in traces
+                   if any(s["name"] == "api" for s in spans))
+    if with_api == 0:
+        raise AssertionError("trace completeness: no trace carries API-call "
+                             "child spans")
+    return {"traces_sampled": len(traces), "traces_with_api_spans": with_api}
+
+
 def run_bench(jobs: int, workers: int, threadiness: int, mode: str,
               serial: bool, create_latency: float, timeout: float,
-              background_pods: int = 1000) -> Dict:
+              background_pods: int = 1000, trace: bool = True) -> Dict:
     server = LatencyServer(create_latency=create_latency)
     # a busy cluster: pods the operator does not own and must not touch.
     # The indexed claim path never sees them; the scan control walks them
@@ -172,8 +215,10 @@ def run_bench(jobs: int, workers: int, threadiness: int, mode: str,
     clients = ClientSet(server)
     ctrl = TPUJobController(
         clients,
-        config=ControllerConfig(threadiness=threadiness, resync_period=0),
+        config=ControllerConfig(threadiness=threadiness, resync_period=0,
+                                enable_tracing=trace),
     )
+    trace_started0, trace_closed0 = TRACER.counters()
     if mode == "scan":
         use_scan_claims(ctrl)
     if serial:
@@ -213,13 +258,36 @@ def run_bench(jobs: int, workers: int, threadiness: int, mode: str,
         raise TimeoutError(
             f"{len(pending)}/{jobs} jobs not Running after {timeout:.0f}s")
 
+    # drain: workers finish their in-flight item after stop; wait until the
+    # root-span ledger balances so the completeness check isn't racing a
+    # sync that is mid-span
+    drain_deadline = time.monotonic() + 5
+    while time.monotonic() < drain_deadline:
+        s1, c1 = TRACER.counters()
+        with lat_lock:
+            lat = sorted(latencies)
+        s2, c2 = TRACER.counters()
+        if s1 == c1 == s2 == c2:
+            break  # ledger balanced and stable across the latency snapshot
+        time.sleep(0.01)
+    else:
+        with lat_lock:
+            lat = sorted(latencies)
+
     pod_count = len(server.list(RESOURCE_PODS)) - background_pods
-    with lat_lock:
-        lat = sorted(latencies)
+    started, closed = TRACER.counters()
+    started -= trace_started0
+    closed -= trace_closed0
+    trace_report: Dict = {"trace": trace}
+    if trace:
+        trace_report.update(_check_trace_completeness(
+            ctrl, len(lat), started, closed))
+        trace_report.update(traces_started=started, traces_closed=closed)
     return {
         "metric": "controller_reconcile",
         "mode": mode,
         "serial": serial,
+        **trace_report,
         "jobs": jobs,
         "workers": workers,
         "threadiness": threadiness,
@@ -248,6 +316,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--background-pods", type=int, default=1000,
                    help="unowned pods pre-loaded into the cluster")
     p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--no-trace", dest="trace", action="store_false",
+                   default=True,
+                   help="disable per-sync tracing (the pre-flight-recorder "
+                        "baseline; skips the trace-completeness assertion)")
     return p
 
 
@@ -256,8 +328,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         result = run_bench(args.jobs, args.workers, args.threadiness, args.mode,
                            args.serial, args.create_latency, args.timeout,
-                           background_pods=args.background_pods)
-    except TimeoutError as e:
+                           background_pods=args.background_pods,
+                           trace=args.trace)
+    except (TimeoutError, AssertionError) as e:
         print(f"FAIL: {e}", file=sys.stderr)
         return 1
     print(json.dumps(result))

@@ -17,6 +17,11 @@ convergence the harness asserts the system invariants that define
 4. the reconciler's ``_restart_deltas`` ledger drains and every
    expectation is satisfied once the cluster is quiet
 5. no orphaned pods/services survive a finished (or TTL-deleted) job
+6. trace completeness (the flight-recorder PR): every sync that started
+   under the fault schedule produced exactly one closed root span, and
+   every job's lifecycle timeline survived — ordered, and carrying spans,
+   events and condition transitions (plus backoff decisions where the
+   matrix crash-loops)
 
 Runnable:  python -m e2e.chaos --seed 7
 (or the full seeded matrix via the repo-root ``soak.py`` / ``make soak``)
@@ -43,6 +48,7 @@ from tpujob.kube.chaos import (
 from tpujob.kube.client import RESOURCE_TPUJOBS, ClientSet
 from tpujob.kube.errors import ConflictError, NotFoundError
 from tpujob.kube.memserver import InMemoryAPIServer
+from tpujob.obs.trace import TRACER
 
 
 # ---------------------------------------------------------------------------
@@ -61,6 +67,9 @@ class JobCase:
     expect_terminal: str = "any"
     expect_deleted: bool = False  # TTL reaps the job itself
     clean_all: bool = False  # cleanPodPolicy All: no pods may survive
+    # controller-owned ExitCode restarts occur, so the flight-recorder
+    # timeline must carry restart-backoff decisions
+    expect_backoff: bool = False
 
 
 def _job(name: str, spec: Dict[str, Any]) -> TPUJob:
@@ -107,6 +116,7 @@ def matrix(prefix: str) -> List[JobCase]:
             },
         }),
         scripts=[PodScript(match=f"{prefix}-wonly-worker-0", exit_codes=[137])],
+        expect_backoff=True,
     ))
 
     # multislice v4-16 x2: master + 3 workers across 2 slices (4 hosts
@@ -147,6 +157,7 @@ def matrix(prefix: str) -> List[JobCase]:
         }),
         scripts=[PodScript(match=f"{prefix}-exhaust-worker-0", exit_codes=[137] * 50)],
         expect_terminal="Failed",
+        expect_backoff=True,
     ))
     return cases
 
@@ -376,6 +387,79 @@ def check_invariants(
     return problems
 
 
+def check_trace_invariants(
+    controller,
+    cases: List[JobCase],
+    started0: int,
+    closed0: int,
+    settle_s: float = 5.0,
+) -> Tuple[List[str], Dict[str, int]]:
+    """Invariant 6: the flight recorder survived the fault schedule.
+
+    Every sync that started produced exactly one closed root span (the
+    ledger balances once workers drain), and every matrix job's timeline is
+    ordered and carries span/event/condition entries (plus backoff
+    decisions where the case crash-loops).  Call AFTER the cluster stopped
+    — a worker mid-sync legitimately holds an open root span.
+    """
+    problems: List[str] = []
+    deadline = time.monotonic() + settle_s
+    while time.monotonic() < deadline:
+        s, c = TRACER.counters()
+        if s == c:
+            break
+        time.sleep(0.02)
+    s, c = TRACER.counters()
+    synced = s - started0
+    if s != c:
+        problems.append(
+            f"trace ledger unbalanced after drain: {synced} roots started, "
+            f"{c - closed0} closed")
+    if synced <= 0:
+        problems.append("no traced syncs recorded under the fault schedule")
+    for case in cases:
+        name = case.job.metadata.name
+        tl = controller.flight.timeline("default", name)
+        if tl is None:
+            problems.append(f"{name}: no flight-recorder timeline")
+            continue
+        entries = tl["entries"]
+        seqs = [e["seq"] for e in entries]
+        if seqs != sorted(seqs):
+            problems.append(f"{name}: timeline entries out of order")
+        kinds = {e["kind"] for e in entries}
+        for want in ("span", "event", "condition"):
+            if want not in kinds:
+                problems.append(
+                    f"{name}: timeline missing {want!r} entries "
+                    f"(has {sorted(kinds)})")
+        if case.expect_backoff and "backoff" not in kinds:
+            problems.append(
+                f"{name}: expected restart-backoff decisions in timeline "
+                f"(has {sorted(kinds)})")
+        # recent sync entries must resolve to one closed root span with the
+        # queue-latency child (older corr ids legitimately rotate out of
+        # the bounded trace ring)
+        for e in [x for x in entries if x["kind"] == "span"][-3:]:
+            tr = controller.flight.trace(e["corr_id"])
+            if tr is None:
+                continue
+            roots = tr["spans"]
+            if len(roots) != 1:
+                problems.append(
+                    f"{name}: trace {e['corr_id']} has {len(roots)} root "
+                    "spans, want exactly 1")
+                continue
+            root = roots[0]
+            if root["duration_ms"] is None:
+                problems.append(
+                    f"{name}: trace {e['corr_id']} root span never closed")
+            if not any(ch["name"] == "queue_wait" for ch in root["children"]):
+                problems.append(
+                    f"{name}: trace {e['corr_id']} missing queue_wait child")
+    return problems, {"syncs": synced, "closed": c - closed0}
+
+
 # ---------------------------------------------------------------------------
 # soak driver
 # ---------------------------------------------------------------------------
@@ -427,6 +511,7 @@ def run_soak(
     inner.hooks.append(tracker.hook)
     scripts = [s for case in cases for s in case.scripts]
     started = time.monotonic()
+    trace_started0, trace_closed0 = TRACER.counters()
 
     with E2ECluster(
         scripts=scripts,
@@ -494,6 +579,17 @@ def run_soak(
             "storm_strikes": storm.struck,
             "invariants": "ok",
         }
+
+    # invariant 6 — after the cluster stopped, so no worker legitimately
+    # holds an open root span: every sync produced exactly one closed root
+    # span, and every job's lifecycle timeline survived the fault schedule
+    trace_problems, trace_stats = check_trace_invariants(
+        controller, cases, trace_started0, trace_closed0)
+    if trace_problems:
+        raise AssertionError(
+            f"seed {seed}: trace invariants violated:\n  "
+            + "\n  ".join(trace_problems))
+    report["trace"] = {**trace_stats, "timelines": "ok"}
     return report
 
 

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Trace smoke: one job synced with tracing on must yield a well-formed
+timeline over the real debug HTTP surface.
+
+Spins the in-memory apiserver + controller + monitoring listener, drives a
+1-master/1-worker job to Succeeded via a simulated kubelet hook, then
+fetches ``/debug/jobs``, ``/debug/jobs/default/<job>`` and
+``/debug/traces/<corr-id>`` over HTTP and asserts the timeline JSON is
+well-formed: strictly ordered, carrying span/event/condition entries, and
+every sampled sync resolving to exactly one closed root span.
+
+Wired as a ``make test`` prerequisite (``make trace-smoke``); budget ~2 s.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpujob.api import constants as c
+from tpujob.controller.job_base import ControllerConfig
+from tpujob.controller.reconciler import TPUJobController
+from tpujob.kube.client import RESOURCE_PODS, RESOURCE_TPUJOBS, ClientSet
+from tpujob.kube.memserver import ADDED, MODIFIED, InMemoryAPIServer
+from tpujob.server.monitoring import MonitoringServer
+
+JOB = "trace-smoke"
+
+
+def _fetch(port: int, path: str, expect: int = 200):
+    url = f"http://127.0.0.1:{port}{path}"
+    try:
+        with urllib.request.urlopen(url) as resp:  # noqa: S310 (local)
+            assert resp.status == expect, f"{path}: HTTP {resp.status}"
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        assert e.code == expect, f"{path}: HTTP {e.code}, want {expect}"
+        return None
+
+
+def install_kubelet(server: InMemoryAPIServer) -> None:
+    """Every created pod runs briefly, then succeeds."""
+
+    def hook(ev_type: str, resource: str, obj) -> None:
+        if resource != RESOURCE_PODS or ev_type not in (ADDED, MODIFIED):
+            return
+        phase = (obj.get("status") or {}).get("phase")
+        meta = obj.get("metadata") or {}
+        nxt = {"": "Running", None: "Running", "Pending": "Running",
+               "Running": "Succeeded"}.get(phase)
+        if nxt is None:
+            return
+
+        def advance():
+            server.update_status(RESOURCE_PODS, {
+                "metadata": {"namespace": meta.get("namespace"),
+                             "name": meta.get("name")},
+                "status": {"phase": nxt, "containerStatuses": [{
+                    "name": c.DEFAULT_CONTAINER_NAME,
+                    "ready": nxt == "Running",
+                    "state": ({"terminated": {"exitCode": 0}}
+                              if nxt == "Succeeded" else {}),
+                }]},
+            })
+
+        # off-thread: hooks run under the server lock
+        threading.Timer(0.02, advance).start()
+
+    server.hooks.append(hook)
+
+
+def main() -> int:
+    server = InMemoryAPIServer()
+    install_kubelet(server)
+    clients = ClientSet(server)
+    ctrl = TPUJobController(clients, config=ControllerConfig(
+        threadiness=1, resync_period=0, enable_tracing=True))
+    mon = MonitoringServer(host="127.0.0.1", port=0,
+                           flight=ctrl.flight).start()
+    stop = threading.Event()
+    try:
+        ctrl.run(stop, threadiness=1)
+        tmpl = {"spec": {"containers": [{"name": c.DEFAULT_CONTAINER_NAME,
+                                         "image": "smoke:latest"}]}}
+        server.create(RESOURCE_TPUJOBS, {
+            "apiVersion": c.API_VERSION, "kind": c.KIND,
+            "metadata": {"name": JOB, "namespace": "default"},
+            "spec": {"tpuReplicaSpecs": {
+                c.REPLICA_TYPE_MASTER: {"replicas": 1, "template": tmpl},
+                c.REPLICA_TYPE_WORKER: {"replicas": 1, "template": tmpl},
+            }},
+        })
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            job = server.get(RESOURCE_TPUJOBS, "default", JOB)
+            conds = {cond.get("type") for cond in
+                     (job.get("status") or {}).get("conditions") or []
+                     if cond.get("status") == "True"}
+            if c.JOB_SUCCEEDED in conds:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(f"{JOB} never reached Succeeded")
+
+        # --- /debug/jobs index ------------------------------------------
+        index = _fetch(mon.port, "/debug/jobs")
+        rows = {r["job"]: r for r in index["jobs"]}
+        assert f"default/{JOB}" in rows, f"index missing the job: {index}"
+
+        # --- /debug/jobs/<ns>/<name> timeline ---------------------------
+        tl = _fetch(mon.port, f"/debug/jobs/default/{JOB}")
+        entries = tl["entries"]
+        assert entries, "empty timeline"
+        seqs = [e["seq"] for e in entries]
+        assert seqs == sorted(seqs), "timeline out of order"
+        kinds = {e["kind"] for e in entries}
+        for want in ("span", "event", "condition"):
+            assert want in kinds, f"timeline missing {want!r}: has {sorted(kinds)}"
+        for e in entries:
+            for field in ("seq", "time", "kind", "summary", "corr_id"):
+                assert field in e, f"timeline entry missing {field!r}: {e}"
+        succeeded = [e for e in entries if e["kind"] == "condition"
+                     and "Succeeded" in e["summary"]]
+        assert succeeded, "no Succeeded condition transition in timeline"
+
+        # --- /debug/traces/<corr-id> span trees -------------------------
+        sync_entries = [e for e in entries if e["kind"] == "span"]
+        assert sync_entries, "no sync span entries"
+        checked = 0
+        for e in sync_entries:
+            tree = _fetch(mon.port, f"/debug/traces/{e['corr_id']}")
+            if tree is None:
+                continue
+            roots = tree["spans"]
+            assert len(roots) == 1, f"{e['corr_id']}: {len(roots)} roots"
+            root = roots[0]
+            assert root["name"] == "sync" and root["duration_ms"] is not None
+            assert any(ch["name"] == "queue_wait" for ch in root["children"])
+            checked += 1
+        assert checked, "no trace resolved via /debug/traces"
+        api_spans = any(
+            sp["name"] == "api"
+            for e in sync_entries
+            for t in [_fetch(mon.port, f"/debug/traces/{e['corr_id']}")]
+            if t is not None
+            for sp in _flatten(t["spans"])
+        )
+        assert api_spans, "no API-call child spans in any sampled trace"
+
+        # --- 404s stay 404 ----------------------------------------------
+        _fetch(mon.port, "/debug/jobs/default/absent-job", expect=404)
+        _fetch(mon.port, "/debug/traces/c-never-issued", expect=404)
+    finally:
+        stop.set()
+        ctrl.factory.stop()
+        mon.stop()
+    print(f"trace-smoke: OK ({len(entries)} timeline entries, "
+          f"{checked} trace(s) verified)")
+    return 0
+
+
+def _flatten(nodes):
+    for n in nodes:
+        yield n
+        yield from _flatten(n["children"])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,6 +74,48 @@ def test_formatters_render_fields():
     assert parsed["uid"] == "u1"
 
 
+def test_json_formatter_serializes_non_json_safe_fields():
+    """Exceptions/objects in fields must render, never raise inside
+    logging (a formatter crash cascades into logging-handler errors)."""
+    rec = logging.LogRecord("t6", logging.INFO, __file__, 1, "boom", (), None)
+    rec.fields = {"err": ValueError("bad spec"), "obj": object()}
+    parsed = json.loads(JsonFieldsFormatter().format(rec))
+    assert parsed["msg"] == "boom"
+    assert "bad spec" in parsed["err"]
+    assert "object" in parsed["obj"]
+
+
+class _Hostile:
+    def __str__(self):
+        raise RuntimeError("no str for you")
+
+    __repr__ = __str__
+
+
+def test_formatters_survive_hostile_field_values():
+    rec = logging.LogRecord("t7", logging.INFO, __file__, 1, "m", (), None)
+    rec.fields = {"bad": _Hostile(), "ok": 1}
+    text = TextFieldsFormatter().format(rec)
+    assert "bad=<unrepresentable _Hostile>" in text
+    assert "ok=1" in text
+    parsed = json.loads(JsonFieldsFormatter().format(rec))
+    assert parsed["bad"] == "<unrepresentable _Hostile>"
+    assert parsed["ok"] == 1
+
+
+def test_json_formatter_includes_exc_info():
+    try:
+        raise KeyError("missing")
+    except KeyError:
+        import sys
+
+        rec = logging.LogRecord("t8", logging.ERROR, __file__, 1, "failed",
+                                (), sys.exc_info())
+    parsed = json.loads(JsonFieldsFormatter().format(rec))
+    assert "KeyError" in parsed["exc"]
+    assert parsed["level"] == "error" or "error" in str(parsed).lower()
+
+
 def test_reconciler_tags_malformed_job_logs(caplog):
     """The reconcile path emits tagged records (logger.go integration)."""
     h = Harness()

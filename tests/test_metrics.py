@@ -1,6 +1,14 @@
 """Metrics registry: Histogram semantics and hot-path recording."""
+import threading
+
 from tpujob.server import metrics
-from tpujob.server.metrics import Counter, Gauge, Histogram, Registry
+from tpujob.server.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    LabeledHistogram,
+    Registry,
+)
 
 from jobtestutil import Harness, new_tpujob
 
@@ -36,6 +44,56 @@ def test_histogram_quantile_interpolates():
     h2 = Histogram("w_seconds", "test", reg, buckets=(0.1,))
     h2.observe(99.0)  # beyond the last finite bucket: clamps
     assert h2.quantile(0.99) == 0.1
+
+
+def test_histogram_inf_bucket_tracks_count_beyond_finite_buckets():
+    reg = Registry()
+    h = Histogram("inf_seconds", "test", reg, buckets=(0.1,))
+    for v in (0.05, 99.0, float("inf")):
+        h.observe(v)
+    samples = dict(h.samples())
+    # +Inf is the total count even when observations overflow every finite
+    # bucket (including an observation of inf itself)
+    assert samples['inf_seconds_bucket{le="0.1"}'] == 1
+    assert samples['inf_seconds_bucket{le="+Inf"}'] == 3
+    assert samples["inf_seconds_count"] == 3
+
+
+def test_histogram_count_sum_consistent_under_concurrent_observe():
+    reg = Registry()
+    h = Histogram("conc_seconds", "test", reg, buckets=(0.5,))
+    threads_n, per_thread, v = 8, 500, 0.25
+
+    def worker():
+        for _ in range(per_thread):
+            h.observe(v)
+
+    threads = [threading.Thread(target=worker) for _ in range(threads_n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    samples = dict(h.samples())
+    total = threads_n * per_thread
+    assert samples["conc_seconds_count"] == total
+    assert abs(samples["conc_seconds_sum"] - total * v) < 1e-6
+    # cumulative buckets agree with _count: no lost/torn increments
+    assert samples['conc_seconds_bucket{le="+Inf"}'] == total
+    assert samples['conc_seconds_bucket{le="0.5"}'] == total
+
+
+def test_labeled_histogram_escapes_label_values_in_samples():
+    reg = Registry()
+    fam = LabeledHistogram("esc_seconds", "test", reg, ("path",),
+                           buckets=(1.0,))
+    fam.labels(path='a"b\\c\nd').observe(0.5)
+    names = [name for name, _ in fam.samples()]
+    assert any('path="a\\"b\\\\c\\nd"' in n for n in names)
+    # the escaped series round-trips through full exposition without
+    # emitting a raw newline mid-series
+    for line in reg.expose().splitlines():
+        assert not line.endswith('\\')
+    assert '\\n' in reg.expose()
 
 
 def test_exposition_format():

@@ -1,0 +1,458 @@
+"""Flight-recorder subsystem: tracing, timelines, debug endpoints, dampers."""
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jobtestutil import Harness, new_tpujob
+from tpujob.api import constants as c
+from tpujob.controller.job_base import ControllerConfig
+from tpujob.kube.control import EventRecorder, slow_start_batch
+from tpujob.kube.errors import NotFoundError
+from tpujob.kube.memserver import InMemoryAPIServer
+from tpujob.obs.debug import span_tree
+from tpujob.obs.recorder import FlightRecorder
+from tpujob.obs.trace import (
+    TRACER,
+    KeyedTokenBucket,
+    Tracer,
+    TracingTransport,
+    resource_from_path,
+)
+from tpujob.server.monitoring import MonitoringServer
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+
+
+def test_root_and_child_spans_nest():
+    tracer = Tracer()
+    ctx = tracer.sync_root("sync", job="ns/j")
+    with ctx:
+        with tracer.span("phase", phase="claim"):
+            with tracer.span("api", verb="list") as api:
+                api.tags["code"] = 200
+    spans = ctx.spans
+    assert [s.name for s in spans] == ["api", "phase", "sync"]  # finish order
+    by_name = {s.name: s for s in spans}
+    assert by_name["sync"].parent_id is None
+    assert by_name["phase"].parent_id == by_name["sync"].span_id
+    assert by_name["api"].parent_id == by_name["phase"].span_id
+    assert all(s.duration is not None for s in spans)
+    assert by_name["api"].tags["code"] == 200
+    assert tracer.counters() == (1, 1)
+
+
+def test_span_without_active_trace_is_noop():
+    tracer = Tracer()
+    with tracer.span("api", verb="get") as sp:
+        assert sp is None
+    assert tracer.counters() == (0, 0)
+
+
+def test_disabled_tracer_is_noop():
+    tracer = Tracer(enabled=False)
+    ctx = tracer.sync_root("sync")
+    with ctx as root:
+        assert root is None
+        with tracer.span("phase") as sp:
+            assert sp is None
+    assert ctx.spans == []
+    assert ctx.trace_id == ""
+    assert tracer.counters() == (0, 0)
+
+
+def test_span_records_error_on_exception():
+    tracer = Tracer()
+    ctx = tracer.sync_root("sync")
+    with pytest.raises(ValueError):
+        with ctx:
+            with tracer.span("phase", phase="claim"):
+                raise ValueError("boom")
+    spans = {s.name: s for s in ctx.spans}
+    assert "boom" in spans["phase"].error
+    assert "boom" in spans["sync"].error
+    assert tracer.counters() == (1, 1)  # closed even on the error path
+
+
+def test_traces_are_thread_isolated():
+    tracer = Tracer()
+    seen = {}
+
+    def worker(name):
+        ctx = tracer.sync_root("sync", job=name)
+        with ctx:
+            time.sleep(0.01)
+            with tracer.span("phase", phase=name):
+                pass
+        seen[name] = ctx.spans
+
+    threads = [threading.Thread(target=worker, args=(f"t{i}",)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for name, spans in seen.items():
+        assert len(spans) == 2
+        assert all(s.trace_id == spans[0].trace_id for s in spans)
+        phase = next(s for s in spans if s.name == "phase")
+        assert phase.tags["phase"] == name  # no cross-thread bleed
+
+
+def test_slow_start_batch_propagates_trace_context():
+    """Pool-thread creates must attach to the submitting sync's trace."""
+    tracer = Tracer()
+    from tpujob.obs import trace as trace_mod
+
+    def fake_create(i):
+        with trace_mod.TRACER.span("api", verb="create", i=i):
+            time.sleep(0.001)
+
+    old = trace_mod.TRACER
+    trace_mod.TRACER = tracer
+    try:
+        ctx = tracer.sync_root("sync")
+        with ctx:
+            with tracer.span("phase", phase="slow_start_create"):
+                successes, err = slow_start_batch(4, fake_create)
+    finally:
+        trace_mod.TRACER = old
+    assert (successes, err) == (4, None)
+    api = [s for s in ctx.spans if s.name == "api"]
+    assert len(api) == 4
+    phase_id = next(s for s in ctx.spans if s.name == "phase").span_id
+    assert all(s.parent_id == phase_id for s in api)
+
+
+def test_span_tree_nests_and_orders():
+    tracer = Tracer()
+    ctx = tracer.sync_root("sync")
+    with ctx:
+        with tracer.span("phase", phase="b"):
+            pass
+        with tracer.span("phase", phase="a"):
+            pass
+    ctx.add_closed("queue_wait", 0.5)
+    roots = span_tree(ctx.spans)
+    assert len(roots) == 1
+    children = roots[0]["children"]
+    assert [ch["name"] for ch in children] == ["queue_wait", "phase", "phase"]
+    assert children[0]["start"] <= children[1]["start"]
+
+
+def test_resource_from_path():
+    assert resource_from_path("/api/pods/default/p") == "pods"
+    assert resource_from_path("/api/tpujobs/status") == "tpujobs"
+    assert resource_from_path(
+        "/apis/x.dev/v1/namespaces/ns/tpujobs/j/status") == "tpujobs"
+    assert resource_from_path("/api/v1/pods?labelSelector=a") == "pods"
+    assert resource_from_path("/api/v1/namespaces/ns/services/s") == "services"
+
+
+# ---------------------------------------------------------------------------
+# tracing transport
+# ---------------------------------------------------------------------------
+
+
+def test_tracing_transport_tags_verb_resource_code():
+    tracer = Tracer()
+    from tpujob.obs import trace as trace_mod
+
+    server = InMemoryAPIServer()
+    old = trace_mod.TRACER
+    trace_mod.TRACER = tracer
+    try:
+        wrapped = TracingTransport(server)
+        ctx = tracer.sync_root("sync")
+        with ctx:
+            wrapped.create("pods", {"metadata": {"name": "p", "namespace": "d"}})
+            with pytest.raises(NotFoundError):
+                wrapped.get("pods", "d", "absent")
+    finally:
+        trace_mod.TRACER = old
+    api = [s for s in ctx.spans if s.name == "api"]
+    tags = [(s.tags["verb"], s.tags["resource"], s.tags["code"]) for s in api]
+    assert ("create", "pods", 200) in tags
+    assert ("get", "pods", 404) in tags
+    err = next(s for s in api if s.tags["verb"] == "get")
+    assert "NotFoundError" in err.error
+
+
+def test_tracing_transport_delegates_surface():
+    server = InMemoryAPIServer()
+    wrapped = TracingTransport(server)
+    assert wrapped.traced is True
+    assert wrapped.hooks is server.hooks  # attribute passthrough
+    w = wrapped.watch("pods", send_initial=True)
+    w.stop()
+
+
+def test_clientset_wraps_untraced_transport_once():
+    from tpujob.kube.client import ClientSet
+
+    server = InMemoryAPIServer()
+    clients = ClientSet(server)
+    assert isinstance(clients.server, TracingTransport)
+    # a second ClientSet over an already-traced transport must not re-wrap
+    clients2 = ClientSet(clients.server)
+    assert clients2.server is clients.server
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_rings_are_bounded_and_ordered():
+    rec = FlightRecorder(ring_size=8, max_jobs=2, max_traces=4)
+    for i in range(20):
+        rec.record("default/a", "event", f"e{i}")
+    tl = rec.timeline("default", "a")
+    assert len(tl["entries"]) == 8
+    seqs = [e["seq"] for e in tl["entries"]]
+    assert seqs == sorted(seqs)
+    assert tl["entries"][-1]["summary"] == "e19"
+    # max_jobs LRU eviction
+    rec.record("default/b", "event", "x")
+    rec.record("default/c", "event", "x")
+    assert rec.timeline("default", "a") is None
+    assert rec.timeline("default", "c") is not None
+
+
+def test_recorder_condition_transitions_deduped():
+    rec = FlightRecorder()
+
+    class Cond:
+        def __init__(self, type_, status, reason="r", message="m"):
+            self.type, self.status = type_, status
+            self.reason, self.message = reason, message
+
+    rec.note_conditions("default/j", [Cond("Created", "True")])
+    rec.note_conditions("default/j", [Cond("Created", "True")])  # unchanged
+    rec.note_conditions("default/j", [Cond("Created", "True"),
+                                      Cond("Running", "True")])
+    entries = rec.timeline("default", "j")["entries"]
+    assert [e["summary"] for e in entries] == [
+        "Created -> True (r)", "Running -> True (r)"]
+
+
+def test_recorder_trace_ring_bounded():
+    tracer = Tracer()
+    rec = FlightRecorder(max_traces=2)
+    ids = []
+    for i in range(3):
+        ctx = tracer.sync_root("sync", job="default/j")
+        with ctx:
+            pass
+        rec.record_sync("default/j", ctx.trace_id, ctx.spans)
+        ids.append(ctx.trace_id)
+    assert rec.trace(ids[0]) is None  # rotated out
+    assert rec.trace(ids[-1]) is not None
+
+
+def test_event_recorder_sink_feeds_timeline_and_counts_drops():
+    from tpujob.server import metrics
+
+    rec = FlightRecorder()
+    recorder = EventRecorder(clients=None)
+    recorder.sinks.append(rec.record_event)
+    job = new_tpujob(name="evt")
+    recorder.event(job, "Normal", "Tested", "hello")
+    entries = rec.timeline("default", "evt")["entries"]
+    assert entries[0]["kind"] == "event"
+    assert "Tested" in entries[0]["summary"]
+    assert len(recorder.events) == 1  # bounded-deque tail snapshot
+
+    # a failing best-effort API write increments the dropped counter
+    class FailingEvents:
+        def create(self, ev):
+            raise RuntimeError("events API down")
+
+    class FailingClients:
+        events = FailingEvents()
+
+    recorder2 = EventRecorder(clients=FailingClients())
+    before = metrics.events_dropped.value
+    recorder2.event(job, "Warning", "Dropped", "never lands")
+    assert metrics.events_dropped.value == before + 1
+    assert len(recorder2.events) == 1  # local tail still holds it
+
+
+def test_event_recorder_tail_bounded():
+    recorder = EventRecorder(clients=None, tail=10)
+    job = new_tpujob(name="tail")
+    for i in range(25):
+        recorder.event(job, "Normal", "R", f"m{i}")
+    events = recorder.events
+    assert len(events) == 10
+    assert events[-1].message == "m24"
+
+
+# ---------------------------------------------------------------------------
+# controller integration
+# ---------------------------------------------------------------------------
+
+
+def _process(h: Harness, key: str = "default/test-job") -> None:
+    h.controller.factory.sync_all()
+    h.controller.enqueue_job(key)
+    assert h.controller.process_next_item(timeout=1.0)
+
+
+def test_traced_sync_produces_closed_root_with_children():
+    from tpujob.server import metrics
+
+    h = Harness()
+    h.submit(new_tpujob())
+    before_q = metrics.queue_latency.value
+    _process(h)
+    tl = h.controller.flight.timeline("default", "test-job")
+    kinds = {e["kind"] for e in tl["entries"]}
+    assert {"span", "event", "condition", "expectation"} <= kinds
+    sync_entry = next(e for e in tl["entries"] if e["kind"] == "span")
+    tree = h.controller.flight.trace(sync_entry["corr_id"])
+    assert len(tree["spans"]) == 1
+    root = tree["spans"][0]
+    assert root["name"] == "sync" and root["duration_ms"] is not None
+    child_names = {ch["name"] for ch in root["children"]}
+    assert "queue_wait" in child_names and "phase" in child_names
+    phases = {ch["tags"]["phase"] for ch in root["children"]
+              if ch["name"] == "phase"}
+    assert {"cache_get", "claim", "pod_diff", "service_diff"} <= phases
+    assert metrics.queue_latency.value > before_q
+
+
+def test_sync_phase_and_api_metrics_recorded():
+    from tpujob.server import metrics
+
+    h = Harness()
+    h.submit(new_tpujob(name="metrics-job"))
+    _process(h, "default/metrics-job")
+    text = metrics.REGISTRY.expose()
+    assert 'tpujob_operator_sync_phase_duration_seconds_count{phase="claim"}' in text
+    assert ('tpujob_operator_api_request_duration_seconds_count'
+            '{verb="create",resource="pods",code="200"}') in text
+
+
+def test_no_trace_config_restores_untraced_path():
+    h = Harness(config=ControllerConfig(enable_tracing=False))
+    started0, closed0 = TRACER.counters()
+    h.submit(new_tpujob(name="untraced"))
+    _process(h, "default/untraced")
+    assert TRACER.counters() == (started0, closed0)
+    tl = h.controller.flight.timeline("default", "untraced")
+    # the flight recorder still runs (events/conditions/expectations), but
+    # no sync span entries and no stored traces
+    assert tl is not None
+    assert all(e["kind"] != "span" for e in tl["entries"])
+    # restore the process-wide default for later tests
+    TRACER.enabled = True
+
+
+def test_exitcode_restart_records_backoff_decision():
+    h = Harness(config=ControllerConfig(restart_backoff_seconds=10.0,
+                                        restart_backoff_max_seconds=60.0))
+    job = new_tpujob(name="boj", master=None, workers=1,
+                     restart_policy=c.RESTART_POLICY_EXIT_CODE,
+                     backoff_limit=10)
+    h.submit(job)
+    h.sync()
+    h.set_pod_phase("boj", c.REPLICA_TYPE_WORKER, 0, "Failed", exit_code=137)
+    h.sync()
+    h.sync()  # replacement gated by the damper -> "delaying" decision
+    entries = h.controller.flight.timeline("default", "boj")["entries"]
+    backoff = [e for e in entries if e["kind"] == "backoff"]
+    assert any("restart strike 1" in e["summary"] for e in backoff)
+    expectations = [e for e in entries if e["kind"] == "expectation"]
+    assert any("pod-delete expectation" in e["summary"] for e in expectations)
+
+
+def test_slow_sync_dump_rate_limited(caplog):
+    h = Harness(config=ControllerConfig(slow_sync_threshold_s=1e-9))
+    h.submit(new_tpujob(name="slow"))
+    with caplog.at_level(logging.WARNING, logger="tpujob.controller"):
+        for _ in range(6):
+            _process(h, "default/slow")
+    dumps = [r for r in caplog.records if "slow sync" in r.getMessage()]
+    # token bucket: 3 immediate permits, then damped
+    assert 1 <= len(dumps) <= 3
+    assert all(getattr(r, "fields", {}).get("corr_id") for r in dumps)
+    assert all(getattr(r, "fields", {}).get("trace") for r in dumps)
+
+
+def test_keyed_token_bucket():
+    bucket = KeyedTokenBucket(capacity=2, refill_per_s=1000.0, max_keys=2)
+    assert bucket.allow("a") and bucket.allow("a")
+    assert not bucket.allow("a")  # drained
+    assert bucket.allow("b")  # independent key
+    time.sleep(0.01)
+    assert bucket.allow("a")  # refilled
+    bucket.allow("c")
+    bucket.allow("d")  # evicts the LRU key; no growth past max_keys
+    assert len(bucket._buckets) <= 2
+
+
+# ---------------------------------------------------------------------------
+# debug endpoints over HTTP
+# ---------------------------------------------------------------------------
+
+
+def _get_json(port, path, expect=200):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as resp:
+            assert resp.status == expect
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        assert e.code == expect
+        return None
+
+
+def test_debug_endpoints_serve_flight_recorder():
+    h = Harness()
+    h.submit(new_tpujob(name="dbg"))
+    _process(h, "default/dbg")
+    mon = MonitoringServer(host="127.0.0.1", port=0,
+                           flight=h.controller.flight).start()
+    try:
+        index = _get_json(mon.port, "/debug/jobs")
+        assert any(r["job"] == "default/dbg" for r in index["jobs"])
+        tl = _get_json(mon.port, "/debug/jobs/default/dbg")
+        assert tl["job"] == "default/dbg" and tl["entries"]
+        corr = next(e["corr_id"] for e in tl["entries"] if e["kind"] == "span")
+        tree = _get_json(mon.port, f"/debug/traces/{corr}")
+        assert tree["spans"][0]["name"] == "sync"
+        _get_json(mon.port, "/debug/jobs/default/absent", expect=404)
+        _get_json(mon.port, "/debug/traces/nope", expect=404)
+        # /metrics and /healthz unaffected by the new routes
+        with urllib.request.urlopen(f"http://127.0.0.1:{mon.port}/healthz") as r:
+            assert r.read() == b"ok"
+    finally:
+        mon.stop()
+
+
+def test_debug_endpoints_404_without_flight_recorder():
+    mon = MonitoringServer(host="127.0.0.1", port=0).start()
+    try:
+        _get_json(mon.port, "/debug/jobs", expect=404)
+    finally:
+        mon.stop()
+
+
+def test_trace_smoke_script_runs():
+    """The `make trace-smoke` gate end to end (real HTTP debug surface)."""
+    import importlib
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+    try:
+        trace_smoke = importlib.import_module("trace_smoke")
+        assert trace_smoke.main() == 0
+    finally:
+        sys.path.pop(0)

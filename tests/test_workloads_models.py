@@ -12,6 +12,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+# model-parity suites compile full train steps per strategy on the virtual
+# 8-device CPU mesh (>10 min wall); they run in `make unit` / `make ci`,
+# not in the budgeted tier-1 `make test` pass (see Makefile unit-fast note)
+pytestmark = pytest.mark.slow
+
 from tpujob.workloads import bert as bertlib
 from tpujob.workloads import distributed as dist
 from tpujob.workloads import parallel, resnet

@@ -36,6 +36,8 @@ from tpujob.kube.informers import (
     SharedInformer,
 )
 from tpujob.kube.objects import Pod, Service
+from tpujob.obs.recorder import FlightRecorder
+from tpujob.obs.trace import TRACER, KeyedTokenBucket
 from tpujob.runtime import ExpectationsCache, WorkQueue
 from tpujob.server import metrics
 
@@ -96,12 +98,71 @@ class ControllerConfig:
     restart_backoff_seconds: float = 1.0
     restart_backoff_max_seconds: float = 300.0
     namespace: Optional[str] = None  # None = all namespaces
+    # flight-recorder/tracing subsystem (tpujob/obs): per-sync span trees,
+    # per-job lifecycle timelines, /debug/* endpoints.  Tracing is process-
+    # wide (the transports reach the tracer without plumbing), so the last
+    # controller constructed wins — one controller per process in practice.
+    enable_tracing: bool = True
+    # a sync slower than this dumps its full span tree through the
+    # structured logger (rate-limited per job); <= 0 disables the dump
+    slow_sync_threshold_s: float = 5.0
+    flight_recorder_size: int = 256  # timeline entries retained per job
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
 def expectation_key(job_key: str, rtype: str, kind: str) -> str:
     """jobcontroller/util.go:46-51: job/replicatype/{pods,services}."""
     return f"{job_key}/{rtype.lower()}/{kind}"
+
+
+class _InstrumentedQueue:
+    """WorkQueue proxy stamping when each key became due, so dequeue can
+    observe true queue latency (add→get for immediate adds, due→get for
+    delayed ones — client-go's workqueue_queue_duration_seconds role).
+
+    First stamp wins while a key is queued (matching the queue's dedup);
+    the stamp is popped at dequeue.  Everything else delegates to the
+    wrapped queue (which may be the native C++ one).
+    """
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._due: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def _stamp(self, key: str, delay: float) -> None:
+        due = time.monotonic() + delay
+        with self._lock:
+            self._due.setdefault(key, due)
+
+    def add(self, key: str) -> None:
+        self._stamp(key, 0.0)
+        self._inner.add(key)
+
+    def add_after(self, key: str, delay: float) -> None:
+        self._stamp(key, delay)
+        self._inner.add_after(key, delay)
+
+    def add_rate_limited(self, key: str) -> None:
+        # no stamp: the inner queue computes the backoff delay internally,
+        # so the proxy cannot know when the key becomes due.  The dequeue
+        # path treats a missing stamp as "became due just now" (wait=0) —
+        # under-counting a requeued item's post-backoff wait beats folding
+        # the whole failure backoff (up to workqueue_max_backoff_s) into
+        # queue_latency, which would destroy it as a contention signal.
+        # client-go excludes AddRateLimited delays the same way (its stamp
+        # happens at the post-delay Add()).
+        self._inner.add_rate_limited(key)
+
+    def pop_due(self, key: str) -> Optional[float]:
+        with self._lock:
+            return self._due.pop(key, None)
+
+    def __len__(self) -> int:
+        return len(self._inner)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
 
 
 class JobController:
@@ -124,11 +185,23 @@ class JobController:
         self.recorder = recorder or EventRecorder(clients)
         self.pod_control = PodControl(clients, self.recorder)
         self.service_control = ServiceControl(clients, self.recorder)
-        self.queue = WorkQueue(
+        self.queue = _InstrumentedQueue(WorkQueue(
             base_delay=self.config.backoff_base_delay,
             max_delay=self.config.backoff_max_delay,
-        )
+        ))
         self.expectations = ExpectationsCache(ttl=self.config.expectations_ttl)
+
+        # flight recorder + tracing (tpujob/obs): per-sync span trees and
+        # per-job lifecycle timelines, served on /debug/* by the monitoring
+        # server.  The tracer is process-wide (transports reach it without
+        # plumbing); recorded events feed the per-job timelines via the
+        # recorder sink.
+        TRACER.enabled = self.config.enable_tracing
+        self.flight = FlightRecorder(ring_size=self.config.flight_recorder_size)
+        if hasattr(self.recorder, "sinks"):
+            self.recorder.sinks.append(self.flight.record_event)
+        self._slow_dump_limiter = KeyedTokenBucket(
+            capacity=3.0, refill_per_s=1 / 60.0)
 
         self.job_informer = self.factory.informer(RESOURCE_TPUJOBS)
         self.pod_informer = self.factory.informer(RESOURCE_PODS)
@@ -355,7 +428,14 @@ class JobController:
         raise NotImplementedError
 
     def process_next_item(self, timeout: Optional[float] = None) -> bool:
-        """One worker iteration: dequeue, sync, forget-or-backoff."""
+        """One worker iteration: dequeue, sync, forget-or-backoff.
+
+        Each item processed under tracing opens a root ``sync`` span tagged
+        with a fresh correlation id; the queue wait rides along as a
+        pre-measured child span, and the finished span tree feeds the
+        flight recorder, the span-derived metrics and (for slow syncs) a
+        rate-limited span-tree dump.
+        """
         from tpujob.runtime import SHUTDOWN
 
         try:
@@ -365,20 +445,79 @@ class JobController:
         if key is None:
             return True
         metrics.queue_depth.set(len(self.queue))
+        due = self.queue.pop_due(key)
         start = time.monotonic()
-        try:
-            forget = self.sync_handler(key)
-            if forget:
-                self.queue.forget(key)
-            else:
+        ctx = TRACER.sync_root("sync", job=key)
+        with ctx as root:
+            try:
+                # a missing stamp means the key was dirty-requeued at done()
+                # while its stamp was being consumed (watch-event re-add
+                # racing the dequeue): it became due at the requeue, i.e.
+                # just now
+                wait = max(0.0, start - due) if due is not None else 0.0
+                metrics.queue_latency.observe(wait)
+                ctx.add_closed("queue_wait", wait)
+            except Exception:
+                # best-effort observability must not skip the sync (or the
+                # queue.done below that keeps the key processable)
+                log.exception("error recording queue wait for job %s", key)
+            try:
+                forget = self.sync_handler(key)
+                if forget:
+                    self.queue.forget(key)
+                else:
+                    self.queue.add_rate_limited(key)
+            except Exception:
+                if root is not None:
+                    root.error = "sync raised; requeued with backoff"
+                log.exception("error syncing job %s", key)
                 self.queue.add_rate_limited(key)
+            finally:
+                metrics.reconcile_duration.observe(time.monotonic() - start)
+                self.queue.done(key)
+        try:
+            self._sink_trace(key, ctx)
         except Exception:
-            log.exception("error syncing job %s", key)
-            self.queue.add_rate_limited(key)
-        finally:
-            metrics.reconcile_duration.observe(time.monotonic() - start)
-            self.queue.done(key)
+            # observers are best-effort: a sink failure must not kill the
+            # worker thread (same contract as the EventRecorder sinks)
+            log.exception("error delivering sync trace for job %s", key)
         return True
+
+    def _sink_trace(self, key: str, ctx) -> None:
+        """Deliver one finished sync trace to its sinks: span-derived
+        metrics, the flight recorder, and the slow-sync dump."""
+        spans = ctx.spans
+        if not spans:
+            return  # tracing disabled
+        for sp in spans:
+            if sp.duration is None:
+                continue
+            if sp.name == "api":
+                metrics.api_request_duration.labels(
+                    verb=str(sp.tags.get("verb", "")),
+                    resource=str(sp.tags.get("resource", "")),
+                    code=str(sp.tags.get("code", "")),
+                ).observe(sp.duration)
+            elif sp.name == "phase":
+                metrics.sync_phase_duration.labels(
+                    phase=str(sp.tags.get("phase", ""))
+                ).observe(sp.duration)
+        self.flight.record_sync(key, ctx.trace_id, spans)
+        root = next((s for s in spans if s.parent_id is None), None)
+        threshold = self.config.slow_sync_threshold_s
+        if (root is not None and root.duration is not None and threshold > 0
+                and root.duration >= threshold):
+            # token bucket per job: a crash-looping job dumps a few traces,
+            # then is damped — it cannot flood the log (the restart-backoff
+            # damper pattern applied to logging)
+            if self._slow_dump_limiter.allow(key):
+                from tpujob.controller.joblogger import logger_for_key
+                from tpujob.obs.debug import span_tree
+
+                logger_for_key(log, key).with_fields(
+                    corr_id=ctx.trace_id, trace=span_tree(spans),
+                ).warning("slow sync: %.3fs exceeds threshold %.3fs",
+                          root.duration, threshold)
 
     def resync_all(self) -> int:
         """Re-enqueue every cached job (the informer resync replay: drift

@@ -87,13 +87,31 @@ class TextFieldsFormatter(logging.Formatter):
         out = super().format(record)
         fields = getattr(record, "fields", None)
         if fields:
-            out += " (" + " ".join(f"{k}={v}" for k, v in fields.items()) + ")"
+            rendered = []
+            for k, v in fields.items():
+                try:
+                    rendered.append(f"{k}={v}")
+                except Exception:  # hostile __str__ must not kill the line
+                    rendered.append(f"{k}=<unrepresentable {type(v).__name__}>")
+            out += " (" + " ".join(rendered) + ")"
         return out
+
+
+def _json_safe(value: Any) -> str:
+    """Fallback serializer for non-JSON field values (exceptions, arbitrary
+    objects): a log line must never raise inside logging — a formatter
+    crash turns one diagnostic into a logging-handler error cascade."""
+    try:
+        return repr(value)
+    except Exception:  # even a hostile __repr__ must not kill the line
+        return f"<unrepresentable {type(value).__name__}>"
 
 
 class JsonFieldsFormatter(logging.Formatter):
     """One JSON object per line with the fields inlined (the reference's
-    logrus JSON format for Stackdriver, main.go:42-58)."""
+    logrus JSON format for Stackdriver, main.go:42-58).  Non-JSON-safe
+    field values (exceptions, objects) are serialized via ``repr`` instead
+    of raising inside the logging call."""
 
     def format(self, record: logging.LogRecord) -> str:
         out: Dict[str, Any] = {
@@ -105,7 +123,7 @@ class JsonFieldsFormatter(logging.Formatter):
         out.update(getattr(record, "fields", None) or {})
         if record.exc_info:
             out["exc"] = self.formatException(record.exc_info)
-        return json.dumps(out)
+        return json.dumps(out, default=_json_safe)
 
 
 def configure_root_logging(json_format: bool, level: int = logging.INFO) -> None:

@@ -47,6 +47,7 @@ from tpujob.kube.objects import (
     ServicePort,
     ServiceSpec,
 )
+from tpujob.obs.trace import TRACER
 from tpujob.runtime import is_retryable_exit_code
 from tpujob.server import metrics
 
@@ -194,25 +195,36 @@ class TPUJobController(JobController):
     # ------------------------------------------------------------------
 
     def sync_handler(self, key: str) -> bool:
-        ns, _, name = key.partition("/")
-        cached = self.job_informer.store.get(ns, name)
-        if cached is None:
-            logger_for_key(log, key).info("job no longer exists")
-            return True
-        try:
-            job = TPUJob.from_dict(cached)
-            set_defaults_tpujob(job)
-            # strict topology: a replicas-vs-slice mismatch cannot be env-
-            # injected coherently, so it must fail visibly instead of looping
-            errs = validate_tpujob_spec(job.spec, strict_topology=True)
-        except (TypeError, ValueError) as e:
-            job, errs = None, [str(e)]
+        with TRACER.span("phase", phase="cache_get"):
+            ns, _, name = key.partition("/")
+            cached = self.job_informer.store.get(ns, name)
+            if cached is None:
+                logger_for_key(log, key).info("job no longer exists")
+                return True
+            try:
+                job = TPUJob.from_dict(cached)
+                set_defaults_tpujob(job)
+                # strict topology: a replicas-vs-slice mismatch cannot be
+                # env-injected coherently, so it must fail visibly instead
+                # of looping
+                errs = validate_tpujob_spec(job.spec, strict_topology=True)
+            except (TypeError, ValueError) as e:
+                job, errs = None, [str(e)]
         if errs:
             self._fail_malformed(cached, errs)
             return True
         if not self.satisfied_expectations(job):
-            return True  # informer cache stale; a watch event will re-enqueue
-        return self.reconcile_tpujobs(job)
+            # informer cache stale; a watch event will re-enqueue
+            self.flight.record(
+                key, "expectation",
+                "sync gated: informer cache still awaiting our own writes")
+            return True
+        forget = self.reconcile_tpujobs(job)
+        # one observation point for the whole sync's condition churn: every
+        # path through reconcile (incl. _fail_job) mutates job.status in
+        # place before returning here
+        self.flight.note_conditions(key, job.status.conditions)
+        return forget
 
     # ------------------------------------------------------------------
     # reconcile (controller.go:336-492)
@@ -234,8 +246,9 @@ class TPUJobController(JobController):
                 rs = job.status.replica_statuses.setdefault(rtype, ReplicaStatus())
                 rs.restarts += d
             self._restart_deltas[key] = dict(carried)
-        pods = self.get_pods_for_job(job)
-        services = self.get_services_for_job(job)
+        with TRACER.span("phase", phase="claim"):
+            pods = self.get_pods_for_job(job)
+            services = self.get_services_for_job(job)
 
         # terminal: clean up and freeze (controller.go:362-389)
         if st.is_finished(job.status):
@@ -269,12 +282,14 @@ class TPUJobController(JobController):
         coord_rtype = tpu_env.coordinator_replica(job)
         for rtype, rspec in job.spec.tpu_replica_specs.items():
             typed_pods = self.filter_by_replica_type(pods, rtype)
-            restarting = self._reconcile_pods(job, typed_pods, rtype, rspec, pods)
+            with TRACER.span("phase", phase="pod_diff", rtype=rtype):
+                restarting = self._reconcile_pods(job, typed_pods, rtype, rspec, pods)
             if rtype == coord_rtype:
                 # coordinator-only headless service (controller.go:474-477;
                 # worker-0 coordinates master-less jobs)
                 typed_svcs = self.filter_by_replica_type(services, rtype)
-                self._reconcile_services(job, typed_svcs, rtype, rspec)
+                with TRACER.span("phase", phase="service_diff", rtype=rtype):
+                    self._reconcile_services(job, typed_svcs, rtype, rspec)
             self._update_status_single(job, rtype, rspec, restarting)
 
         # re-check the backoff limit with the counts updated THIS sync:
@@ -352,6 +367,11 @@ class TPUJobController(JobController):
                                 code)
                             ekey = expectation_key(job.key, rtype, "pods")
                             self.expectations.expect(ekey, adds=0, dels=1)
+                            self.flight.record(
+                                job.key, "expectation",
+                                f"raise +1 pod-delete expectation [{rtype}/{index}] "
+                                f"(retryable exit {code})",
+                                {"rtype": rtype, "index": index, "dels": 1})
                             try:
                                 self.pod_control.delete_pod(
                                     pod.metadata.namespace,
@@ -406,10 +426,17 @@ class TPUJobController(JobController):
                 # decayed exponential delay instead of relaunching at full
                 # controller speed until backoffLimit; healthy siblings (the
                 # `ready` set) are untouched
+                wait = min(waits[i] for i in delayed)
                 logger_for_replica(log, job, rtype).info(
                     "restart backoff: delaying replacement pod(s) %s for %.2fs",
-                    delayed, min(waits[i] for i in delayed))
-                self.queue.add_after(job.key, min(waits[i] for i in delayed))
+                    delayed, wait)
+                self.flight.record(
+                    job.key, "backoff",
+                    f"delaying replacement pod(s) {delayed} [{rtype}] "
+                    f"for {wait:.2f}s",
+                    {"rtype": rtype, "indices": delayed,
+                     "wait_s": round(wait, 3)})
+                self.queue.add_after(job.key, wait)
             if ready:
                 # all unthrottled missing replicas of this type launch
                 # concurrently (a v4-32 job's 8 hosts cost ~1 API round
@@ -443,6 +470,12 @@ class TPUJobController(JobController):
         delay = 0.0 if strikes == 1 else min(
             base * (2 ** min(strikes - 2, 30)), max_delay)
         self._restart_backoff[(key, rtype, index)] = (strikes, now, now + delay)
+        self.flight.record(
+            key, "backoff",
+            f"restart strike {strikes} for {rtype}[{index}]: "
+            f"next replacement delayed {delay:.2f}s",
+            {"rtype": rtype, "index": index, "strikes": strikes,
+             "delay_s": round(delay, 3)})
 
     def _restart_backoff_remaining(self, key: str, rtype: str, index: int) -> float:
         entry = self._restart_backoff.get((key, rtype, index))
@@ -458,11 +491,22 @@ class TPUJobController(JobController):
         ekey = expectation_key(job.key, rtype, "pods")
         pods = [self._build_pod(job, rtype, rspec, index) for index in indices]
         self.expectations.expect(ekey, adds=len(pods), dels=0)
-        created, err = self.pod_control.create_pods(
-            job.metadata.namespace or "default", pods, job)
+        self.flight.record(
+            job.key, "expectation",
+            f"raise +{len(pods)} pod-create expectation(s) [{rtype}]",
+            {"rtype": rtype, "adds": len(pods), "indices": list(indices)})
+        with TRACER.span("phase", phase="slow_start_create", kind="pods",
+                         count=len(pods)):
+            created, err = self.pod_control.create_pods(
+                job.metadata.namespace or "default", pods, job)
         for _ in range(len(pods) - created):
             self.expectations.observe_add(ekey)
         if err is not None:
+            self.flight.record(
+                job.key, "expectation",
+                f"lower {len(pods) - created} unmet pod-create "
+                f"expectation(s) [{rtype}]: {type(err).__name__}",
+                {"rtype": rtype, "created": created, "intended": len(pods)})
             raise err
 
     @staticmethod
@@ -559,8 +603,14 @@ class TPUJobController(JobController):
         ekey = expectation_key(job.key, rtype, "services")
         services = [self._build_service(job, rtype, index) for index in indices]
         self.expectations.expect(ekey, adds=len(services), dels=0)
-        created, err = self.service_control.create_services(
-            job.metadata.namespace or "default", services, job)
+        self.flight.record(
+            job.key, "expectation",
+            f"raise +{len(services)} service-create expectation(s) [{rtype}]",
+            {"rtype": rtype, "adds": len(services)})
+        with TRACER.span("phase", phase="slow_start_create", kind="services",
+                         count=len(services)):
+            created, err = self.service_control.create_services(
+                job.metadata.namespace or "default", services, job)
         for _ in range(len(services) - created):
             self.expectations.observe_add(ekey)
         if err is not None:
@@ -807,6 +857,10 @@ class TPUJobController(JobController):
     # ------------------------------------------------------------------
 
     def _update_job_status(self, job: TPUJob) -> None:
+        with TRACER.span("phase", phase="status_update"):
+            self._write_job_status(job)
+
+    def _write_job_status(self, job: TPUJob) -> None:
         job.status.last_reconcile_time = st.now_iso()
         deltas = self._restart_deltas.pop(job.key, None)
         try:

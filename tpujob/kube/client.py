@@ -92,9 +92,20 @@ class EventInterface(TypedClient[Event]):
 
 class ClientSet:
     """All typed clients over one transport (the reference builds 4 clientsets
-    in ``app/server.go:176-199``; here one transport serves them all)."""
+    in ``app/server.go:176-199``; here one transport serves them all).
+
+    Transports that don't trace their own calls (the in-memory server, the
+    chaos injector) are wrapped so every API verb issued during a traced
+    sync records an ``api`` child span; the REST transports mark themselves
+    ``traced`` and span inside ``_request`` instead (real HTTP status +
+    retry visibility), so they are never double-counted.
+    """
 
     def __init__(self, server: InMemoryAPIServer):
+        if not getattr(server, "traced", False):
+            from tpujob.obs.trace import TracingTransport
+
+            server = TracingTransport(server)
         self.server = server
         self.tpujobs = TPUJobInterface(server)
         self.pods = PodInterface(server)

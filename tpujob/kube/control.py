@@ -8,9 +8,11 @@ and client-go's EventRecorder.
 """
 from __future__ import annotations
 
+import contextvars
 import threading
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Deque, List, Optional, Tuple
 
 from tpujob.api import constants as c
 from tpujob.api.types import TPUJob
@@ -68,7 +70,15 @@ def slow_start_batch(
                 errors.append(e)
         else:
             pool = _batch_executor()
-            futures = [pool.submit(fn, i) for i in range(position, position + batch)]
+            # each task runs under a copy of the submitter's context so the
+            # active sync trace (tpujob.obs.trace contextvars) propagates
+            # into the pool threads and per-create API spans attach to the
+            # right span tree; one copy per task — a shared Context cannot
+            # be entered concurrently
+            futures = [
+                pool.submit(contextvars.copy_context().run, fn, i)
+                for i in range(position, position + batch)
+            ]
             for future in futures:
                 try:
                     future.result()
@@ -115,14 +125,31 @@ def gen_pod_group_name(job_name: str) -> str:
 
 
 class EventRecorder:
-    """Records k8s Events against the API server (client-go recorder role)."""
+    """Records k8s Events against the API server (client-go recorder role).
 
-    def __init__(self, clients: Optional[ClientSet] = None, component: str = "tpujob-operator"):
+    The local tail is a bounded deque trimmed atomically with the append
+    (the old list-rebind trimming raced concurrent readers/writers outside
+    the lock), and a swallowed best-effort API write is now counted
+    (``tpujob_operator_events_dropped_total``) instead of vanishing.
+    """
+
+    def __init__(self, clients: Optional[ClientSet] = None,
+                 component: str = "tpujob-operator", tail: int = 1000):
         self.clients = clients
         self.component = component
         self._lock = threading.Lock()
         self._seq = 0
-        self.events: List[Event] = []  # local tail for tests/inspection
+        self._events: Deque[Event] = deque(maxlen=tail)
+        # observers notified of every recorded event (e.g. the controller's
+        # flight recorder folding events into per-job timelines); must never
+        # raise into the reconcile path
+        self.sinks: List[Callable[[Event], None]] = []
+
+    @property
+    def events(self) -> List[Event]:
+        """Snapshot of the local tail (tests/inspection)."""
+        with self._lock:
+            return list(self._events)
 
     def event(self, obj, etype: str, reason: str, message: str) -> None:
         meta: ObjectMeta = obj.metadata
@@ -146,14 +173,19 @@ class EventRecorder:
         )
         ev.extra["firstTimestamp"] = now_iso()
         with self._lock:
-            self.events.append(ev)
-            if len(self.events) > 1000:
-                self.events = self.events[-500:]
+            self._events.append(ev)  # deque(maxlen) trims under the lock
+        for sink in self.sinks:
+            try:
+                sink(ev)
+            except Exception:
+                pass  # observers are best-effort, never fail reconcile
         if self.clients is not None:
             try:
                 self.clients.events.create(ev)
             except Exception:
-                pass  # events are best-effort, never fail reconcile
+                # best-effort, never fail reconcile — but a silent swallow
+                # hides a broken events pipeline; count it
+                metrics.events_dropped.inc()
 
 
 class PodControl:

@@ -24,6 +24,7 @@ from tpujob.kube.errors import (
     error_for_status,
 )
 from tpujob.kube.memserver import WatchEvent
+from tpujob.obs.trace import TRACER, resource_from_path
 
 
 def _raise_for(status: int, payload: Dict[str, Any]) -> None:
@@ -133,6 +134,10 @@ class HTTPWatch:
 class HTTPApiClient:
     """ApiServer-interface client over HTTP."""
 
+    # every request spans itself inside _request (real HTTP status + retry
+    # count), so ClientSet must not additionally wrap this transport
+    traced = True
+
     def __init__(self, base_url: str, timeout: float = 10.0):
         self.base_url = base_url.rstrip("/")
         parsed = urllib.parse.urlparse(self.base_url)
@@ -164,24 +169,30 @@ class HTTPApiClient:
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"}
         last_err: Optional[Exception] = None
-        for attempt in range(2):  # retry once on a stale keep-alive socket
-            conn = self._conn()
-            try:
-                conn.request(method, path, body=data, headers=headers)
-                resp = conn.getresponse()
-                payload_raw = resp.read() or b"{}"
-            except (http.client.HTTPException, ConnectionError, OSError) as e:
-                self._drop_conn()
-                last_err = e
-                continue
-            if resp.status >= 400:
+        with TRACER.span("api", verb=method,
+                         resource=resource_from_path(path)) as sp:
+            for attempt in range(2):  # retry once on a stale keep-alive socket
+                conn = self._conn()
                 try:
-                    payload = json.loads(payload_raw)
-                except ValueError:
-                    payload = {}
-                _raise_for(resp.status, payload)
-            return json.loads(payload_raw)
-        raise ApiError(f"cannot reach API server at {self.base_url}: {last_err}")
+                    conn.request(method, path, body=data, headers=headers)
+                    resp = conn.getresponse()
+                    payload_raw = resp.read() or b"{}"
+                except (http.client.HTTPException, ConnectionError, OSError) as e:
+                    self._drop_conn()
+                    last_err = e
+                    continue
+                if sp is not None:
+                    sp.tags["code"] = resp.status
+                    if attempt:
+                        sp.tags["retried"] = attempt
+                if resp.status >= 400:
+                    try:
+                        payload = json.loads(payload_raw)
+                    except ValueError:
+                        payload = {}
+                    _raise_for(resp.status, payload)
+                return json.loads(payload_raw)
+            raise ApiError(f"cannot reach API server at {self.base_url}: {last_err}")
 
     # -- ApiServer surface ---------------------------------------------------
 

@@ -40,6 +40,7 @@ from tpujob.kube.errors import (
     error_for_status,
 )
 from tpujob.kube.memserver import WatchEvent
+from tpujob.obs.trace import TRACER, resource_from_path
 
 log = logging.getLogger("tpujob.kubetransport")
 
@@ -298,6 +299,10 @@ class KubeApiTransport:
     # feature-probing the live call would mask real TypeErrors)
     supports_resume = True
 
+    # every request spans itself inside _request (real HTTP status + retry
+    # count), so ClientSet must not additionally wrap this transport
+    traced = True
+
     def __init__(
         self,
         config: Optional[KubeConfig] = None,
@@ -394,34 +399,40 @@ class KubeApiTransport:
     ):
         data = json.dumps(body).encode() if body is not None else None
         last_err: Optional[Exception] = None
-        for attempt in range(2):
-            conn = self._conn()
-            sent = False
-            try:
-                conn.request(method, path, body=data, headers=self._headers(content_type))
-                sent = True
-                resp = conn.getresponse()
-                payload = resp.read()
-            except (http.client.HTTPException, ConnectionError, OSError) as e:
-                self._drop_conn()
-                last_err = e
-                # Replay safety: a send failure on a reused keep-alive socket
-                # means the server saw nothing — any verb may retry.  A
-                # failure after the request went out may have been committed
-                # server-side, so only idempotent-and-safe GET retries
-                # (urllib3/client-go retry discipline); replaying a POST
-                # could turn a committed create into a spurious 409.
-                if attempt == 0 and (not sent or method == "GET"):
-                    continue
-                raise ApiError(
-                    f"connection to {self.config.host} failed mid-{method}: {e}"
-                )
-            if resp.status >= 400:
-                raise _status_error(resp.status, payload)
-            if raw:
-                return payload
-            return json.loads(payload or b"{}")
-        raise ApiError(f"cannot reach API server at {self.config.host}: {last_err}")
+        with TRACER.span("api", verb=method,
+                         resource=resource_from_path(path)) as sp:
+            for attempt in range(2):
+                conn = self._conn()
+                sent = False
+                try:
+                    conn.request(method, path, body=data, headers=self._headers(content_type))
+                    sent = True
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                except (http.client.HTTPException, ConnectionError, OSError) as e:
+                    self._drop_conn()
+                    last_err = e
+                    # Replay safety: a send failure on a reused keep-alive socket
+                    # means the server saw nothing — any verb may retry.  A
+                    # failure after the request went out may have been committed
+                    # server-side, so only idempotent-and-safe GET retries
+                    # (urllib3/client-go retry discipline); replaying a POST
+                    # could turn a committed create into a spurious 409.
+                    if attempt == 0 and (not sent or method == "GET"):
+                        continue
+                    raise ApiError(
+                        f"connection to {self.config.host} failed mid-{method}: {e}"
+                    )
+                if sp is not None:
+                    sp.tags["code"] = resp.status
+                    if attempt:
+                        sp.tags["retried"] = attempt
+                if resp.status >= 400:
+                    raise _status_error(resp.status, payload)
+                if raw:
+                    return payload
+                return json.loads(payload or b"{}")
+            raise ApiError(f"cannot reach API server at {self.config.host}: {last_err}")
 
     # -- URL building --------------------------------------------------------
 

@@ -1,0 +1,26 @@
+"""Control-plane observability: tracing, flight recorder, debug rendering.
+
+The layer that turns aggregate metrics into answerable per-job questions:
+every work-queue item gets a correlation id, every sync a span tree, every
+job a bounded lifecycle timeline served on the monitoring port under
+``/debug/*`` (see docs/monitoring/README.md).
+"""
+from tpujob.obs.recorder import FlightRecorder
+from tpujob.obs.trace import (
+    TRACER,
+    KeyedTokenBucket,
+    Span,
+    Tracer,
+    TracingTransport,
+    resource_from_path,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "KeyedTokenBucket",
+    "Span",
+    "TRACER",
+    "Tracer",
+    "TracingTransport",
+    "resource_from_path",
+]

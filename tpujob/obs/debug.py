@@ -1,0 +1,28 @@
+"""Debug rendering: span trees for the /debug endpoints and slow-sync dumps."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Union
+
+from tpujob.obs.trace import Span
+
+
+def span_tree(spans: List[Union[Span, Dict[str, Any]]]) -> List[Dict[str, Any]]:
+    """Nest a flat span list into parent->children trees (children ordered
+    by start time).  Accepts Span objects or their to_dict() form; returns
+    the list of roots (normally exactly one per sync trace)."""
+    dicts = [s.to_dict() if isinstance(s, Span) else dict(s) for s in spans]
+    by_id: Dict[Any, Dict[str, Any]] = {}
+    for d in dicts:
+        d["children"] = []
+        by_id[d["span_id"]] = d
+    roots: List[Dict[str, Any]] = []
+    for d in dicts:
+        parent = by_id.get(d["parent_id"])
+        if parent is None or parent is d:
+            roots.append(d)
+        else:
+            parent["children"].append(d)
+    for d in dicts:
+        d["children"].sort(key=lambda c: c.get("start") or 0)
+    roots.sort(key=lambda c: c.get("start") or 0)
+    return roots

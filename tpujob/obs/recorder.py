@@ -1,0 +1,209 @@
+"""Bounded in-memory flight recorder: per-job lifecycle timelines.
+
+Each job gets a ring buffer of timeline entries merging, in one ordered
+stream, everything the control plane decided about it:
+
+- ``span``        — one completed sync (root duration, per-phase breakdown,
+                    API-call count, correlation id)
+- ``event``       — every Event the recorder emitted for the job
+- ``condition``   — job condition transitions as the controller saw them
+- ``backoff``     — restart-backoff strikes and delayed-replacement waits
+- ``expectation`` — expectation raises and sync gates on a stale cache
+
+The analog of ``kubectl describe`` for the operator's own decision history,
+served as JSON on the monitoring port (``/debug/jobs/<ns>/<name>``); recent
+full span trees are retained for ``/debug/traces/<corr-id>``.  Everything
+is bounded: N entries per job, M jobs, K traces — a preemption storm
+rotates history, it never grows the process.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Deque, Dict, List, Optional
+
+from tpujob.obs.trace import TRACER, Span
+
+
+def _iso(t: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t))
+
+
+class FlightRecorder:
+    def __init__(self, ring_size: int = 256, max_jobs: int = 1024,
+                 max_traces: int = 256):
+        self.ring_size = ring_size
+        self.max_jobs = max_jobs
+        self.max_traces = max_traces
+        self._lock = threading.Lock()
+        self._seq = itertools.count(1)
+        # job key -> ring of timeline entries (LRU-bounded across jobs)
+        self._jobs: "OrderedDict[str, Deque[Dict[str, Any]]]" = OrderedDict()
+        # job key -> {condition type -> status} as last observed
+        self._conditions: Dict[str, Dict[str, str]] = {}
+        # corr id -> {job, spans} for recent syncs
+        self._traces: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+
+    # ------------------------------------------------------------------
+    # recording
+    # ------------------------------------------------------------------
+
+    def _ring(self, job_key: str) -> Deque[Dict[str, Any]]:
+        ring = self._jobs.get(job_key)
+        if ring is None:
+            ring = deque(maxlen=self.ring_size)
+            self._jobs[job_key] = ring
+        self._jobs.move_to_end(job_key)
+        while len(self._jobs) > self.max_jobs:
+            evicted, _ = self._jobs.popitem(last=False)
+            self._conditions.pop(evicted, None)
+        return ring
+
+    def record(self, job_key: str, kind: str, summary: str,
+               detail: Optional[Dict[str, Any]] = None,
+               t: Optional[float] = None,
+               corr_id: Optional[str] = None) -> None:
+        """Append one timeline entry, auto-tagged with the active sync's
+        correlation id (empty outside a traced sync)."""
+        now = time.time() if t is None else t
+        entry: Dict[str, Any] = {
+            "seq": 0,  # assigned under the lock: seq order == ring order
+            "time": _iso(now),
+            "t": round(now, 6),
+            "kind": kind,
+            "summary": summary,
+            "corr_id": (corr_id if corr_id is not None
+                        else TRACER.current_trace_id()),
+        }
+        if detail:
+            entry["detail"] = detail
+        with self._lock:
+            entry["seq"] = next(self._seq)
+            self._ring(job_key).append(entry)
+
+    def record_sync(self, job_key: str, corr_id: str, spans: List[Span]) -> None:
+        """Store one completed sync: the full span tree (for /debug/traces)
+        plus a summarizing timeline entry."""
+        if not spans:
+            return
+        root = next((s for s in spans if s.parent_id is None), spans[-1])
+        phases: Dict[str, float] = {}
+        api_calls = 0
+        for s in spans:
+            if s.duration is None:
+                continue
+            if s.name == "phase":
+                p = str(s.tags.get("phase", ""))
+                phases[p] = round(phases.get(p, 0.0) + s.duration * 1e3, 3)
+            elif s.name == "api":
+                api_calls += 1
+        dur_ms = round(root.duration * 1e3, 3) if root.duration is not None else None
+        detail: Dict[str, Any] = {"duration_ms": dur_ms, "spans": len(spans),
+                                  "api_calls": api_calls}
+        if phases:
+            detail["phases_ms"] = phases
+        if root.error:
+            detail["error"] = root.error
+        summary = f"sync {dur_ms}ms ({api_calls} API call(s))"
+        if root.error:
+            summary += f" ERROR: {root.error}"
+        with self._lock:
+            self._traces[corr_id] = {
+                "job": job_key, "spans": [s.to_dict() for s in spans],
+            }
+            self._traces.move_to_end(corr_id)
+            while len(self._traces) > self.max_traces:
+                self._traces.popitem(last=False)
+        self.record(job_key, "span", summary, detail, t=root.start,
+                    corr_id=corr_id)
+
+    def note_conditions(self, job_key: str, conditions) -> None:
+        """Diff the job's condition set against the last observation and
+        record every transition (type, status, reason)."""
+        state = {c.type: c.status for c in conditions}
+        with self._lock:
+            prev = self._conditions.get(job_key, {})
+            changed = [c for c in conditions
+                       if prev.get(c.type) != c.status]
+            self._conditions[job_key] = state
+        for c in changed:
+            self.record(
+                job_key, "condition",
+                f"{c.type} -> {c.status} ({c.reason})",
+                {"type": c.type, "status": c.status, "reason": c.reason,
+                 "message": c.message},
+            )
+
+    def record_event(self, ev) -> None:
+        """EventRecorder sink: fold a recorded Event into the timeline of
+        the job it involves."""
+        involved = getattr(ev, "involved_object", None) or {}
+        name = involved.get("name")
+        if not name:
+            return
+        key = f"{involved.get('namespace') or 'default'}/{name}"
+        self.record(key, "event", f"{ev.type} {ev.reason}: {ev.message}",
+                    {"type": ev.type, "reason": ev.reason})
+
+    def reset(self) -> None:
+        with self._lock:
+            self._jobs.clear()
+            self._conditions.clear()
+            self._traces.clear()
+
+    # ------------------------------------------------------------------
+    # introspection (the /debug/* payloads)
+    # ------------------------------------------------------------------
+
+    def jobs_index(self) -> Dict[str, Any]:
+        """The /debug/jobs payload: one summary row per tracked job."""
+        with self._lock:
+            rows = []
+            for key, ring in self._jobs.items():
+                last = ring[-1] if ring else None
+                last_sync = next(
+                    (e for e in reversed(ring) if e["kind"] == "span"), None)
+                rows.append({
+                    "job": key,
+                    "entries": len(ring),
+                    "last_seen": last["time"] if last else None,
+                    "last_sync_ms": ((last_sync.get("detail") or {}).get(
+                        "duration_ms") if last_sync else None),
+                    "conditions": dict(self._conditions.get(key, {})),
+                })
+        rows.sort(key=lambda r: r["job"])
+        return {"jobs": rows}
+
+    def timeline(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        """The /debug/jobs/<ns>/<name> payload: the ordered timeline."""
+        key = f"{namespace or 'default'}/{name}"
+        with self._lock:
+            ring = self._jobs.get(key)
+            if ring is None:
+                return None
+            entries = list(ring)
+            conditions = dict(self._conditions.get(key, {}))
+        return {"job": key, "entries": entries, "conditions": conditions}
+
+    def traces(self) -> List[Dict[str, Any]]:
+        """Snapshot of every retained trace (flat span dicts, oldest first)
+        — the harness-facing surface for completeness assertions, so
+        callers never reach into the internal stores."""
+        with self._lock:
+            return [{"corr_id": cid, "job": rec["job"],
+                     "spans": list(rec["spans"])}
+                    for cid, rec in self._traces.items()]
+
+    def trace(self, corr_id: str) -> Optional[Dict[str, Any]]:
+        """The /debug/traces/<corr-id> payload: the nested span tree."""
+        with self._lock:
+            rec = self._traces.get(corr_id)
+            if rec is None:
+                return None
+            spans = list(rec["spans"])
+            job = rec["job"]
+        from tpujob.obs.debug import span_tree
+
+        return {"trace_id": corr_id, "job": job, "spans": span_tree(spans)}

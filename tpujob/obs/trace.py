@@ -1,0 +1,405 @@
+"""Per-sync tracing with contextvar propagation.
+
+Every work-queue item processed by the controller opens a **root span**
+carrying a correlation id; the reconcile phases (cache get, claim, pod/
+service diff, slow-start creates, status update) and every API call made
+underneath open **child spans**.  Propagation rides a ``contextvars``
+context, so the span tree assembles itself through
+``job_base.py`` → ``reconciler.py`` → ``control.py`` →
+``httpclient.py``/``kubetransport.py`` without threading a handle through
+every signature — including across the slow-start batch pool, whose tasks
+run under a copied context (``control.slow_start_batch``).
+
+The tracer is a process-wide singleton (``TRACER``) so the transport
+layers can reach it without plumbing; ``enabled=False`` (``--no-trace``)
+reduces every instrumentation point to a shared no-op context manager —
+the PR 1 hot path, unchanged.
+
+Spans feed three sinks, driven by ``JobController._sink_trace``:
+
+1. the per-job flight recorder (:mod:`tpujob.obs.recorder`),
+2. span-derived metrics (``tpujob_operator_api_request_duration_seconds``,
+   ``tpujob_operator_sync_phase_duration_seconds``,
+   ``tpujob_operator_queue_latency_seconds``),
+3. a rate-limited slow-sync span-tree dump through the structured logger.
+"""
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+# the active trace (set by the root span for the duration of one sync) and
+# the innermost open span (the parent for any span opened underneath)
+_current_trace: "contextvars.ContextVar[Optional[_Trace]]" = contextvars.ContextVar(
+    "tpujob_trace", default=None
+)
+_current_span: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "tpujob_span", default=None
+)
+
+_corr_seq = itertools.count(1)
+
+
+class Span:
+    """One timed operation inside a trace.
+
+    ``duration`` is ``None`` while the span is open; ``start`` is wall-clock
+    (for timeline ordering), the duration measured on ``perf_counter``.
+    """
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "tags",
+                 "start", "duration", "error", "_t0")
+
+    def __init__(self, trace_id: str, span_id: int, parent_id: Optional[int],
+                 name: str, tags: Dict[str, Any]):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.tags = tags
+        self.start = time.time()
+        self.duration: Optional[float] = None
+        self.error: Optional[str] = None
+        self._t0 = time.perf_counter()
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "duration_ms": (round(self.duration * 1e3, 3)
+                            if self.duration is not None else None),
+        }
+        if self.tags:
+            d["tags"] = dict(self.tags)
+        if self.error:
+            d["error"] = self.error
+        return d
+
+
+class _Trace:
+    """Per-sync span accumulator: spans append on finish, in any thread."""
+
+    __slots__ = ("trace_id", "spans", "closed", "_lock", "_ids")
+
+    def __init__(self, trace_id: str):
+        self.trace_id = trace_id
+        self.spans: List[Span] = []
+        self.closed = False
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+
+    def next_span_id(self) -> int:
+        return next(self._ids)
+
+    def add(self, span: Span, force: bool = False) -> None:
+        """Append a finished span.  After the trace closes, late adds are
+        dropped (a pool-thread span finishing after the sink already read
+        the trace) unless ``force`` — used by ``add_closed`` for
+        pre-measured spans the root's owner attaches explicitly."""
+        with self._lock:
+            if force or not self.closed:
+                self.spans.append(span)
+
+
+class _SpanCtx:
+    """Context manager for one child span of the active trace."""
+
+    __slots__ = ("_trace", "_tags", "_name", "span", "_tok")
+
+    def __init__(self, trace: _Trace, name: str, tags: Dict[str, Any]):
+        self._trace = trace
+        self._name = name
+        self._tags = tags
+        self.span: Optional[Span] = None
+        self._tok = None
+
+    def __enter__(self) -> Span:
+        parent = _current_span.get()
+        self.span = Span(
+            self._trace.trace_id, self._trace.next_span_id(),
+            parent.span_id if parent is not None else None,
+            self._name, self._tags,
+        )
+        self._tok = _current_span.set(self.span)
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        span = self.span
+        span.duration = time.perf_counter() - span._t0
+        if exc is not None and span.error is None:
+            span.error = f"{type(exc).__name__}: {exc}"
+        _current_span.reset(self._tok)
+        self._trace.add(span)
+        return False
+
+
+class _NoopSpanCtx:
+    """Shared no-op: tracing disabled, or no trace active on this context."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NOOP_SPAN = _NoopSpanCtx()
+
+
+class _RootCtx:
+    """Context manager for one sync's root span: installs the trace on the
+    current context, exposes the finished span list afterwards."""
+
+    __slots__ = ("_tracer", "trace", "root", "_name", "_tags",
+                 "_tok_trace", "_tok_span")
+
+    def __init__(self, tracer: "Tracer", trace_id: str, name: str,
+                 tags: Dict[str, Any]):
+        self._tracer = tracer
+        self.trace = _Trace(trace_id)
+        self._name = name
+        self._tags = tags
+        self.root: Optional[Span] = None
+
+    @property
+    def trace_id(self) -> str:
+        return self.trace.trace_id
+
+    @property
+    def spans(self) -> List[Span]:
+        return list(self.trace.spans)
+
+    def __enter__(self) -> Span:
+        self._tracer._note_root(started=True)
+        self.root = Span(self.trace.trace_id, self.trace.next_span_id(),
+                         None, self._name, self._tags)
+        self._tok_trace = _current_trace.set(self.trace)
+        self._tok_span = _current_span.set(self.root)
+        return self.root
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        root = self.root
+        root.duration = time.perf_counter() - root._t0
+        if exc is not None and root.error is None:
+            root.error = f"{type(exc).__name__}: {exc}"
+        _current_span.reset(self._tok_span)
+        _current_trace.reset(self._tok_trace)
+        self.trace.add(root)
+        self.trace.closed = True
+        self._tracer._note_root(started=False)
+        return False
+
+    def add_closed(self, name: str, duration: float, **tags: Any) -> None:
+        """Attach a pre-measured child (e.g. the queue wait that happened
+        before the root opened): start back-dated so timelines order it
+        ahead of the work it preceded."""
+        span = Span(self.trace.trace_id, self.trace.next_span_id(),
+                    self.root.span_id if self.root is not None else None,
+                    name, tags)
+        span.start -= duration
+        span.duration = duration
+        self.trace.add(span, force=True)
+
+
+class _NoopRootCtx:
+    """Root no-op with the same read surface as _RootCtx."""
+
+    __slots__ = ()
+    trace_id = ""
+    spans: List[Span] = []
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+    def add_closed(self, name: str, duration: float, **tags: Any) -> None:
+        pass
+
+
+_NOOP_ROOT = _NoopRootCtx()
+
+
+class Tracer:
+    """Process-wide tracer: root/child span factories + completeness counters.
+
+    ``roots_started``/``roots_closed`` let harnesses (bench, chaos soak)
+    assert trace completeness: every sync that started produced exactly one
+    closed root span.
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._roots_started = 0
+        self._roots_closed = 0
+
+    def _note_root(self, started: bool) -> None:
+        with self._lock:
+            if started:
+                self._roots_started += 1
+            else:
+                self._roots_closed += 1
+
+    def counters(self) -> Tuple[int, int]:
+        """(roots started, roots closed) — equal when no sync is in flight."""
+        with self._lock:
+            return self._roots_started, self._roots_closed
+
+    def new_corr_id(self) -> str:
+        return f"c{next(_corr_seq):08x}"
+
+    def sync_root(self, name: str, **tags: Any):
+        """Root span for one work-queue item; no-op when disabled."""
+        if not self.enabled:
+            return _NOOP_ROOT
+        return _RootCtx(self, self.new_corr_id(), name, tags)
+
+    def span(self, name: str, **tags: Any):
+        """Child span of the active trace; no-op when disabled or when no
+        trace is active on the current context (informer threads, tests
+        driving sync_handler directly)."""
+        if not self.enabled:
+            return _NOOP_SPAN
+        trace = _current_trace.get()
+        if trace is None or trace.closed:
+            return _NOOP_SPAN
+        return _SpanCtx(trace, name, tags)
+
+    def current_trace_id(self) -> str:
+        trace = _current_trace.get()
+        return trace.trace_id if trace is not None else ""
+
+
+TRACER = Tracer()
+
+
+# ---------------------------------------------------------------------------
+# API-call instrumentation
+# ---------------------------------------------------------------------------
+
+_KNOWN_RESOURCES = frozenset(
+    {"pods", "services", "events", "tpujobs", "podgroups", "leases"}
+)
+
+
+def resource_from_path(path: str) -> str:
+    """Best-effort resource plural from a REST path, for span/metric tags.
+
+    Handles both the tpujob HTTP dialect (``/api/<resource>/...``) and the
+    K8s dialect (``/api/v1/namespaces/<ns>/pods/...``,
+    ``/apis/<group>/<version>/<resource>``).
+    """
+    parts = [p for p in path.partition("?")[0].split("/") if p]
+    if "namespaces" in parts:
+        rest = parts[parts.index("namespaces") + 2:]
+        if rest:
+            return rest[0]
+    for p in parts:
+        if p in _KNOWN_RESOURCES:
+            return p
+    return parts[-1] if parts else ""
+
+
+class TracingTransport:
+    """Wrap an ApiServer-duck transport so every verb call made under an
+    active sync trace records an ``api`` child span tagged verb/resource/
+    code.  Installed by :class:`tpujob.kube.client.ClientSet` for transports
+    that don't trace themselves (the in-memory server, the chaos injector);
+    the REST transports mark themselves ``traced`` and span inside
+    ``_request`` instead, where the real HTTP status and retry count live.
+    """
+
+    traced = True
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _traced_call(self, verb: str, resource: str, fn, *args, **kwargs):
+        with TRACER.span("api", verb=verb, resource=resource) as sp:
+            try:
+                out = fn(resource, *args, **kwargs)
+            except Exception as e:
+                if sp is not None:
+                    sp.tags["code"] = getattr(e, "code", "err")
+                raise
+            if sp is not None:
+                sp.tags["code"] = 200
+            return out
+
+    def create(self, resource, obj):
+        return self._traced_call("create", resource, self._inner.create, obj)
+
+    def get(self, resource, namespace, name):
+        return self._traced_call("get", resource, self._inner.get, namespace, name)
+
+    def list(self, resource, namespace=None, label_selector=None):
+        return self._traced_call("list", resource, self._inner.list,
+                                 namespace, label_selector)
+
+    def update(self, resource, obj):
+        return self._traced_call("update", resource, self._inner.update, obj)
+
+    def update_status(self, resource, obj):
+        return self._traced_call("update_status", resource,
+                                 self._inner.update_status, obj)
+
+    def patch(self, resource, namespace, name, patch):
+        return self._traced_call("patch", resource, self._inner.patch,
+                                 namespace, name, patch)
+
+    def delete(self, resource, namespace, name):
+        return self._traced_call("delete", resource, self._inner.delete,
+                                 namespace, name)
+
+
+# ---------------------------------------------------------------------------
+# per-key rate limiting (slow-sync dump damper)
+# ---------------------------------------------------------------------------
+
+
+class KeyedTokenBucket:
+    """Non-blocking per-key token bucket (the restart-backoff damper pattern
+    applied to log flooding): each key gets ``capacity`` immediate permits,
+    refilled at ``refill_per_s``, so a crash-looping job can dump a few slow
+    traces and is then throttled instead of flooding the log.
+
+    Bounded: beyond ``max_keys`` the least-recently-touched entries are
+    evicted (an evicted key restarts with a full bucket — bounded memory
+    beats perfect damping under key churn, like ``_DedupWarner``).
+    """
+
+    def __init__(self, capacity: float = 3.0, refill_per_s: float = 1 / 60.0,
+                 max_keys: int = 4096):
+        self.capacity = float(capacity)
+        self.refill_per_s = refill_per_s
+        self.max_keys = max_keys
+        self._lock = threading.Lock()
+        self._buckets: "OrderedDict[Any, Tuple[float, float]]" = OrderedDict()
+
+    def allow(self, key: Any) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            tokens, last = self._buckets.get(key, (self.capacity, now))
+            tokens = min(self.capacity, tokens + (now - last) * self.refill_per_s)
+            ok = tokens >= 1.0
+            if ok:
+                tokens -= 1.0
+            self._buckets[key] = (tokens, now)
+            self._buckets.move_to_end(key)
+            while len(self._buckets) > self.max_keys:
+                self._buckets.popitem(last=False)
+        return ok

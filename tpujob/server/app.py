@@ -94,6 +94,9 @@ class OperatorApp:
                 restart_backoff_max_seconds=opt.restart_backoff_max_s,
                 backoff_base_delay=opt.workqueue_base_backoff_s,
                 backoff_max_delay=opt.workqueue_max_backoff_s,
+                enable_tracing=opt.enable_tracing,
+                slow_sync_threshold_s=opt.slow_sync_threshold_s,
+                flight_recorder_size=opt.flight_recorder_size,
             ),
         )
         self.monitoring: Optional[MonitoringServer] = None
@@ -107,8 +110,12 @@ class OperatorApp:
         configure_root_logging(self.opt.json_log_format)
         setup_signal_handler(self.stop_event)
         if self.opt.monitoring_port:
-            self.monitoring = MonitoringServer(port=self.opt.monitoring_port).start()
-            log.info("monitoring on :%d/metrics", self.monitoring.port)
+            self.monitoring = MonitoringServer(
+                port=self.opt.monitoring_port,
+                flight=self.controller.flight,
+            ).start()
+            log.info("monitoring on :%d/metrics (+/debug/jobs)",
+                     self.monitoring.port)
 
         def start_controller():
             log.info("leadership acquired; starting controller (threadiness=%d)",

@@ -10,20 +10,28 @@ from __future__ import annotations
 
 import bisect
 import threading
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 
 def _fmt(v: float) -> str:
     return str(int(v)) if v == int(v) else repr(v)
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, quote, LF."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 class Counter:
-    def __init__(self, name: str, help_text: str, registry: "Registry"):
+    def __init__(self, name: str, help_text: str,
+                 registry: Optional["Registry"] = None, label_str: str = ""):
         self.name = name
         self.help = help_text
+        self._label_str = label_str  # 'k="v",...' for labeled children
         self._value = 0.0
         self._lock = threading.Lock()
-        registry._register(self)
+        if registry is not None:
+            registry._register(self)
 
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
@@ -39,6 +47,8 @@ class Counter:
 
     def samples(self) -> List[Tuple[str, float]]:
         """(series name incl. labels, value) pairs for exposition."""
+        if self._label_str:
+            return [(f"{self.name}{{{self._label_str}}}", self.value)]
         return [(self.name, self.value)]
 
 
@@ -62,16 +72,20 @@ DEFAULT_BUCKETS = (
 class Histogram:
     """Cumulative-bucket histogram (the promauto.NewHistogram equivalent)."""
 
-    def __init__(self, name: str, help_text: str, registry: "Registry",
-                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+    def __init__(self, name: str, help_text: str,
+                 registry: Optional["Registry"] = None,
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+                 label_str: str = ""):
         self.name = name
         self.help = help_text
+        self._label_str = label_str  # 'k="v",...' for labeled children
         self._buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self._buckets) + 1)  # per-bucket + overflow
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
-        registry._register(self)
+        if registry is not None:
+            registry._register(self)
 
     def observe(self, value: float) -> None:
         i = bisect.bisect_left(self._buckets, value)
@@ -123,15 +137,88 @@ class Histogram:
     def samples(self) -> List[Tuple[str, float]]:
         with self._lock:
             counts, total, s = list(self._counts), self._count, self._sum
+        lbl = self._label_str
+        bucket_prefix = f"{lbl}," if lbl else ""
+        suffix = f"{{{lbl}}}" if lbl else ""
         out: List[Tuple[str, float]] = []
         cum = 0
         for ub, n in zip(self._buckets, counts):
             cum += n
-            out.append((f'{self.name}_bucket{{le="{_fmt(ub)}"}}', cum))
-        out.append((f'{self.name}_bucket{{le="+Inf"}}', total))
-        out.append((f"{self.name}_sum", s))
-        out.append((f"{self.name}_count", total))
+            out.append((f'{self.name}_bucket{{{bucket_prefix}le="{_fmt(ub)}"}}', cum))
+        out.append((f'{self.name}_bucket{{{bucket_prefix}le="+Inf"}}', total))
+        out.append((f"{self.name}_sum{suffix}", s))
+        out.append((f"{self.name}_count{suffix}", total))
         return out
+
+
+class _LabeledFamily:
+    """A family of per-label-value child metrics under one metric name
+    (the promauto ``NewCounterVec``/``NewHistogramVec`` role).  Children are
+    created on first use of a label combination and exposed together; label
+    values are escaped per the Prometheus text format."""
+
+    def __init__(self, name: str, help_text: str, registry: "Registry",
+                 labelnames: Tuple[str, ...], kind: str):
+        self.name = name
+        self.help = help_text
+        self._labelnames = tuple(labelnames)
+        self._kind = kind
+        self._children: Dict[Tuple[str, ...], Counter] = {}
+        self._lock = threading.Lock()
+        registry._register(self)
+
+    def _make_child(self, label_str: str):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def labels(self, **labelvalues):
+        """Child metric for one label-value combination; unknown or missing
+        label names raise (a typo'd label must not mint a new series)."""
+        if set(labelvalues) != set(self._labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labelvalues)} != declared "
+                f"{sorted(self._labelnames)}")
+        key = tuple(str(labelvalues[n]) for n in self._labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                label_str = ",".join(
+                    f'{n}="{_escape_label_value(v)}"'
+                    for n, v in zip(self._labelnames, key))
+                child = self._make_child(label_str)
+                self._children[key] = child
+        return child
+
+    def kind(self) -> str:
+        return self._kind
+
+    def samples(self) -> List[Tuple[str, float]]:
+        with self._lock:
+            children = [self._children[k] for k in sorted(self._children)]
+        out: List[Tuple[str, float]] = []
+        for child in children:
+            out.extend(child.samples())
+        return out
+
+
+class LabeledCounter(_LabeledFamily):
+    def __init__(self, name: str, help_text: str, registry: "Registry",
+                 labelnames: Tuple[str, ...]):
+        super().__init__(name, help_text, registry, labelnames, "counter")
+
+    def _make_child(self, label_str: str) -> Counter:
+        return Counter(self.name, self.help, label_str=label_str)
+
+
+class LabeledHistogram(_LabeledFamily):
+    def __init__(self, name: str, help_text: str, registry: "Registry",
+                 labelnames: Tuple[str, ...],
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self._buckets_cfg = buckets
+        super().__init__(name, help_text, registry, labelnames, "histogram")
+
+    def _make_child(self, label_str: str) -> Histogram:
+        return Histogram(self.name, self.help, buckets=self._buckets_cfg,
+                         label_str=label_str)
 
 
 class Registry:
@@ -211,5 +298,34 @@ relists = Counter(
     "tpujob_operator_relists_total",
     "Full LIST+reconcile operations (initial informer sync and 410-Gone "
     "forced relists)",
+    REGISTRY,
+)
+
+# Span-derived observability series (the flight-recorder PR): latency broken
+# down by where one sync actually spent its time, recorded from the span
+# tree each root sync span closes (tpujob/obs/trace.py).
+queue_latency = Histogram(
+    "tpujob_operator_queue_latency_seconds",
+    "Time a work-queue item waited between becoming due and being dequeued",
+    REGISTRY,
+)
+api_request_duration = LabeledHistogram(
+    "tpujob_operator_api_request_duration_seconds",
+    "Latency of one API call made during a sync, by verb/resource/"
+    "status code",
+    REGISTRY,
+    ("verb", "resource", "code"),
+)
+sync_phase_duration = LabeledHistogram(
+    "tpujob_operator_sync_phase_duration_seconds",
+    "Latency of one reconcile phase (cache_get, claim, pod_diff, "
+    "service_diff, slow_start_create, status_update)",
+    REGISTRY,
+    ("phase",),
+)
+events_dropped = Counter(
+    "tpujob_operator_events_dropped_total",
+    "Events whose best-effort API write failed and was swallowed "
+    "(the local recorder tail still holds them)",
     REGISTRY,
 )

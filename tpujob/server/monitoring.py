@@ -1,6 +1,18 @@
-"""/metrics + /healthz HTTP listener (reference main.go:31-40)."""
+"""/metrics + /healthz + /debug/* HTTP listener (reference main.go:31-40).
+
+Beyond the reference's metrics/health surface, the listener serves the
+flight recorder's introspection payloads — the ``kubectl describe`` analog
+for the operator's own decision history:
+
+- ``/debug/jobs``                 index of tracked jobs
+- ``/debug/jobs/<ns>/<name>``     ordered per-job lifecycle timeline
+- ``/debug/traces/<corr-id>``     one sync's nested span tree
+
+All JSON, all read-only, all bounded (the recorder rotates history).
+"""
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -14,13 +26,35 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         pass
 
+    def _debug_payload(self, path: str):
+        """Resolve one /debug/* path to its JSON payload (None = 404)."""
+        flight = getattr(self.server, "flight", None)
+        if flight is None:
+            return None
+        parts = [p for p in path.split("/") if p]  # ["debug", ...]
+        if parts == ["debug", "jobs"]:
+            return flight.jobs_index()
+        if len(parts) == 4 and parts[:2] == ["debug", "jobs"]:
+            return flight.timeline(parts[2], parts[3])
+        if len(parts) == 3 and parts[:2] == ["debug", "traces"]:
+            return flight.trace(parts[2])
+        return None
+
     def do_GET(self):
-        if self.path.startswith("/metrics"):
+        path = self.path.partition("?")[0]
+        if path.startswith("/metrics"):
             body = REGISTRY.expose().encode()
             ctype = "text/plain; version=0.0.4"
             code = 200
-        elif self.path.startswith("/healthz"):
+        elif path.startswith("/healthz"):
             body, ctype, code = b"ok", "text/plain", 200
+        elif path.startswith("/debug/"):
+            payload = self._debug_payload(path)
+            if payload is None:
+                body, ctype, code = b'{"error": "not found"}', "application/json", 404
+            else:
+                body = json.dumps(payload, indent=2).encode()
+                ctype, code = "application/json", 200
         else:
             body, ctype, code = b"not found", "text/plain", 404
         self.send_response(code)
@@ -31,9 +65,11 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class MonitoringServer:
-    def __init__(self, host: str = "0.0.0.0", port: int = 8443):
+    def __init__(self, host: str = "0.0.0.0", port: int = 8443, flight=None):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.daemon_threads = True
+        # the flight recorder backing /debug/* (None = endpoints 404)
+        self.httpd.flight = flight
         self._thread: Optional[threading.Thread] = None
 
     @property

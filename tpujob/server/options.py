@@ -42,6 +42,13 @@ class ServerOption:
     # workqueue per-key failure backoff (client-go rate limiter bounds)
     workqueue_base_backoff_s: float = 0.005
     workqueue_max_backoff_s: float = 1200.0
+    # flight recorder + per-sync tracing (tpujob/obs): --no-trace restores
+    # the untraced hot path; the /debug/* endpoints then serve empty data
+    enable_tracing: bool = True
+    # a sync slower than this dumps its span tree to the log, token-bucket
+    # rate-limited per job (<= 0 disables the dump)
+    slow_sync_threshold_s: float = 5.0
+    flight_recorder_size: int = 256  # timeline entries retained per job
 
 
 class _LazyVersionAction(argparse.Action):
@@ -97,6 +104,19 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         dest="workqueue_base_backoff_s")
     parser.add_argument("--workqueue-max-backoff", type=float, default=1200.0,
                         dest="workqueue_max_backoff_s")
+    parser.add_argument("--trace", dest="enable_tracing", action="store_true",
+                        default=True,
+                        help="per-sync tracing + flight recorder (default on)")
+    parser.add_argument("--no-trace", dest="enable_tracing", action="store_false",
+                        help="disable tracing/flight recorder (restores the "
+                             "untraced reconcile hot path)")
+    parser.add_argument("--slow-sync-threshold", type=float, default=5.0,
+                        dest="slow_sync_threshold_s",
+                        help="dump the span tree of any sync slower than this "
+                             "many seconds, rate-limited per job (<=0 disables)")
+    parser.add_argument("--flight-recorder-size", type=int, default=256,
+                        dest="flight_recorder_size",
+                        help="timeline entries retained per job for /debug/jobs")
 
 
 def parse_options(argv: Optional[List[str]] = None) -> ServerOption:

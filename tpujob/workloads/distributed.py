@@ -315,3 +315,37 @@ def local_batch_slice(global_batch: int, env: Optional[ProcessEnv] = None) -> Tu
         )
     per = global_batch // pe.num_processes
     return pe.process_id * per, per
+
+
+def shard_map_supports_partial_manual() -> bool:
+    """Whether this jax can leave some mesh axes *auto* inside a shard_map
+    region (``axis_names``/``auto``).  Releases without the top-level
+    ``jax.shard_map`` export (< 0.5) accept the kwarg but their SPMD
+    partitioner crashes on the resulting program (PartitionId /
+    IsManualSubgroup check failures), so callers must fall back or skip."""
+    try:
+        from jax import shard_map as _  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+              axis_names=None):
+    """Version-compat ``shard_map``: the modern ``jax.shard_map`` surface
+    (``check_vma``, ``axis_names`` = the *manual* axes) translated for older
+    releases where it lives under ``jax.experimental`` and speaks
+    ``check_rep`` / ``auto`` (= the complement: axes left automatic)."""
+    try:
+        from jax import shard_map as _native
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _legacy
+
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_rep=check_vma, **kwargs)
+    kwargs = {} if axis_names is None else {"axis_names": axis_names}
+    return _native(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=check_vma, **kwargs)

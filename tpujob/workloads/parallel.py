@@ -38,10 +38,10 @@ from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpujob.workloads import distributed as dist
+from tpujob.workloads.distributed import shard_map
 
 
 # ---------------------------------------------------------------------------

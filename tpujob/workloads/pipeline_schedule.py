@@ -46,10 +46,10 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from tpujob.workloads import distributed as dist
+from tpujob.workloads.distributed import shard_map
 
 
 # action codes for the per-tick lax.switch

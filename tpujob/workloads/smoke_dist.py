@@ -29,8 +29,9 @@ log = logging.getLogger("tpujob.smoke_dist")
 def run(mesh=None) -> bool:
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from tpujob.workloads.distributed import shard_map
 
     if mesh is None:
         mesh = dist.make_mesh({"data": -1})
