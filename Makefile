@@ -11,7 +11,7 @@ SHELL := /bin/bash
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci ci-fast native lint codegen-verify unit unit-fast test trace-smoke e2e soak bench-smoke bench-controller dryrun images clean
+.PHONY: ci ci-fast native lint codegen-verify unit unit-fast test trace-smoke failover-smoke e2e soak bench-smoke bench-controller dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
@@ -40,9 +40,15 @@ unit:
 trace-smoke:
 	$(PY) scripts/trace_smoke.py
 
+# crash-only smoke (~10 s): one seeded leader hard-kill — the standby must
+# acquire the stale lease, cold-start and converge; every deposed-leader
+# write must be fenced (docs/failure-handling, "controller crash & HA")
+failover-smoke:
+	$(PY) scripts/failover_smoke.py
+
 # the tier-1 command from ROADMAP.md, verbatim (modulo $$-escaping for
 # make), so local and CI invocations agree on what "the tests pass" means
-test: trace-smoke
+test: trace-smoke failover-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # the operator/controller/kube/api tests only — the model-path suites
@@ -61,9 +67,11 @@ e2e:
 
 # chaos soak: the full job matrix under 5 seeded fault schedules (25 jobs;
 # API faults + watch kills + compaction + preemption storms), asserting the
-# system invariants after every convergence (docs/failure-handling)
+# system invariants after every convergence (docs/failure-handling).
+# --crash adds the controller-lifecycle tier per seed: hard-kill + cold
+# restart schedules and warm-standby failover with write-fencing probes.
 soak:
-	$(PY) soak.py --seeds 1,2,3,4,5
+	$(PY) soak.py --seeds 1,2,3,4,5 --crash
 
 # driver-contract smoke: the multi-chip sharding dryrun on 8 virtual devices
 dryrun:

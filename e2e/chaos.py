@@ -23,7 +23,22 @@ convergence the harness asserts the system invariants that define
    events and condition transitions (plus backoff decisions where the
    matrix crash-loops)
 
-Runnable:  python -m e2e.chaos --seed 7
+The crash tier (the crash-only-controller PR) faults the CONTROLLER
+itself, not just its transport:
+
+- ``run_crash_soak`` — seeded schedule of hard kills mid-sync (every
+  in-memory ledger dies with the instance; the API server survives)
+  followed by cold restarts; the restarted controller must rebuild from
+  durable state and converge without double-creating pods.
+- ``run_failover_soak`` — two-candidate warm-standby matrix: the leader is
+  hard-killed without releasing its lease, the standby must wait the lease
+  out, acquire, cold-start and converge every job; afterwards the deposed
+  leader's clients are probed and every write must be refused by the
+  fencing layer (invariant 7: **zero writes accepted from a fenced
+  leader**, validated both client-side and by the memserver's server-side
+  token check).
+
+Runnable:  python -m e2e.chaos --seed 7 [--mode api|crash|failover]
 (or the full seeded matrix via the repo-root ``soak.py`` / ``make soak``)
 """
 from __future__ import annotations
@@ -35,7 +50,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from e2e.cluster import E2ECluster
-from e2e.kubelet import PodScript
+from e2e.kubelet import KubeletSim, PodScript
 from tpujob.api import constants as c
 from tpujob.api.types import TPUJob
 from tpujob.controller.job_base import expectation_key
@@ -46,9 +61,16 @@ from tpujob.kube.chaos import (
     FaultInjectingAPIServer,
 )
 from tpujob.kube.client import RESOURCE_TPUJOBS, ClientSet
-from tpujob.kube.errors import ConflictError, NotFoundError
+from tpujob.kube.errors import (
+    AlreadyExistsError,
+    ConflictError,
+    FencedError,
+    NotFoundError,
+)
 from tpujob.kube.memserver import InMemoryAPIServer
 from tpujob.obs.trace import TRACER
+from tpujob.server.app import OperatorApp
+from tpujob.server.options import ServerOption
 
 
 # ---------------------------------------------------------------------------
@@ -387,6 +409,31 @@ def check_invariants(
     return problems
 
 
+def check_trace_ledger(
+    started0: int, closed0: int, settle_s: float = 5.0,
+) -> Tuple[List[str], Dict[str, int]]:
+    """The process-wide half of invariant 6: every root sync span that
+    started since the baseline also closed (workers drained cleanly — true
+    across controller incarnations, since a hard kill still joins the
+    workers the way process death ends their syscalls)."""
+    problems: List[str] = []
+    deadline = time.monotonic() + settle_s
+    while time.monotonic() < deadline:
+        started, closed = TRACER.counters()
+        if started == closed:
+            break
+        time.sleep(0.02)
+    started, closed = TRACER.counters()
+    synced = started - started0
+    if started != closed:
+        problems.append(
+            f"trace ledger unbalanced after drain: {synced} roots started, "
+            f"{closed - closed0} closed")
+    if synced <= 0:
+        problems.append("no traced syncs recorded under the fault schedule")
+    return problems, {"syncs": synced, "closed": closed - closed0}
+
+
 def check_trace_invariants(
     controller,
     cases: List[JobCase],
@@ -402,21 +449,7 @@ def check_trace_invariants(
     decisions where the case crash-loops).  Call AFTER the cluster stopped
     — a worker mid-sync legitimately holds an open root span.
     """
-    problems: List[str] = []
-    deadline = time.monotonic() + settle_s
-    while time.monotonic() < deadline:
-        s, c = TRACER.counters()
-        if s == c:
-            break
-        time.sleep(0.02)
-    s, c = TRACER.counters()
-    synced = s - started0
-    if s != c:
-        problems.append(
-            f"trace ledger unbalanced after drain: {synced} roots started, "
-            f"{c - closed0} closed")
-    if synced <= 0:
-        problems.append("no traced syncs recorded under the fault schedule")
+    problems, stats = check_trace_ledger(started0, closed0, settle_s)
     for case in cases:
         name = case.job.metadata.name
         tl = controller.flight.timeline("default", name)
@@ -457,7 +490,61 @@ def check_trace_invariants(
             if not any(ch["name"] == "queue_wait" for ch in root["children"]):
                 problems.append(
                     f"{name}: trace {e['corr_id']} missing queue_wait child")
-    return problems, {"syncs": synced, "closed": c - closed0}
+    return problems, stats
+
+
+def _soak_harness(
+    seed: int,
+    prefix_letter: str,
+    config: Optional[ChaosConfig],
+    cases: Optional[List[JobCase]],
+    fence: bool = False,
+) -> Tuple[str, List[JobCase], InMemoryAPIServer, FaultInjectingAPIServer,
+           ClientSet, StatusTracker, List[PodScript]]:
+    """Shared scaffolding for every soak mode: per-seed prefix + matrix,
+    inner server (optionally fence-validating), seeded chaos wrapper, admin
+    clients, terminal-flip tracker, and the flattened kubelet scripts."""
+    prefix = f"{prefix_letter}{seed}"
+    cases = cases if cases is not None else matrix(prefix)
+    inner = InMemoryAPIServer()
+    if fence:
+        inner.enable_fence_validation("default", "tpujob-operator")
+    chaos = FaultInjectingAPIServer(inner, seed=seed, config=config or SOAK_CHAOS)
+    admin = ClientSet(inner)
+    tracker = StatusTracker()
+    inner.hooks.append(tracker.hook)
+    scripts = [s for case in cases for s in case.scripts]
+    return prefix, cases, inner, chaos, admin, tracker, scripts
+
+
+def _converge_or_fail(admin: ClientSet, cases: List[JobCase], deadline: float,
+                      seed: int, detail: str = "") -> None:
+    """Poll until every matrix job converged or the deadline passes; raise
+    with the jobs' statuses on timeout."""
+    while time.monotonic() < deadline and not _all_converged(admin, cases):
+        time.sleep(0.05)
+    if not _all_converged(admin, cases):
+        jobs = {j.metadata.name: j.status.to_dict() for j in admin.tpujobs.list()}
+        raise AssertionError(
+            f"seed {seed}: jobs did not converge{detail}: {jobs}")
+
+
+def _all_converged(admin: ClientSet, cases: List[JobCase]) -> bool:
+    """Every matrix job reached a terminal condition (or its TTL reaped it)."""
+    jobs = {j.metadata.name: j for j in admin.tpujobs.list()}
+    for case in cases:
+        job = jobs.get(case.job.metadata.name)
+        if case.expect_deleted:
+            if job is not None:
+                return False
+            continue
+        if job is None:
+            return False
+        if not any(cond.status == "True"
+                   and cond.type in (c.JOB_SUCCEEDED, c.JOB_FAILED)
+                   for cond in job.status.conditions):
+            return False
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -502,14 +589,8 @@ def run_soak(
     invariant.  The fault schedule is a pure function of ``seed`` — rerun
     with the same seed to reproduce the same injection schedule.
     """
-    prefix = f"s{seed}"
-    cases = cases if cases is not None else matrix(prefix)
-    inner = InMemoryAPIServer()
-    chaos = FaultInjectingAPIServer(inner, seed=seed, config=config or SOAK_CHAOS)
-    admin = ClientSet(inner)
-    tracker = StatusTracker()
-    inner.hooks.append(tracker.hook)
-    scripts = [s for case in cases for s in case.scripts]
+    prefix, cases, inner, chaos, admin, tracker, scripts = _soak_harness(
+        seed, "s", config, cases)
     started = time.monotonic()
     trace_started0, trace_closed0 = TRACER.counters()
 
@@ -525,44 +606,15 @@ def run_soak(
         storm = PreemptionStorm(admin, seed, kills=storm_kills,
                                 prefix=prefix).start()
 
-        def converged() -> bool:
-            jobs = {j.metadata.name: j for j in admin.tpujobs.list()}
-            for case in cases:
-                job = jobs.get(case.job.metadata.name)
-                if case.expect_deleted:
-                    if job is not None:
-                        return False
-                    continue
-                if job is None:
-                    return False
-                if not any(cond.status == "True"
-                           and cond.type in (c.JOB_SUCCEEDED, c.JOB_FAILED)
-                           for cond in job.status.conditions):
-                    return False
-            return True
-
         deadline = started + timeout
-        while time.monotonic() < deadline and not converged():
-            time.sleep(0.05)
-        storm.stop()
-        if not converged():
-            jobs = {j.metadata.name: j.status.to_dict() for j in admin.tpujobs.list()}
-            raise AssertionError(
-                f"seed {seed}: jobs did not converge within {timeout}s: {jobs}")
+        try:
+            _converge_or_fail(admin, cases, deadline, seed,
+                              f" within {timeout}s")
+        finally:
+            storm.stop()
 
-        # quiescence: wait for the ledger, cleanup deletes and TTL reaps to
-        # settle (they retry through injected faults), then hold the
-        # invariants for two consecutive observations
-        stable = 0
-        while time.monotonic() < deadline and stable < 2:
-            problems = check_invariants(admin, controller, cases, tracker, chaos)
-            stable = stable + 1 if not problems else 0
-            if stable < 2:
-                # sleep between observations even when clean — back-to-back
-                # checks microseconds apart are one observation, not two, and
-                # would miss an in-flight cleanup landing moments later
-                time.sleep(0.1)
-        problems = check_invariants(admin, controller, cases, tracker, chaos)
+        problems = _settle_invariants(admin, controller, cases, tracker, chaos,
+                                      deadline)
         if problems:
             raise AssertionError(
                 f"seed {seed}: invariants violated:\n  " + "\n  ".join(problems))
@@ -593,12 +645,337 @@ def run_soak(
     return report
 
 
+# ---------------------------------------------------------------------------
+# controller lifecycle faults: hard kill / cold restart / warm-standby failover
+# ---------------------------------------------------------------------------
+
+
+def _soak_opt(opt_overrides: Optional[Dict[str, Any]] = None,
+              leader_election: bool = False) -> ServerOption:
+    """ServerOption for a soak controller: short leases so a crashed
+    leader's stale lease expires within the run, soak-tightened backoffs.
+    The lease namespace is pinned to 'default' — the namespace the failover
+    soak's server-side fence validation watches — so an OPERATOR_NAMESPACE
+    env var on the host cannot divert the lease out from under it."""
+    opt = ServerOption(
+        monitoring_port=0,
+        enable_leader_election=leader_election,
+        leader_election_namespace="default",
+        lease_duration_s=0.6, renew_deadline_s=0.3, retry_period_s=0.05,
+    )
+    for k, v in {**SOAK_OPT_OVERRIDES, **(opt_overrides or {})}.items():
+        if not hasattr(opt, k):
+            raise TypeError(f"unknown ServerOption override {k!r}")
+        setattr(opt, k, v)
+    return opt
+
+
+def _start_app(transport, opt_overrides: Optional[Dict[str, Any]] = None,
+               leader_election: bool = False) -> OperatorApp:
+    """Cold-start one operator instance.  Without leader election the
+    controller starts synchronously (run() returns only after the
+    wait-for-cache-sync barrier); with it, the elector thread acquires in
+    the background and the controller cold-starts on acquisition."""
+    app = OperatorApp(_soak_opt(opt_overrides, leader_election), transport=transport)
+    app.run(block=False)
+    return app
+
+
+def _wait_for(predicate, timeout: float, interval: float = 0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return bool(predicate())
+
+
+def _settle_invariants(admin: ClientSet, controller, cases: List[JobCase],
+                       tracker: StatusTracker,
+                       chaos: Optional[FaultInjectingAPIServer],
+                       deadline: float) -> List[str]:
+    """Quiescence: wait for the ledger, cleanup deletes and TTL reaps to
+    settle (they retry through injected faults), hold the invariants for
+    two spaced observations, then return the final check's problems (empty
+    = clean).  The sleep between observations matters even when clean —
+    back-to-back checks microseconds apart are one observation, not two,
+    and would miss an in-flight cleanup landing moments later."""
+    stable = 0
+    while time.monotonic() < deadline and stable < 2:
+        problems = check_invariants(admin, controller, cases, tracker, chaos)
+        stable = stable + 1 if not problems else 0
+        if stable < 2:
+            time.sleep(0.1)
+    return check_invariants(admin, controller, cases, tracker, chaos)
+
+
+def run_crash_soak(
+    seed: int,
+    config: Optional[ChaosConfig] = None,
+    cases: Optional[List[JobCase]] = None,
+    kills: int = 2,
+    storm_kills: int = 4,
+    timeout: float = 60.0,
+    opt_overrides: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Crash-only schedule: hard-kill the controller mid-run, cold-restart.
+
+    Every kill discards ALL in-memory controller state — expectations,
+    restart-delta ledger, crash-loop damper, flight recorder, informer
+    caches — while the API server (and the kubelet) keep running.  Each
+    cold restart must rebuild from durable state behind the cache-sync
+    barrier and converge the full matrix without double-creating pods or
+    losing restart accounting.  The kill/restart schedule is seeded.
+    """
+    prefix, cases, inner, chaos, admin, tracker, scripts = _soak_harness(
+        seed, "c", config, cases)
+    rng = random.Random(f"{seed}:controller-kill")
+    started = time.monotonic()
+    trace_started0, trace_closed0 = TRACER.counters()
+
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts)
+    app = _start_app(chaos, opt_overrides)
+    kubelet.start()
+    storm = PreemptionStorm(admin, seed, kills=storm_kills, prefix=prefix).start()
+    kill_log: List[Dict[str, float]] = []
+    try:
+        for case in cases:
+            admin.tpujobs.create(case.job)
+        for _ in range(kills):
+            # seeded mid-flight kill: the matrix is actively churning
+            time.sleep(rng.uniform(0.4, 1.2))
+            app.hard_kill()
+            headless_s = rng.uniform(0.05, 0.4)
+            time.sleep(headless_s)  # the cluster runs unsupervised meanwhile
+            app = _start_app(chaos, opt_overrides)
+            kill_log.append({"headless_s": round(headless_s, 3)})
+        deadline = started + timeout
+        _converge_or_fail(admin, cases, deadline, seed,
+                          f" within {timeout}s across {kills} controller "
+                          "kill(s)")
+        storm.stop()
+        problems = _settle_invariants(admin, app.controller, cases, tracker,
+                                      chaos, deadline)
+        if problems:
+            raise AssertionError(
+                f"seed {seed}: invariants violated after controller kills:\n  "
+                + "\n  ".join(problems))
+        report = {
+            "mode": "crash",
+            "seed": seed,
+            "jobs": len(cases),
+            "controller_kills": kills,
+            "kill_schedule": kill_log,
+            "duration_s": round(time.monotonic() - started, 3),
+            "api_faults": len(chaos.injected),
+            "storm_strikes": storm.struck,
+            "invariants": "ok",
+        }
+    finally:
+        storm.stop()
+        kubelet.stop()
+        app.shutdown()
+    # per-job timeline kinds are NOT asserted here: the recorder died with
+    # each incarnation by design, so only the process-wide ledger must hold
+    trace_problems, trace_stats = check_trace_ledger(trace_started0, trace_closed0)
+    if trace_problems:
+        raise AssertionError(
+            f"seed {seed}: trace ledger violated across controller kills:\n  "
+            + "\n  ".join(trace_problems))
+    report["trace"] = trace_stats
+    return report
+
+
+def run_failover_soak(
+    seed: int,
+    config: Optional[ChaosConfig] = None,
+    cases: Optional[List[JobCase]] = None,
+    storm_kills: int = 4,
+    timeout: float = 60.0,
+    opt_overrides: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Warm-standby failover under faults, with write fencing asserted.
+
+    Two candidates run leader election over one lease (with server-side
+    fencing validation enabled on the API server).  The leader is
+    hard-killed WITHOUT releasing its lease; the standby must wait the
+    stale lease out, acquire (bumping the fencing generation), cold-start
+    and converge every job.  A controller that loses leadership to an
+    injected fault mid-run is treated crash-only too: it exits and the
+    harness cold-starts a replacement, the way a Deployment restarts a
+    crashed operator.  After convergence the deposed leader's clients are
+    probed: every mutating call must be refused — locally once its elector
+    noticed, and by the server-side token check when the harness resurrects
+    the elector's stale belief (the paused-then-resumed race).  Invariant
+    7: zero writes accepted from a fenced leader.
+    """
+    prefix, cases, inner, chaos, admin, tracker, scripts = _soak_harness(
+        seed, "f", config, cases, fence=True)
+    rng = random.Random(f"{seed}:failover")
+    started = time.monotonic()
+    trace_started0, trace_closed0 = TRACER.counters()
+
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts)
+    leader = _start_app(chaos, opt_overrides, leader_election=True)
+    if not _wait_for(lambda: leader.elector.is_leader
+                     and leader.controller.job_informer.has_synced(), 10):
+        raise AssertionError(f"seed {seed}: initial leader never started leading")
+    standby = _start_app(chaos, opt_overrides, leader_election=True)
+    kubelet.start()
+    storm = PreemptionStorm(admin, seed, kills=storm_kills, prefix=prefix).start()
+    apps = [leader, standby]
+    current = standby
+    restarts = 0
+    try:
+        for case in cases:
+            admin.tpujobs.create(case.job)
+        # hard-kill the leader mid-flight: stale lease stays in place
+        time.sleep(rng.uniform(0.4, 1.2))
+        leader.hard_kill()
+        lease_wait = leader.opt.lease_duration_s + 5.0
+        if not _wait_for(lambda: standby.elector.is_leader, lease_wait):
+            raise AssertionError(
+                f"seed {seed}: standby never acquired the stale lease")
+
+        deadline = started + timeout
+        while time.monotonic() < deadline and not _all_converged(admin, cases):
+            if current.stop_event.is_set():
+                # an injected fault burst cost the leader its lease renewal:
+                # crash-only — reap it and cold-start a replacement
+                current.hard_kill()
+                current = _start_app(chaos, opt_overrides, leader_election=True)
+                apps.append(current)
+                restarts += 1
+            time.sleep(0.05)
+        storm.stop()
+        # the loop above already waited out the deadline; this is the final
+        # converged-or-raise check with the failover context attached
+        _converge_or_fail(admin, cases, time.monotonic(), seed,
+                          f" within {timeout}s after failover "
+                          f"(+{restarts} crash-restart(s))")
+        problems = _settle_invariants(admin, current.controller, cases, tracker,
+                                      chaos, deadline)
+
+        # invariant 7: the deposed leader cannot write.  (a) local check:
+        # its elector knows it stopped leading, so the fence slams shut at
+        # the transport; (b) server-side check: resurrect the stale belief
+        # (the paused-process race — the elector still thinks it leads) and
+        # the memserver must reject the stale token against the live lease.
+        fence_probes = 0
+        fence_rejected = 0
+        zombies = [a for a in apps if a is not current]
+        probe_pod = {"metadata": {"name": f"{prefix}-zombie-pod",
+                                  "namespace": "default"}}
+
+        def probe(op) -> str:
+            """One probe's verdict: 'rejected' | 'accepted' | 'inconclusive'.
+            Chaos can fault any single call before it reaches the fence
+            check, so retry through transient injected faults.  A 404/409
+            from the REAL store is proof the call got PAST the fence (the
+            chaos layer never mints those two for the probe verbs' targets)
+            — e.g. an unfenced delete of the absent zombie pod answers
+            NotFound, which must count as a breach, not as chaos noise."""
+            for _ in range(12):
+                try:
+                    op()
+                except FencedError:
+                    return "rejected"
+                except (NotFoundError, AlreadyExistsError):
+                    return "accepted"  # reached storage: fencing failed
+                except Exception:
+                    continue  # injected chaos fault, not a fencing verdict
+                return "accepted"
+            return "inconclusive"
+
+        fence_inconclusive = 0
+        from tpujob.kube.fencing import FencedTransport
+
+        for zombie in zombies:
+            # a resumed process writes over a FRESH connection carrying its
+            # stale token — not through its severed (dead) kill switch — so
+            # probe via a new FencedTransport bound to the zombie's elector
+            zt = FencedTransport(chaos, fence=zombie.elector.current_token)
+            for resurrect in (False, True):
+                if resurrect:
+                    zombie.elector.is_leader = True  # stale belief, stale token
+                for op in (
+                    lambda t=zt: t.create("pods", dict(probe_pod)),
+                    lambda t=zt: t.delete(
+                        "pods", "default", f"{prefix}-zombie-pod"),
+                ):
+                    fence_probes += 1
+                    verdict = probe(op)
+                    if verdict == "rejected":
+                        fence_rejected += 1
+                    elif verdict == "inconclusive":
+                        fence_inconclusive += 1
+                zombie.elector.is_leader = False
+        accepted = fence_probes - fence_rejected - fence_inconclusive
+        if accepted:
+            problems.append(
+                f"fencing: {accepted} of {fence_probes} deposed-leader "
+                "writes were ACCEPTED")
+        if fence_rejected == 0:
+            problems.append(
+                f"fencing: no probe produced a rejection verdict "
+                f"({fence_inconclusive} of {fence_probes} inconclusive "
+                "under chaos)")
+        if any(p.metadata.name == f"{prefix}-zombie-pod" for p in admin.pods.list()):
+            problems.append("fencing: zombie probe pod was committed to the server")
+        if inner.fence_rejections == [] and fence_probes:
+            problems.append(
+                "fencing: server-side validation never fired (stale tokens "
+                "unchecked)")
+        if problems:
+            raise AssertionError(
+                f"seed {seed}: failover invariants violated:\n  "
+                + "\n  ".join(problems))
+        report = {
+            "mode": "failover",
+            "seed": seed,
+            "jobs": len(cases),
+            "candidates": len(apps),
+            "crash_restarts": restarts,
+            "duration_s": round(time.monotonic() - started, 3),
+            "api_faults": len(chaos.injected),
+            "storm_strikes": storm.struck,
+            "fence": {
+                "probes": fence_probes,
+                "rejected": fence_rejected,
+                "inconclusive": fence_inconclusive,
+                "server_checked": inner.fence_checked,
+                "server_rejections": len(inner.fence_rejections),
+            },
+            "invariants": "ok",
+        }
+    finally:
+        storm.stop()
+        kubelet.stop()
+        for a in apps:
+            if a is current:
+                a.shutdown()
+            else:
+                a.hard_kill()
+    trace_problems, trace_stats = check_trace_ledger(trace_started0, trace_closed0)
+    if trace_problems:
+        raise AssertionError(
+            f"seed {seed}: trace ledger violated across failover:\n  "
+            + "\n  ".join(trace_problems))
+    report["trace"] = trace_stats
+    return report
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     import json
 
     parser = argparse.ArgumentParser(description="one seeded chaos soak run")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--mode", choices=("api", "crash", "failover"),
+                        default="api",
+                        help="api = transport faults only; crash = + seeded "
+                             "controller kills; failover = warm-standby "
+                             "leader kill + fencing probes")
     parser.add_argument("--storm-kills", type=int, default=6)
     parser.add_argument("--timeout", type=float, default=60.0)
     parser.add_argument("--verbose", action="store_true")
@@ -607,7 +984,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         import logging
 
         logging.disable(logging.CRITICAL)
-    report = run_soak(args.seed, storm_kills=args.storm_kills, timeout=args.timeout)
+    if args.mode == "crash":
+        report = run_crash_soak(args.seed, storm_kills=args.storm_kills,
+                                timeout=args.timeout)
+    elif args.mode == "failover":
+        report = run_failover_soak(args.seed, storm_kills=args.storm_kills,
+                                   timeout=args.timeout)
+    else:
+        report = run_soak(args.seed, storm_kills=args.storm_kills,
+                          timeout=args.timeout)
     print(json.dumps(report, sort_keys=True))
     return 0
 

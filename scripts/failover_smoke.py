@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Failover smoke: one seeded leader-kill must converge under the standby.
+
+The fast single-seed slice of the crash-only acceptance gate (``make
+failover-smoke``, wired as a ``make test`` prerequisite; budget ~10 s):
+
+- two operator candidates elect over one lease with server-side fencing
+  validation on the in-memory API server;
+- the leader is hard-killed WITHOUT releasing its lease mid-run;
+- the standby must wait the stale lease out, acquire (bumping the fencing
+  generation), cold-start behind the cache-sync barrier, and converge a
+  reduced two-job matrix;
+- every probe write from the deposed leader must be refused by the fencing
+  layer, and all chaos invariants must hold.
+
+No API-transport faults here — the full fault mix runs in ``make soak
+--crash``; this smoke isolates the lifecycle/fencing path so a failure
+points straight at the handover machinery.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from e2e.chaos import ChaosConfig, matrix, run_failover_soak
+
+# fault-free transport: the smoke isolates controller-lifecycle faults
+NO_API_FAULTS = ChaosConfig(
+    error_rate=0.0, timeout_rate=0.0, conflict_rate=0.0, latency_rate=0.0,
+    kill_watch_every=0, compact_every=0, duplicate_event_rate=0.0,
+)
+
+
+def main() -> int:
+    logging.disable(logging.CRITICAL)  # the kill makes ERROR lines pure noise
+    seed = 17
+    # reduced matrix: the master+worker TTL case and the ExitCode restart
+    # case — cleanup/GC and controller-owned restart both cross the handover
+    cases = matrix(f"f{seed}")[:2]
+    report = run_failover_soak(seed, config=NO_API_FAULTS, cases=cases,
+                               storm_kills=2, timeout=30.0)
+    fence = report["fence"]
+    assert report["invariants"] == "ok"
+    assert fence["rejected"] == fence["probes"] > 0, fence
+    assert fence["server_rejections"] > 0, fence
+    print(f"failover-smoke: OK (jobs={report['jobs']} "
+          f"candidates={report['candidates']} "
+          f"fence_rejected={fence['rejected']}/{fence['probes']} "
+          f"server_rejections={fence['server_rejections']} "
+          f"in {report['duration_s']}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,13 +8,20 @@ history compaction, duplicate events, and a kubelet-level preemption storm.
 Every run must converge and hold the system invariants; the same seed
 reproduces the same fault schedule byte for byte.
 
+``--crash`` adds the controller-lifecycle tier per seed: a seeded schedule
+of controller hard-kills + cold restarts (``run_crash_soak``) and a
+two-candidate warm-standby failover with write-fencing probes
+(``run_failover_soak``) — the crash-only acceptance gate: all invariants
+hold across every kill, and zero writes are accepted from a fenced leader.
+
 Usage:
     python soak.py                      # default 5 seeds x 5 jobs = 25 jobs
     python soak.py --seeds 7,8,9        # specific seeds
     python soak.py --seed-count 20      # a longer randomized-matrix soak
+    python soak.py --crash              # + controller-kill/failover tiers
 
 Exit status 0 = every seed converged with all invariants intact; one JSON
-report line per seed on stdout (make soak).
+report line per seed (and per crash-tier run) on stdout (make soak).
 """
 from __future__ import annotations
 
@@ -24,7 +31,7 @@ import sys
 import time
 from typing import List, Optional
 
-from e2e.chaos import run_soak
+from e2e.chaos import run_crash_soak, run_failover_soak, run_soak
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -35,6 +42,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="run seeds 1..N instead of --seeds")
     parser.add_argument("--storm-kills", type=int, default=6,
                         help="preemption-storm strikes per seed")
+    parser.add_argument("--crash", action="store_true",
+                        help="also run the controller-kill and warm-standby "
+                             "failover schedules for every seed")
     parser.add_argument("--timeout", type=float, default=60.0,
                         help="per-seed convergence timeout (s)")
     parser.add_argument("--verbose", action="store_true",
@@ -48,22 +58,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     seeds = (list(range(1, args.seed_count + 1)) if args.seed_count
              else [int(s) for s in args.seeds.split(",") if s.strip()])
 
+    runs = [("api", lambda seed: run_soak(
+        seed, storm_kills=args.storm_kills, timeout=args.timeout))]
+    if args.crash:
+        runs.append(("crash", lambda seed: run_crash_soak(
+            seed, storm_kills=args.storm_kills, timeout=args.timeout)))
+        runs.append(("failover", lambda seed: run_failover_soak(
+            seed, storm_kills=args.storm_kills, timeout=args.timeout)))
+
     failures = 0
     total_jobs = 0
     started = time.monotonic()
     for seed in seeds:
-        try:
-            report = run_soak(seed, storm_kills=args.storm_kills,
-                              timeout=args.timeout)
-        except AssertionError as e:
-            failures += 1
-            print(json.dumps({"seed": seed, "invariants": "VIOLATED",
-                              "detail": str(e)}, sort_keys=True))
-            continue
-        total_jobs += report["jobs"]
-        print(json.dumps(report, sort_keys=True))
+        for mode, fn in runs:
+            try:
+                report = fn(seed)
+            except AssertionError as e:
+                failures += 1
+                print(json.dumps({"seed": seed, "mode": mode,
+                                  "invariants": "VIOLATED",
+                                  "detail": str(e)}, sort_keys=True))
+                continue
+            total_jobs += report["jobs"]
+            print(json.dumps(report, sort_keys=True))
     summary = {
         "seeds": len(seeds),
+        "modes": [m for m, _ in runs],
+        "runs": len(seeds) * len(runs),
+        # distinct job objects across all runs: every (seed, mode) pair
+        # submits its own prefixed matrix
         "jobs": total_jobs,
         "failures": failures,
         "duration_s": round(time.monotonic() - started, 3),

@@ -1,0 +1,495 @@
+"""Crash-only controller: restart/failover chaos, lease release, cold start.
+
+Covers the leader-election edge cases (racing candidates, renewal failure,
+lease-time parsing), graceful vs. hard shutdown semantics, cold-start
+recovery (no double-create after a restart, damper reconstruction from
+durable status), and the crash/failover soak smokes; the multi-seed crash
+matrix is the slow tier (``make soak --crash`` shape).
+"""
+import threading
+import time
+
+import pytest
+
+from e2e.chaos import ChaosConfig, matrix, run_crash_soak, run_failover_soak
+from tpujob.api import constants as c
+from tpujob.controller.job_base import ControllerConfig
+from tpujob.controller.reconciler import TPUJobController
+from tpujob.kube.client import RESOURCE_PODS, ClientSet
+from tpujob.kube.errors import ApiError
+from tpujob.kube.memserver import ADDED, InMemoryAPIServer
+from tpujob.obs.recorder import CONTROLLER_TIMELINE_KEY
+from tpujob.server import metrics
+from tpujob.server.app import OperatorApp
+from tpujob.server.leader_election import LeaderElector, parse_lease_time, rfc3339micro
+from tpujob.server.options import ServerOption
+
+from jobtestutil import new_tpujob
+
+# fault-free chaos config for the lifecycle smokes: failures here must point
+# at the handover machinery, not at an injected 500
+NO_FAULTS = ChaosConfig(error_rate=0.0, timeout_rate=0.0, conflict_rate=0.0,
+                        latency_rate=0.0)
+
+
+def _app(transport=None, leader_election=True, **opt_kw) -> OperatorApp:
+    # lease namespace pinned: a host OPERATOR_NAMESPACE must not move it
+    kw = dict(monitoring_port=0, enable_leader_election=leader_election,
+              leader_election_namespace="default",
+              lease_duration_s=0.6, renew_deadline_s=0.3,
+              retry_period_s=0.05, resync_period_s=0.5)
+    kw.update(opt_kw)
+    return OperatorApp(ServerOption(**kw), transport=transport)
+
+
+def _wait(predicate, timeout=5.0, interval=0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return bool(predicate())
+
+
+# ---------------------------------------------------------------------------
+# graceful release vs. hard kill
+# ---------------------------------------------------------------------------
+
+
+def test_graceful_shutdown_zeroes_holder_identity():
+    """OperatorApp.shutdown releases the lease by zeroing holderIdentity —
+    the lease object (and its leaseTransitions generation) survives."""
+    server = InMemoryAPIServer()
+    app = _app(server)
+    app.run(block=False)
+    assert _wait(lambda: app.elector.is_leader)
+    app.shutdown()
+    lease = server.get("leases", "default", "tpujob-operator")
+    assert lease["spec"]["holderIdentity"] == ""
+    assert lease["spec"]["leaseTransitions"] == 0  # preserved, not reset
+
+
+def test_standby_acquires_immediately_after_graceful_release():
+    """A released lease is acquirable NOW — no lease_duration wait."""
+    server = InMemoryAPIServer()
+    app = _app(server, lease_duration_s=30.0)  # expiry alone would take 30 s
+    app.run(block=False)
+    assert _wait(lambda: app.elector.is_leader)
+    app.shutdown()
+    standby = LeaderElector(server, identity="standby", lease_duration=30.0,
+                            renew_deadline=0.3, retry_period=0.05)
+    t0 = time.monotonic()
+    assert standby._try_acquire_or_renew()
+    assert time.monotonic() - t0 < 1.0
+    lease = server.get("leases", "default", "tpujob-operator")
+    assert lease["spec"]["holderIdentity"] == "standby"
+    assert lease["spec"]["leaseTransitions"] == 1  # generation bumped
+
+
+def test_hard_kill_leaves_the_lease_held():
+    """A hard-killed (crashed) leader must NOT release: the standby has to
+    wait out lease_duration, and the stale lease stays attributed."""
+    server = InMemoryAPIServer()
+    app = _app(server)
+    app.run(block=False)
+    assert _wait(lambda: app.elector.is_leader)
+    identity = app.elector.identity
+    app.hard_kill()
+    lease = server.get("leases", "default", "tpujob-operator")
+    assert lease["spec"]["holderIdentity"] == identity
+    # ...and a second shutdown() after the hard kill must not release either
+    app.shutdown()
+    assert server.get("leases", "default", "tpujob-operator")[
+        "spec"]["holderIdentity"] == identity
+
+
+def test_failed_cold_start_after_acquiring_is_fatal(monkeypatch):
+    """If the controller cannot start after leadership is won (e.g. caches
+    never sync), the app must EXIT — not linger as a zombie leader holding
+    the lease while doing nothing.  The clean stop then releases the lease
+    so a standby takes over immediately."""
+    server = InMemoryAPIServer()
+    app = _app(server)
+
+    def boom(*a, **k):
+        raise RuntimeError("informer caches failed to sync")
+
+    monkeypatch.setattr(app.controller, "run", boom)
+    app.run(block=False)
+    assert _wait(lambda: app.stop_event.is_set()), "failed start not fatal"
+    app.shutdown()
+    assert server.get("leases", "default", "tpujob-operator")[
+        "spec"]["holderIdentity"] == ""
+
+
+def test_release_never_clobbers_another_holder():
+    server = InMemoryAPIServer()
+    e = LeaderElector(server, identity="op-a")
+    assert e._try_acquire_or_renew()
+    # another candidate takes over (expiry + steal simulated directly)
+    lease = server.get("leases", "default", "tpujob-operator")
+    lease["spec"]["holderIdentity"] = "op-b"
+    server.update("leases", lease)
+    e.release()
+    assert server.get("leases", "default", "tpujob-operator")[
+        "spec"]["holderIdentity"] == "op-b"
+
+
+# ---------------------------------------------------------------------------
+# leader-election edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_stable_identity_reacquire_bumps_generation():
+    """A restarted process with a stable configured identity re-acquiring
+    its predecessor's lease must mint a NEW fencing generation — keying on
+    the holder string alone would reproduce the dead twin's exact token and
+    a paused twin could write through the fence.  A live leader's renewals,
+    by contrast, keep the generation stable for the whole tenure."""
+    server = InMemoryAPIServer()
+    e1 = LeaderElector(server, identity="op-stable", lease_duration=5)
+    assert e1._try_acquire_or_renew()
+    e1.is_leader = True
+    gen1 = e1._generation
+    assert e1._try_acquire_or_renew()  # renewal
+    assert e1._generation == gen1
+    # "restart": a fresh elector, same identity, not yet leading
+    e2 = LeaderElector(server, identity="op-stable", lease_duration=5)
+    assert e2._try_acquire_or_renew()
+    assert e2._generation == gen1 + 1
+    assert server.get("leases", "default", "tpujob-operator")[
+        "spec"]["leaseTransitions"] == gen1 + 1
+
+
+def test_hard_kill_severs_in_flight_writes():
+    """hard_kill models SIGKILL: the instance's transport is severed, so a
+    worker mid-sync dies on its NEXT API call instead of tidily finishing
+    the sync — already-committed writes stay, the rest never happen."""
+    from tpujob.kube.errors import ApiError
+
+    server = InMemoryAPIServer()
+    app = _app(server, leader_election=False)  # no fence masking the sever
+    app.run(block=False)
+    app.hard_kill()
+    with pytest.raises(ApiError, match="severed"):
+        app.clients.server.create("pods", {"metadata": {"name": "x"}})
+    # the cluster itself is untouched: only this instance died
+    server.create("pods", {"metadata": {"name": "kubelet-still-alive"}})
+
+
+def test_two_candidates_racing_one_lease_exactly_one_wins():
+    """Simultaneous acquire attempts: the loser gets AlreadyExists/409 from
+    optimistic concurrency, never a shared win."""
+    for round_n in range(5):
+        server = InMemoryAPIServer()
+        barrier = threading.Barrier(2)
+        wins = []
+        lock = threading.Lock()
+
+        def racer(identity):
+            e = LeaderElector(server, identity=identity, lease_duration=5)
+            barrier.wait()
+            if e._try_acquire_or_renew():
+                with lock:
+                    wins.append(identity)
+
+        ts = [threading.Thread(target=racer, args=(f"op-{i}",)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=5)
+        assert len(wins) == 1, f"round {round_n}: winners {wins}"
+        holder = server.get("leases", "default", "tpujob-operator")[
+            "spec"]["holderIdentity"]
+        assert holder == wins[0]
+
+
+def test_renewal_failure_past_deadline_loses_leadership_exactly_once():
+    class FlakyLeases:
+        """Transport that starts failing every lease write on demand."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.fail = threading.Event()
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+        def _gate(self):
+            if self.fail.is_set():
+                raise ApiError("injected lease-write outage")
+
+        def create(self, resource, obj):
+            if resource == "leases":
+                self._gate()
+            return self.inner.create(resource, obj)
+
+        def update(self, resource, obj):
+            if resource == "leases":
+                self._gate()
+            return self.inner.update(resource, obj)
+
+    transport = FlakyLeases(InMemoryAPIServer())
+    losses = []
+    e = LeaderElector(transport, identity="op-1", lease_duration=0.4,
+                      renew_deadline=0.2, retry_period=0.05,
+                      on_stopped_leading=lambda: losses.append(1))
+    stop = threading.Event()
+    t = threading.Thread(target=e.run, args=(stop,), daemon=True)
+    t.start()
+    assert _wait(lambda: e.is_leader)
+    transport.fail.set()
+    t.join(timeout=5)  # loss is fatal: run() must return on its own
+    assert not t.is_alive()
+    assert not e.is_leader
+    assert losses == [1]  # exactly once
+    assert e.current_token() is None  # the fence slammed shut
+    stop.set()
+
+
+def test_slow_cold_start_does_not_block_lease_renewal():
+    """on_started_leading runs in its own thread (client-go's
+    OnStartedLeading goroutine): a controller cold start that outlasts
+    lease_duration must NOT starve renewals, or a standby would steal the
+    lease from a healthy leader mid cold start (split-brain window)."""
+    server = InMemoryAPIServer()
+    started, release = threading.Event(), threading.Event()
+
+    def slow_cold_start():
+        started.set()
+        release.wait(10)
+
+    e = LeaderElector(server, identity="op-1", lease_duration=0.4,
+                      renew_deadline=0.2, retry_period=0.05,
+                      on_started_leading=slow_cold_start)
+    stop = threading.Event()
+    t = threading.Thread(target=e.run, args=(stop,), daemon=True)
+    t.start()
+    try:
+        assert started.wait(3)
+        time.sleep(1.0)  # well past lease_duration, cold start still running
+        challenger = LeaderElector(server, identity="op-2", lease_duration=0.4,
+                                   renew_deadline=0.2, retry_period=0.05)
+        assert not challenger._try_acquire_or_renew(), \
+            "lease expired during cold start: renewals were starved"
+        assert e.is_leader
+    finally:
+        release.set()
+        stop.set()
+        t.join(timeout=3)
+
+
+def test_parse_lease_time_offsets_and_garbage_fail_closed():
+    t = parse_lease_time("2026-08-03T01:02:03.000004Z")
+    assert t is not None
+    # RFC3339 offsets: another serializer's +00:00 and a non-UTC offset
+    assert parse_lease_time("2026-08-03T01:02:03.000004+00:00") == t
+    assert parse_lease_time("2026-08-03T03:02:03.000004+02:00") == t
+    # bare epoch numbers (older lease records)
+    assert parse_lease_time(1700000000) == 1700000000.0
+    assert parse_lease_time("1700000000.5") == 1700000000.5
+    # garbage fails CLOSED (None), never epoch 0 — treating a live leader's
+    # unparseable renewTime as expired would let a standby steal the lease
+    for garbage in ("not-a-time", "2026-13-45T99:99:99Z", "", None,
+                    ["2026-08-03"], {"t": 1}):
+        assert parse_lease_time(garbage) is None
+    # round trip through the wire format
+    assert parse_lease_time(rfc3339micro(t)) == pytest.approx(t, abs=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# cold-start recovery
+# ---------------------------------------------------------------------------
+
+
+def test_cold_restart_does_not_double_create():
+    """Hard-kill the controller after it built a job's pods; a cold restart
+    must adopt the live pods through the cache-sync barrier, not re-create
+    them (the expectations are rebuilt as satisfied by construction)."""
+    server = InMemoryAPIServer()
+    clients = ClientSet(server)
+    clients.tpujobs.create(new_tpujob(workers=2))
+    creates = []
+    server.hooks.append(lambda ev, res, obj:
+                        creates.append(obj["metadata"]["name"])
+                        if ev == ADDED and res == RESOURCE_PODS else None)
+
+    app = _app(server, leader_election=False)
+    app.run(block=False)
+    assert _wait(lambda: len(clients.pods.list()) == 3)  # master + 2 workers
+    app.hard_kill()
+    created_before = list(creates)
+
+    app2 = _app(server, leader_election=False)
+    app2.run(block=False)  # returns only after the cache-sync barrier
+    try:
+        # give the restarted controller time to (wrongly) act
+        time.sleep(0.5)
+        assert creates == created_before, "cold restart re-created pods"
+        assert len(clients.pods.list()) == 3
+    finally:
+        app2.shutdown()
+
+
+def test_cold_start_rebuilds_restart_backoff_from_status():
+    """A restarted controller must reconstruct the crash-loop damper from
+    status.replicaStatuses[].restarts + condition timestamps — NOT start at
+    zero and prompt-restart the whole crash loop at full speed."""
+    server = InMemoryAPIServer()
+    job = new_tpujob(master=None, workers=1,
+                     restart_policy=c.RESTART_POLICY_EXIT_CODE)
+    server.create("tpujobs", job.to_dict())
+    # durable history: 4 counted restarts, last transition just now
+    server.update_status("tpujobs", {
+        "metadata": {"name": job.metadata.name, "namespace": "default"},
+        "status": {
+            "replicaStatuses": {c.REPLICA_TYPE_WORKER: {"restarts": 4}},
+            "conditions": [{
+                "type": c.JOB_RESTARTING, "status": "True",
+                "reason": "TPUJobRestarting", "message": "crash looping",
+                "lastTransitionTime": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            }],
+        },
+    })
+    ctrl = TPUJobController(ClientSet(server), config=ControllerConfig(
+        restart_backoff_seconds=1.0, restart_backoff_max_seconds=300.0,
+        resync_period=0))
+    stop = threading.Event()
+    try:
+        ctrl.run(stop, threadiness=1)
+        key = f"default/{job.metadata.name}"
+        entry = ctrl._restart_backoff.get((key, c.REPLICA_TYPE_WORKER, 0))
+        assert entry is not None, "damper not reconstructed"
+        strikes = entry[0]
+        assert strikes == 4
+        # 4 strikes -> 1.0 * 2^(4-2) = 4 s replacement delay from the
+        # condition timestamp
+        remaining = ctrl._restart_backoff_remaining(key, c.REPLICA_TYPE_WORKER, 0)
+        assert 2.0 < remaining <= 4.0
+        # the missing replica is therefore NOT created promptly
+        time.sleep(0.3)
+        assert ClientSet(server).pods.list() == []
+    finally:
+        stop.set()
+        ctrl.queue.shutdown()
+        ctrl.factory.stop()
+
+
+def test_cold_start_damper_skips_finished_and_healthy_jobs():
+    server = InMemoryAPIServer()
+    done = new_tpujob(master=None, workers=1, name="done-job",
+                      restart_policy=c.RESTART_POLICY_EXIT_CODE)
+    server.create("tpujobs", done.to_dict())
+    server.update_status("tpujobs", {
+        "metadata": {"name": "done-job", "namespace": "default"},
+        "status": {
+            "replicaStatuses": {c.REPLICA_TYPE_WORKER: {"restarts": 7}},
+            "conditions": [{"type": c.JOB_SUCCEEDED, "status": "True",
+                            "reason": "TPUJobSucceeded", "message": "done"}],
+        },
+    })
+    healthy = new_tpujob(workers=1, name="healthy-job")
+    server.create("tpujobs", healthy.to_dict())  # zero restarts
+    ctrl = TPUJobController(ClientSet(server), config=ControllerConfig(
+        resync_period=0))
+    ctrl.factory.sync_all()
+    ctrl.on_caches_synced()
+    assert ctrl._restart_backoff == {}
+    ctrl.factory.stop()
+
+
+def test_cold_start_metrics_and_controller_timeline():
+    before_sync = metrics.cold_start_duration.labels(stage="caches_synced").value
+    before_first = metrics.cold_start_duration.labels(stage="first_sync").value
+    server = InMemoryAPIServer()
+    clients = ClientSet(server)
+    clients.tpujobs.create(new_tpujob(workers=1))
+    ctrl = TPUJobController(clients, config=ControllerConfig(resync_period=0))
+    stop = threading.Event()
+    try:
+        ctrl.run(stop, threadiness=1)
+        assert metrics.cold_start_duration.labels(
+            stage="caches_synced").value == before_sync + 1
+        assert _wait(lambda: metrics.cold_start_duration.labels(
+            stage="first_sync").value == before_first + 1)
+        tl = ctrl.flight.timeline("-", "controller")
+        assert tl is not None and tl["job"] == CONTROLLER_TIMELINE_KEY
+        stages = [e["detail"]["stage"] for e in tl["entries"]
+                  if e["kind"] == "coldstart" and "stage" in e.get("detail", {})]
+        assert "caches_synced" in stages
+        assert "first_sync" in stages
+    finally:
+        stop.set()
+        ctrl.queue.shutdown()
+        ctrl.factory.stop()
+
+
+def test_leadership_transitions_metric_and_timeline():
+    server = InMemoryAPIServer()
+    before = metrics.leader_transitions.value
+    app = _app(server)
+    app.run(block=False)
+    # the per-elector counter is the deterministic signal (the global
+    # metric is shared with any elector thread another test leaked); the
+    # flight-record lands asynchronously on the leading-callback thread
+    assert _wait(lambda: app.elector.transitions == 1)
+    assert metrics.leader_transitions.value >= before + 1
+    assert _wait(
+        lambda: app.controller.flight.timeline("-", "controller") is not None)
+    tl = app.controller.flight.timeline("-", "controller")
+    assert tl["job"] == CONTROLLER_TIMELINE_KEY
+    leads = [e for e in tl["entries"] if e["kind"] == "leadership"]
+    assert leads and "acquired leadership" in leads[0]["summary"]
+    app.shutdown()
+    assert app.elector.transitions == 2  # release counted
+    assert metrics.leader_transitions.value >= before + 2
+
+
+def test_hard_kill_reports_no_extra_leader_transition():
+    """A simulated crash must count exactly what a real SIGKILL would: the
+    acquisition, and nothing at teardown."""
+    server = InMemoryAPIServer()
+    app = _app(server)
+    app.run(block=False)
+    assert _wait(lambda: app.elector.transitions == 1)
+    app.hard_kill()  # joins the elector thread, so the count is final
+    assert app.elector.transitions == 1
+
+
+# ---------------------------------------------------------------------------
+# crash/failover soak smokes (tier-1) + the slow matrix
+# ---------------------------------------------------------------------------
+
+
+def test_crash_soak_smoke_converges_with_invariants():
+    """Tier-1 smoke: one seeded controller-kill schedule over the full
+    matrix — every in-memory ledger dies twice, invariants still hold."""
+    report = run_crash_soak(seed=11, kills=2, storm_kills=3, timeout=45.0)
+    assert report["invariants"] == "ok"
+    assert report["controller_kills"] == 2
+    assert report["jobs"] == len(matrix("c11")) == 5
+
+
+def test_failover_soak_smoke_fences_the_deposed_leader():
+    """Tier-1 smoke: leader hard-kill, standby takeover, fencing probes —
+    zero writes accepted from the fenced leader."""
+    report = run_failover_soak(seed=11, config=NO_FAULTS, storm_kills=3,
+                               timeout=45.0)
+    assert report["invariants"] == "ok"
+    fence = report["fence"]
+    assert fence["rejected"] == fence["probes"] > 0
+    assert fence["server_rejections"] > 0
+
+
+@pytest.mark.slow
+def test_crash_failover_matrix_many_seeds():
+    """The make soak --crash shape: >= 5 seeds of controller-kill and
+    standby-takeover schedules, all invariants + fencing intact."""
+    for seed in range(31, 36):
+        crash = run_crash_soak(seed, timeout=60.0)
+        assert crash["invariants"] == "ok"
+        failover = run_failover_soak(seed, timeout=60.0)
+        assert failover["invariants"] == "ok"
+        fence = failover["fence"]
+        assert fence["rejected"] == fence["probes"] > 0

@@ -1,0 +1,222 @@
+"""Write fencing: token plumbing, client-side and server-side rejection.
+
+The fencing contract (docs/failure-handling): a mutating call from a
+deposed leader must never be accepted — rejected locally the moment its
+elector notices the loss, and rejected by the storage layer via the token
+check when the elector's view is stale (the paused-then-resumed race).
+"""
+import threading
+import time
+
+import pytest
+
+from tpujob.kube.client import ClientSet
+from tpujob.kube.errors import FencedError, error_for_status
+from tpujob.kube.fencing import (
+    FencedTransport,
+    FencingToken,
+    call_token,
+    current_call_token,
+)
+from tpujob.kube.memserver import InMemoryAPIServer
+from tpujob.server import metrics
+from tpujob.server.leader_election import LeaderElector
+
+
+def _lease(server, holder: str, generation: int) -> None:
+    record = {
+        "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+        "metadata": {"name": "tpujob-operator", "namespace": "default"},
+        "spec": {"holderIdentity": holder, "leaseDurationSeconds": 15,
+                 "leaseTransitions": generation},
+    }
+    try:
+        current = server.get("leases", "default", "tpujob-operator")
+        record["metadata"]["resourceVersion"] = current["metadata"]["resourceVersion"]
+        server.update("leases", record)
+    except Exception:
+        server.create("leases", record)
+
+
+def test_not_leader_rejected_locally_before_the_wire():
+    server = InMemoryAPIServer()
+    calls = []
+    server.hooks.append(lambda *a: calls.append(a))
+    ft = FencedTransport(server, fence=lambda: None)
+    before = metrics.fenced_writes_rejected.value
+    for op in (
+        lambda: ft.create("pods", {"metadata": {"name": "p"}}),
+        lambda: ft.update("pods", {"metadata": {"name": "p"}}),
+        lambda: ft.update_status("pods", {"metadata": {"name": "p"}}),
+        lambda: ft.patch("pods", "default", "p", {}),
+        lambda: ft.delete("pods", "default", "p"),
+    ):
+        with pytest.raises(FencedError):
+            op()
+    assert calls == []  # nothing ever reached the server
+    assert metrics.fenced_writes_rejected.value == before + 5
+
+
+def test_reads_pass_unfenced():
+    """A deposed leader's reads are harmless (cache warm-up must survive)."""
+    server = InMemoryAPIServer()
+    server.create("pods", {"metadata": {"name": "p"}})
+    ft = FencedTransport(server, fence=lambda: None)
+    assert ft.get("pods", "default", "p")["metadata"]["name"] == "p"
+    assert len(ft.list("pods")) == 1
+    w = ft.watch("pods")
+    w.stop()
+
+
+def test_live_token_accepted_stale_token_rejected_server_side():
+    server = InMemoryAPIServer()
+    server.enable_fence_validation("default", "tpujob-operator")
+    _lease(server, "op-1", 3)
+    ft = FencedTransport(server, fence=lambda: FencingToken("op-1", 3))
+    ft.create("pods", {"metadata": {"name": "p1"}})
+    assert server.fence_checked == 1 and server.fence_rejections == []
+
+    # handover: op-2 takes the lease, generation bumps — op-1's token is now
+    # stale even though its local fence still says "leader"
+    _lease(server, "op-2", 4)
+    before = metrics.fenced_writes_rejected.value
+    with pytest.raises(FencedError):
+        ft.create("pods", {"metadata": {"name": "p2"}})
+    assert [r[:2] for r in server.fence_rejections] == [("create", "pods")]
+    assert metrics.fenced_writes_rejected.value == before + 1
+    assert len(server.list("pods")) == 1  # nothing committed
+
+
+def test_same_holder_new_generation_is_stale():
+    """Losing and re-winning the lease mints a NEW generation; writes
+    carrying the old one are rejected (no ABA through one identity)."""
+    server = InMemoryAPIServer()
+    server.enable_fence_validation("default", "tpujob-operator")
+    _lease(server, "op-1", 3)
+    old = FencedTransport(server, fence=lambda: FencingToken("op-1", 2))
+    with pytest.raises(FencedError):
+        old.delete("pods", "default", "whatever")
+
+
+def test_tokenless_writers_never_fenced():
+    """The kubelet and admin/test clients carry no token and are exempt."""
+    server = InMemoryAPIServer()
+    server.enable_fence_validation("default", "tpujob-operator")
+    _lease(server, "op-1", 1)
+    assert current_call_token() is None
+    server.create("pods", {"metadata": {"name": "kubelet-pod"}})
+    server.delete("pods", "default", "kubelet-pod")
+    assert server.fence_checked == 0
+
+
+def test_lease_writes_are_never_fenced():
+    """Fencing the lease itself would deadlock the election."""
+    server = InMemoryAPIServer()
+    server.enable_fence_validation("default", "tpujob-operator")
+    _lease(server, "op-1", 1)
+    with call_token(FencingToken("op-dead", 0)):
+        _lease(server, "op-2", 2)  # update rides the stale-token context
+    assert server.get("leases", "default", "tpujob-operator")[
+        "spec"]["holderIdentity"] == "op-2"
+
+
+def test_call_token_scoped_and_restored():
+    t = FencingToken("x", 1)
+    assert current_call_token() is None
+    with call_token(t):
+        assert current_call_token() == t
+        with call_token(None):
+            assert current_call_token() is None
+        assert current_call_token() == t
+    assert current_call_token() is None
+
+
+def test_paused_leader_race_caught_by_the_server():
+    """The classic fencing race: the old leader's process pauses through the
+    whole handover window, resumes still believing it leads, and writes.
+    The local check passes (its elector never saw the loss) — the storage
+    layer must reject on the stale token."""
+    server = InMemoryAPIServer()
+    server.enable_fence_validation("default", "tpujob-operator")
+    a = LeaderElector(server, identity="op-a", lease_duration=0.2,
+                      renew_deadline=0.1, retry_period=0.02)
+    assert a._try_acquire_or_renew()
+    a.is_leader = True  # what run() would set; then the process "pauses"
+    fenced_a = FencedTransport(server, fence=a.current_token)
+    fenced_a.create("pods", {"metadata": {"name": "pre-pause"}})
+
+    # the pause outlives the lease: backdate renewTime past expiry instead
+    # of sleeping out the 1 s wire-format floor
+    from tpujob.server.leader_election import rfc3339micro
+
+    stale = server.get("leases", "default", "tpujob-operator")
+    stale["spec"]["renewTime"] = rfc3339micro(time.time() - 10)
+    server.update("leases", stale)
+    b = LeaderElector(server, identity="op-b", lease_duration=0.2,
+                      renew_deadline=0.1, retry_period=0.02)
+    assert b._try_acquire_or_renew()
+    b.is_leader = True
+
+    # op-a resumes: local fence still open (is_leader True, stale token)
+    assert a.current_token() is not None
+    with pytest.raises(FencedError):
+        fenced_a.create("pods", {"metadata": {"name": "post-pause"}})
+    assert [p["metadata"]["name"] for p in server.list("pods")] == ["pre-pause"]
+    # the new leader writes fine
+    fenced_b = FencedTransport(server, fence=b.current_token)
+    fenced_b.create("pods", {"metadata": {"name": "b-pod"}})
+
+
+def test_fenced_transport_composes_with_clientset_tracing():
+    """ClientSet wraps a FencedTransport in TracingTransport like any other
+    untraced transport; typed clients work end to end."""
+    server = InMemoryAPIServer()
+    server.enable_fence_validation("default", "tpujob-operator")
+    _lease(server, "op-1", 0)
+    token = [FencingToken("op-1", 0)]
+    clients = ClientSet(FencedTransport(server, fence=lambda: token[0]))
+    from tpujob.kube.objects import Pod
+
+    clients.pods.create(Pod.from_dict({"metadata": {"name": "p"}}))
+    assert clients.pods.get("default", "p").metadata.name == "p"
+    token[0] = None  # leadership lost
+    with pytest.raises(FencedError):
+        clients.pods.delete("default", "p")
+
+
+def test_error_for_status_maps_fenced():
+    assert isinstance(error_for_status(403, "Fenced", "x"), FencedError)
+
+
+def test_fence_check_threads_see_their_own_tokens():
+    """Tokens are call-scoped per thread: concurrent writers cannot leak
+    tokens into each other's calls (slow-start batch pool semantics)."""
+    server = InMemoryAPIServer()
+    server.enable_fence_validation("default", "tpujob-operator")
+    _lease(server, "op-1", 0)
+    ok = FencedTransport(server, fence=lambda: FencingToken("op-1", 0))
+    bad = FencedTransport(server, fence=lambda: FencingToken("op-x", 9))
+    results = {}
+
+    def good_writer():
+        for i in range(20):
+            ok.create("pods", {"metadata": {"name": f"g{i}"}})
+        results["good"] = "done"
+
+    def bad_writer():
+        rejected = 0
+        for i in range(20):
+            try:
+                bad.create("pods", {"metadata": {"name": f"b{i}"}})
+            except FencedError:
+                rejected += 1
+        results["bad_rejected"] = rejected
+
+    ts = [threading.Thread(target=good_writer), threading.Thread(target=bad_writer)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert results == {"good": "done", "bad_rejected": 20}
+    names = {p["metadata"]["name"] for p in server.list("pods")}
+    assert len(names) == 20 and all(n.startswith("g") for n in names)

@@ -194,6 +194,11 @@ def test_leader_failover_on_lease_expiry():
     while time.monotonic() < deadline and not e2.is_leader:
         time.sleep(0.05)
     assert e2.is_leader
+    # on_started_leading now fires on its own thread (client-go's
+    # OnStartedLeading goroutine), so give the callback a moment to land
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and leaders != ["op-1", "op-2"]:
+        time.sleep(0.02)
     assert leaders == ["op-1", "op-2"]
     stop2.set()
     t2.join(timeout=2)

@@ -36,7 +36,7 @@ from tpujob.kube.informers import (
     SharedInformer,
 )
 from tpujob.kube.objects import Pod, Service
-from tpujob.obs.recorder import FlightRecorder
+from tpujob.obs.recorder import CONTROLLER_TIMELINE_KEY, FlightRecorder
 from tpujob.obs.trace import TRACER, KeyedTokenBucket
 from tpujob.runtime import ExpectationsCache, WorkQueue
 from tpujob.server import metrics
@@ -202,6 +202,13 @@ class JobController:
             self.recorder.sinks.append(self.flight.record_event)
         self._slow_dump_limiter = KeyedTokenBucket(
             capacity=3.0, refill_per_s=1 / 60.0)
+
+        # cold-start bookkeeping: run() stamps the start, the first completed
+        # sync closes the measurement (process start -> caches synced ->
+        # first sync)
+        self._run_started_mono: Optional[float] = None
+        self._first_sync_recorded = False
+        self._cold_start_lock = threading.Lock()
 
         self.job_informer = self.factory.informer(RESOURCE_TPUJOBS)
         self.pod_informer = self.factory.informer(RESOURCE_PODS)
@@ -461,8 +468,10 @@ class JobController:
                 # best-effort observability must not skip the sync (or the
                 # queue.done below that keeps the key processable)
                 log.exception("error recording queue wait for job %s", key)
+            synced_ok = False
             try:
                 forget = self.sync_handler(key)
+                synced_ok = True
                 if forget:
                     self.queue.forget(key)
                 else:
@@ -476,6 +485,12 @@ class JobController:
                 metrics.reconcile_duration.observe(time.monotonic() - start)
                 self.queue.done(key)
         try:
+            if synced_ok:
+                # only a sync that ran to completion closes the cold-start
+                # measurement — a first dequeue that died on a transient API
+                # error would under-report recovery latency exactly in the
+                # degraded runs the metric exists to expose
+                self._note_first_sync()
             self._sink_trace(key, ctx)
         except Exception:
             # observers are best-effort: a sink failure must not kill the
@@ -519,6 +534,26 @@ class JobController:
                 ).warning("slow sync: %.3fs exceeds threshold %.3fs",
                           root.duration, threshold)
 
+    def _note_first_sync(self) -> None:
+        """Close the cold-start measurement on the first completed sync."""
+        if self._first_sync_recorded or self._run_started_mono is None:
+            return
+        with self._cold_start_lock:
+            if self._first_sync_recorded:
+                return
+            self._first_sync_recorded = True
+            elapsed = time.monotonic() - self._run_started_mono
+        metrics.cold_start_duration.labels(stage="first_sync").observe(elapsed)
+        self.flight.record(
+            CONTROLLER_TIMELINE_KEY, "coldstart",
+            f"first sync completed {elapsed * 1e3:.1f}ms after controller start",
+            {"stage": "first_sync", "duration_s": round(elapsed, 6)})
+
+    def on_caches_synced(self) -> None:
+        """Hook invoked by run() after the initial LIST landed and before any
+        worker dequeues — the point where durable state (job status) is fully
+        visible and in-memory ledgers may be reconstructed from it."""
+
     def resync_all(self) -> int:
         """Re-enqueue every cached job (the informer resync replay: drift
         between cluster and desired state heals even if a watch event was
@@ -529,10 +564,29 @@ class JobController:
         return len(keys)
 
     def run(self, stop_event: threading.Event, threadiness: Optional[int] = None) -> List[threading.Thread]:
-        """Start informers + N workers (controller.go:185-213)."""
+        """Start informers + N workers (controller.go:185-213).
+
+        Cold start is correct by construction: no worker thread exists until
+        the initial LIST of every informer landed (the wait-for-cache-sync
+        barrier below), and a fresh ExpectationsCache treats unknown keys as
+        satisfied — so the first sync of every job sees the full durable
+        state, never a half-filled cache that would double-create pods.
+        """
+        self._run_started_mono = time.monotonic()
+        self._first_sync_recorded = False
         self.factory.start(stop_event)
         if not self.factory.wait_for_cache_sync():
             raise RuntimeError("informer caches failed to sync")
+        synced_s = time.monotonic() - self._run_started_mono
+        metrics.cold_start_duration.labels(stage="caches_synced").observe(synced_s)
+        self.flight.record(
+            CONTROLLER_TIMELINE_KEY, "coldstart",
+            f"informer caches synced in {synced_s * 1e3:.1f}ms "
+            f"({len(self.job_informer.store.list())} job(s) listed)",
+            {"stage": "caches_synced", "duration_s": round(synced_s, 6)})
+        # ledger reconstruction from durable state happens behind the
+        # barrier, before the first dequeue
+        self.on_caches_synced()
 
         def worker():
             while not stop_event.is_set():

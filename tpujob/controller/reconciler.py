@@ -118,6 +118,86 @@ class TPUJobController(JobController):
         self._restart_backoff: Dict[Tuple[str, str, int], Tuple[int, float, float]] = {}
 
     # ------------------------------------------------------------------
+    # cold-start recovery (crash-only controller semantics)
+    # ------------------------------------------------------------------
+
+    def on_caches_synced(self) -> None:
+        """Reconstruct in-memory ledgers from durable state after a (re)start.
+
+        The crash-loop damper (`_restart_backoff`) dies with the process; a
+        fresh controller starting at zero would prompt-restart every
+        crash-looping replica at full speed — a restart storm each time the
+        CONTROLLER itself crash-loops.  Rebuild it conservatively from
+        ``status.replicaStatuses[].restarts`` (durable, cumulative) anchored
+        at the newest condition transition timestamp.  Over-delaying is safe:
+        the damper only gates REPLACEMENT of missing pods, so a healthy
+        running replica is never touched.
+        """
+        seeded = self._rebuild_restart_backoff()
+        if seeded:
+            from tpujob.obs.recorder import CONTROLLER_TIMELINE_KEY
+
+            self.flight.record(
+                CONTROLLER_TIMELINE_KEY, "coldstart",
+                f"restart-backoff damper reconstructed from status for "
+                f"{seeded} replica type(s)",
+                {"stage": "damper_rebuild", "seeded": seeded})
+
+    def _rebuild_restart_backoff(self) -> int:
+        base = self.config.restart_backoff_seconds
+        if base <= 0:
+            return 0
+        max_delay = self.config.restart_backoff_max_seconds
+        now_mono, now_wall = time.monotonic(), time.time()
+        seeded = 0
+        for obj in self.job_informer.store.list():
+            try:
+                job = TPUJob.from_dict(obj)
+                set_defaults_tpujob(job)
+            except (TypeError, ValueError):
+                continue  # malformed CR: the sync path reports it
+            if st.is_finished(job.status):
+                continue
+            # anchor at the newest condition transition — the closest durable
+            # proxy for "when the last counted restart happened"
+            last_wall = max(
+                (t for t in (_parse_time(cond.last_transition_time)
+                             for cond in job.status.conditions) if t is not None),
+                default=None,
+            )
+            for rtype, rspec in job.spec.tpu_replica_specs.items():
+                if rspec.restart_policy != c.RESTART_POLICY_EXIT_CODE:
+                    continue
+                rs = job.status.replica_statuses.get(rtype)
+                restarts = rs.restarts if rs is not None else 0
+                if restarts <= 0:
+                    continue
+                strikes = min(restarts, 32)
+                delay = 0.0 if strikes == 1 else min(
+                    base * (2 ** min(strikes - 2, 30)), max_delay)
+                # condition times are wall clock; the damper runs on the
+                # monotonic clock — translate, clamping to "just now" if the
+                # timestamp is in the future (clock skew)
+                last_mono = (now_mono if last_wall is None
+                             else now_mono - max(0.0, now_wall - last_wall))
+                not_before = last_mono + delay
+                # restarts are per-type, not per-index: seed every index
+                # (conservative — only replacements of MISSING pods wait)
+                replicas = rspec.replicas if rspec.replicas is not None else 1
+                for index in range(replicas):
+                    self._restart_backoff[(job.key, rtype, index)] = (
+                        strikes, last_mono, not_before)
+                seeded += 1
+                self.flight.record(
+                    job.key, "backoff",
+                    f"cold start: damper reconstructed for {rtype} from "
+                    f"status ({restarts} counted restart(s) -> strikes="
+                    f"{strikes}, replacement delay {delay:.2f}s)",
+                    {"rtype": rtype, "restarts": restarts, "strikes": strikes,
+                     "delay_s": round(delay, 3)})
+        return seeded
+
+    # ------------------------------------------------------------------
     # job event handlers (job.go:35-149)
     # ------------------------------------------------------------------
 

@@ -54,6 +54,16 @@ class ServerTimeoutError(ApiError):
     reason = "Timeout"
 
 
+class FencedError(ApiError):
+    """A mutating call carried a stale fencing token (the caller lost
+    leadership, or another instance acquired the lease since the token was
+    minted).  Terminal for the caller: retrying cannot succeed — only
+    re-acquiring leadership mints a fresh token."""
+
+    code = 403
+    reason = "Fenced"
+
+
 def error_for_status(status: int, reason: str, message: str) -> ApiError:
     """Map a K8s Status reason / HTTP code to the matching ApiError subclass.
 
@@ -75,6 +85,8 @@ def error_for_status(status: int, reason: str, message: str) -> ApiError:
         # ambiguous: the request may have executed server-side before the
         # response was lost — callers branch on this (restart accounting)
         return ServerTimeoutError(message)
+    if reason == "Fenced":
+        return FencedError(message)
     return ApiError(message or f"HTTP {status}")
 
 

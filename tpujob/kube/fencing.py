@@ -1,0 +1,201 @@
+"""Write fencing for the HA controller.
+
+A leader's mutating API calls are guarded by a **fencing token** — the
+holder identity plus the lease acquisition generation (``leaseTransitions``
+at the moment leadership was won).  The generation is monotonic across
+handovers: every new holder bumps it, and a graceful release zeroes only
+``holderIdentity`` (never deletes the lease), so generations can never
+collide across restarts.
+
+Two independent checks enforce "no write from a deposed leader":
+
+- **client-side** — :class:`FencedTransport` wraps the controller's
+  transport and rejects every mutating verb the moment the elector reports
+  leadership lost.  Cheap, immediate, but only as current as the elector's
+  own view.
+- **server-side** — the token rides each mutating call in a contextvar
+  (:func:`call_token`); a storage layer that knows the lease — the
+  in-memory API server with fence validation enabled — compares it against
+  the *current* lease record and rejects stale tokens.  This is what closes
+  the classic pause/resume race: an old leader whose process was suspended
+  through the whole handover window still *believes* it leads, passes the
+  client-side check, and is caught at the server.
+
+Writers without a token (the simulated kubelet, admin/test clients, the
+elector's own lease writes) are never fenced — fencing constrains
+*participants in the election*, exactly like fencing tokens in front of a
+distributed lock service.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from tpujob.kube.errors import FencedError
+from tpujob.server import metrics
+
+
+@dataclass(frozen=True)
+class FencingToken:
+    """One acquisition's identity: (holder, lease generation)."""
+
+    holder: str
+    generation: int
+
+    def __str__(self) -> str:
+        return f"{self.holder}@gen{self.generation}"
+
+
+# The token accompanying the current mutating call, if any.  Set by
+# FencedTransport strictly around the inner call (same thread), so it
+# propagates through any transport stack — chaos injector, rate limiter,
+# tracing — down to the storage layer without plumbing.
+_CALL_TOKEN: "contextvars.ContextVar[Optional[FencingToken]]" = contextvars.ContextVar(
+    "tpujob_fencing_token", default=None
+)
+
+
+def current_call_token() -> Optional[FencingToken]:
+    """The fencing token attached to the in-flight call (None = unfenced
+    writer)."""
+    return _CALL_TOKEN.get()
+
+
+@contextlib.contextmanager
+def call_token(token: Optional[FencingToken]) -> Iterator[None]:
+    reset = _CALL_TOKEN.set(token)
+    try:
+        yield
+    finally:
+        _CALL_TOKEN.reset(reset)
+
+
+TokenProvider = Callable[[], Optional[FencingToken]]
+
+
+class KillSwitchTransport:
+    """Transport facade modeling in-process crash death.
+
+    Python threads cannot be killed mid-bytecode, so an in-process "hard
+    kill" alone would let a worker FINISH its in-flight sync — every crash
+    would land on a tidy sync boundary, a strictly easier recovery problem
+    than a real SIGKILL.  Severing the transport restores the real failure
+    geometry: calls already committed stay committed, and the very next API
+    call of an in-flight sync dies — crashes land BETWEEN the writes of one
+    sync, exactly where recovery bugs live.  Production processes just die;
+    this seam exists for the crash chaos tier (``OperatorApp.hard_kill``).
+    """
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._severed = False
+
+    def sever(self) -> None:
+        self._severed = True
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _call(self, name: str, *args, **kwargs):
+        if self._severed:
+            from tpujob.kube.errors import ApiError
+
+            raise ApiError(f"transport severed (process died): {name}")
+        return getattr(self._inner, name)(*args, **kwargs)
+
+    def create(self, *a, **kw):
+        return self._call("create", *a, **kw)
+
+    def get(self, *a, **kw):
+        return self._call("get", *a, **kw)
+
+    def list(self, *a, **kw):
+        return self._call("list", *a, **kw)
+
+    def update(self, *a, **kw):
+        return self._call("update", *a, **kw)
+
+    def update_status(self, *a, **kw):
+        return self._call("update_status", *a, **kw)
+
+    def patch(self, *a, **kw):
+        return self._call("patch", *a, **kw)
+
+    def delete(self, *a, **kw):
+        return self._call("delete", *a, **kw)
+
+    def watch(self, *a, **kw):
+        return self._call("watch", *a, **kw)
+
+
+class FencedTransport:
+    """ApiServer-surface wrapper rejecting mutations once leadership is gone.
+
+    ``fence`` is consulted per mutating call (``LeaderElector.current_token``
+    in production): ``None`` means "not the leader" and the call is rejected
+    locally before it ever reaches the wire.  A live token is stamped into
+    the call context so a fence-validating server can re-check it against
+    the current lease — server-side :class:`FencedError` rejections are
+    counted here too (once, on the way back up) and re-raised.
+
+    Reads pass through unfenced: a deposed leader's stale reads are
+    harmless (its informers only feed a controller that may no longer
+    write), and fencing them would kill the standby's cache warm-up.
+    """
+
+    def __init__(self, inner, fence: TokenProvider):
+        self._inner = inner
+        self._fence = fence
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _fenced(self, verb: str, fn: Callable[[], Any]) -> Any:
+        token = self._fence()
+        if token is None:
+            metrics.fenced_writes_rejected.inc()
+            raise FencedError(
+                f"fencing: {verb} rejected locally: not the current leader")
+        with call_token(token):
+            try:
+                return fn()
+            except FencedError:
+                # the server saw a fresher lease than our token: deposed
+                # mid-flight (the pause/resume race the local check misses)
+                metrics.fenced_writes_rejected.inc()
+                raise
+
+    # -- mutating verbs (fenced) --------------------------------------------
+
+    def create(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self._fenced("create", lambda: self._inner.create(resource, obj))
+
+    def update(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self._fenced("update", lambda: self._inner.update(resource, obj))
+
+    def update_status(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return self._fenced(
+            "update_status", lambda: self._inner.update_status(resource, obj))
+
+    def patch(self, resource: str, namespace: str, name: str,
+              patch: Dict[str, Any]) -> Dict[str, Any]:
+        return self._fenced(
+            "patch", lambda: self._inner.patch(resource, namespace, name, patch))
+
+    def delete(self, resource: str, namespace: str, name: str) -> None:
+        return self._fenced(
+            "delete", lambda: self._inner.delete(resource, namespace, name))
+
+    # -- reads (unfenced) ---------------------------------------------------
+
+    def get(self, resource: str, namespace: str, name: str) -> Dict[str, Any]:
+        return self._inner.get(resource, namespace, name)
+
+    def list(self, resource: str, namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
+        return self._inner.list(resource, namespace, label_selector)
+
+    def watch(self, *args, **kwargs):
+        return self._inner.watch(*args, **kwargs)

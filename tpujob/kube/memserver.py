@@ -26,6 +26,7 @@ from collections import deque
 from tpujob.kube.errors import (
     AlreadyExistsError,
     ConflictError,
+    FencedError,
     GoneError,
     InvalidError,
     NotFoundError,
@@ -148,6 +149,45 @@ class InMemoryAPIServer:
         self.hooks: List[Callable[[str, str, Dict[str, Any]], None]] = []
         # pod log store: (ns, pod_name) -> text, fed by the simulated kubelet
         self._pod_logs: Dict[Tuple[str, str], str] = {}
+        # server-side fencing (opt-in): (lease namespace, lease name) the
+        # tokens are validated against; ledgers make the handover race
+        # observable in tests
+        self._fence_lease: Optional[Tuple[str, str]] = None
+        self.fence_checked = 0  # token-carrying mutations validated
+        self.fence_rejections: List[Tuple[str, str, str]] = []  # (verb, resource, token)
+
+    # -- write fencing (server-side validation) -----------------------------
+
+    def enable_fence_validation(self, namespace: str = "default",
+                                name: str = "tpujob-operator") -> None:
+        """Validate every token-carrying mutation against the named lease:
+        a token whose (holder, generation) no longer matches the current
+        lease record is rejected with :class:`FencedError` — the storage
+        half of the fencing contract, catching a paused-then-resumed old
+        leader whose local elector still believes it leads.  Token-less
+        writers (kubelet, admin clients) are never fenced."""
+        with self._lock:
+            self._fence_lease = (namespace or "default", name)
+
+    def _fence_check(self, verb: str, resource: str) -> None:
+        if self._fence_lease is None or resource == "leases":
+            return  # lease writes ARE the election; never fence them
+        from tpujob.kube.fencing import current_call_token
+
+        token = current_call_token()
+        if token is None:
+            return
+        self.fence_checked += 1
+        ns, name = self._fence_lease
+        lease = self._store("leases").objects.get((ns, name))
+        spec = (lease or {}).get("spec") or {}
+        holder = spec.get("holderIdentity")
+        generation = int(spec.get("leaseTransitions") or 0)
+        if lease is None or holder != token.holder or generation != token.generation:
+            self.fence_rejections.append((verb, resource, str(token)))
+            raise FencedError(
+                f"fencing: {verb} {resource} rejected: token {token} is stale "
+                f"(lease holder={holder!r} generation={generation})")
 
     # -- pod logs (the read_namespaced_pod_log analog) -----------------------
 
@@ -232,6 +272,7 @@ class InMemoryAPIServer:
 
     def create(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
         with self._lock:
+            self._fence_check("create", resource)
             obj = copy.deepcopy(obj)
             key = self._key(obj)
             store = self._store(resource)
@@ -271,6 +312,7 @@ class InMemoryAPIServer:
 
     def update(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
         with self._lock:
+            self._fence_check("update", resource)
             obj = copy.deepcopy(obj)
             key = self._key(obj)
             store = self._store(resource)
@@ -300,6 +342,7 @@ class InMemoryAPIServer:
         (e.g. reset the cumulative ``restarts`` counter).  No RV provided =
         unconditional write (the malformed-CR write-back path)."""
         with self._lock:
+            self._fence_check("update_status", resource)
             key = self._key(obj)
             current = self._store(resource).objects.get(key)
             if current is None:
@@ -320,6 +363,7 @@ class InMemoryAPIServer:
     def patch(self, resource: str, namespace: str, name: str, patch: Dict[str, Any]) -> Dict[str, Any]:
         """Strategic-merge-ish patch (recursive dict merge; lists replaced)."""
         with self._lock:
+            self._fence_check("patch", resource)
             key = (namespace or "default", name)
             current = self._store(resource).objects.get(key)
             if current is None:
@@ -333,6 +377,7 @@ class InMemoryAPIServer:
 
     def delete(self, resource: str, namespace: str, name: str) -> None:
         with self._lock:
+            self._fence_check("delete", resource)
             key = (namespace or "default", name)
             obj = self._store(resource).objects.pop(key, None)
             if obj is None:

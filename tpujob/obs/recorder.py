@@ -31,6 +31,12 @@ def _iso(t: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t))
 
 
+# Reserved timeline key for controller-level (non-job) lifecycle entries:
+# leadership transitions, cold-start recovery milestones.  '-' can never be
+# a real namespace, so the pseudo-timeline cannot collide with a job's.
+CONTROLLER_TIMELINE_KEY = "-/controller"
+
+
 class FlightRecorder:
     def __init__(self, ring_size: int = 256, max_jobs: int = 1024,
                  max_traces: int = 256):

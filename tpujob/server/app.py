@@ -14,8 +14,10 @@ from typing import Optional
 from tpujob.controller.job_base import ControllerConfig
 from tpujob.controller.reconciler import TPUJobController
 from tpujob.kube.client import ClientSet
+from tpujob.kube.fencing import FencedTransport, KillSwitchTransport
 from tpujob.kube.httpclient import HTTPApiClient
 from tpujob.kube.memserver import InMemoryAPIServer
+from tpujob.obs.recorder import CONTROLLER_TIMELINE_KEY
 from tpujob.server.leader_election import LeaderElector
 from tpujob.server.monitoring import MonitoringServer
 from tpujob.server.options import ServerOption
@@ -80,7 +82,28 @@ class OperatorApp:
     def __init__(self, opt: ServerOption, transport=None):
         self.opt = opt
         self.transport = transport if transport is not None else build_transport(opt)
-        self.clients = ClientSet(self.transport)
+        # the elector speaks the (unfenced) transport directly — lease
+        # writes are how you BECOME leader; the controller's clients are
+        # fenced on the elector's token so a deposed leader cannot keep
+        # writing.  Both ride kill switches so hard_kill() can sever them
+        # mid-sync, the way a SIGKILL severs a real process's sockets.
+        self.elector: Optional[LeaderElector] = None
+        self._controller_kill_switch = KillSwitchTransport(self.transport)
+        self._elector_kill_switch = KillSwitchTransport(self.transport)
+        controller_transport = self._controller_kill_switch
+        if opt.enable_leader_election:
+            self.elector = LeaderElector(
+                self._elector_kill_switch,
+                lock_name=opt.leader_election_id,
+                namespace=self.lease_namespace(),
+                lease_duration=opt.lease_duration_s,
+                renew_deadline=opt.renew_deadline_s,
+                retry_period=opt.retry_period_s,
+            )
+            if opt.enable_fencing:
+                controller_transport = FencedTransport(
+                    self._controller_kill_switch, fence=self.elector.current_token)
+        self.clients = ClientSet(controller_transport)
         self.controller = TPUJobController(
             self.clients,
             config=ControllerConfig(
@@ -101,6 +124,9 @@ class OperatorApp:
         )
         self.monitoring: Optional[MonitoringServer] = None
         self.stop_event = threading.Event()
+        self.controller_threads: list = []
+        self._elector_thread: Optional[threading.Thread] = None
+        self._hard_killed = False
 
     def run(self, block: bool = True) -> None:
         # fields-aware formatters: per-job tags from joblogger render in both
@@ -120,29 +146,49 @@ class OperatorApp:
         def start_controller():
             log.info("leadership acquired; starting controller (threadiness=%d)",
                      self.opt.threadiness)
-            self.controller.run(self.stop_event, threadiness=self.opt.threadiness)
+            self.controller_threads = self.controller.run(
+                self.stop_event, threadiness=self.opt.threadiness)
+
+        def started_leading():
+            try:
+                token = self.elector.current_token() if self.elector else None
+                if token is not None:
+                    self.controller.flight.record(
+                        CONTROLLER_TIMELINE_KEY, "leadership",
+                        f"{token.holder} acquired leadership "
+                        f"(generation {token.generation})",
+                        {"identity": token.holder,
+                         "generation": token.generation})
+                start_controller()
+            except Exception:
+                # a failed cold start (e.g. caches never synced) must be
+                # fatal, not a zombie that holds the lease while doing
+                # nothing: stop the app so the process exits and the
+                # Deployment restarts it; the elector's clean stop then
+                # releases the lease for a standby
+                log.exception("controller failed to start after acquiring "
+                              "leadership; exiting")
+                self.stop_event.set()
 
         def lost_leadership():
-            # loss of leadership is fatal; the Deployment restarts us
+            # loss of leadership is fatal; the Deployment restarts us.  The
+            # fence has already slammed shut: is_leader flipped before this
+            # callback, so every in-flight mutating call is being rejected.
+            self.controller.flight.record(
+                CONTROLLER_TIMELINE_KEY, "leadership",
+                f"{self.elector.identity} lost leadership; exiting",
+                {"identity": self.elector.identity})
             log.error("leader election lost; exiting")
             self.stop_event.set()
 
-        if self.opt.enable_leader_election:
-            elector = LeaderElector(
-                self.transport,
-                lock_name=self.opt.leader_election_id,
-                namespace=self.lease_namespace(),
-                lease_duration=self.opt.lease_duration_s,
-                renew_deadline=self.opt.renew_deadline_s,
-                retry_period=self.opt.retry_period_s,
-                on_started_leading=start_controller,
-                on_stopped_leading=lost_leadership,
-            )
-            thread = threading.Thread(
-                target=elector.run, args=(self.stop_event,), daemon=True,
+        if self.elector is not None:
+            self.elector.on_started_leading = started_leading
+            self.elector.on_stopped_leading = lost_leadership
+            self._elector_thread = threading.Thread(
+                target=self.elector.run, args=(self.stop_event,), daemon=True,
                 name="leader-elector",
             )
-            thread.start()
+            self._elector_thread.start()
         else:
             start_controller()
 
@@ -171,9 +217,67 @@ class OperatorApp:
         cfg_ns = getattr(cfg, "namespace", "") if cfg is not None else ""
         return cfg_ns or "default"
 
-    def shutdown(self) -> None:
+    def _stop_threads(self) -> bool:
+        """Stop and JOIN every thread this app started — workers included,
+        so no in-flight sync keeps writing after the stop returns (for a
+        clean shutdown that would be exactly the deposed-leader window
+        fencing exists to close; joining closes it at the source).
+        Returns True iff every thread actually exited within its join
+        timeout."""
         self.stop_event.set()
         self.controller.queue.shutdown()
         self.controller.factory.stop()
+        # join order follows the spawn chain: elector (publishes
+        # leading_thread) -> leading callback (assigns controller_threads
+        # when start_controller returns) -> workers.  Joining out of order
+        # could read leading_thread/controller_threads before the upstream
+        # thread published them and skip threads that are still starting.
+        threads = []
+        if self._elector_thread is not None:
+            threads.append(self._elector_thread)
+            self._elector_thread.join(timeout=2)
+        if self.elector is not None and self.elector.leading_thread is not None:
+            threads.append(self.elector.leading_thread)
+            self.elector.leading_thread.join(timeout=2)
+        for t in self.controller_threads:
+            threads.append(t)
+            t.join(timeout=2)
         if self.monitoring:
             self.monitoring.stop()
+        return not any(t.is_alive() for t in threads)
+
+    def shutdown(self) -> None:
+        """Clean shutdown: stop + join everything, then release the lease
+        (zeroed holderIdentity) so a restarted or failed-over standby
+        acquires immediately instead of waiting out ``lease_duration``."""
+        drained = self._stop_threads()
+        if self.elector is not None and not self._hard_killed:
+            if drained:
+                # every thread is joined, so this cannot race an in-flight
+                # write OR the elector's own clean-stop release; idempotent
+                # once already released
+                self.elector.release()
+            else:
+                # a worker outlived its join timeout (e.g. wedged in a slow
+                # API call): releasing now would invite a standby in while
+                # our write may still land — let the lease expire instead
+                log.warning(
+                    "threads still alive at shutdown; skipping early lease "
+                    "release (standby must wait out lease_duration)")
+
+    def hard_kill(self) -> None:
+        """Crash simulation: stop every thread WITHOUT releasing the lease,
+        flushing status, or draining the queue.  All in-memory state —
+        expectations, restart ledgers, crash-loop dampers, flight recorder —
+        dies with the instance, exactly as a SIGKILLed process; a standby
+        must wait out the stale lease.  The chaos harness's controller-kill
+        schedules use this seam."""
+        self._hard_killed = True
+        if self.elector is not None:
+            self.elector.release_on_stop = False
+        # sever BEFORE stopping: a worker mid-sync dies on its next API call
+        # instead of finishing the sync — crashes land between the writes of
+        # one sync (where recovery bugs live), not on tidy sync boundaries
+        self._controller_kill_switch.sever()
+        self._elector_kill_switch.sever()
+        self._stop_threads()

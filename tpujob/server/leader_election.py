@@ -14,6 +14,7 @@ import uuid
 from typing import Callable, Optional
 
 from tpujob.kube.errors import ConflictError, NotFoundError
+from tpujob.kube.fencing import FencingToken
 from tpujob.server import metrics
 
 log = logging.getLogger("tpujob.leaderelection")
@@ -80,6 +81,30 @@ class LeaderElector:
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self.is_leader = False
+        # this instance's own observed transitions (acquire/loss/release):
+        # the deterministic per-elector view of the process-global
+        # leader_transitions metric, which concurrent electors share
+        self.transitions = 0
+        # the lease generation (leaseTransitions) our current leadership was
+        # acquired at: half of the fencing token.  Written by the elector
+        # thread on every successful acquire/renew, read by FencedTransport
+        # from worker threads (single attribute, atomic enough).
+        self._generation = 0
+        # a hard kill (crash simulation) clears this so the stale lease
+        # stays in place and a standby must wait out lease_duration — the
+        # crash-only failure mode the chaos harness exercises
+        self.release_on_stop = True
+        # the OnStartedLeading thread (see run()), exposed so an embedding
+        # app can join it on shutdown — the controller's worker threads are
+        # only known once this callback returns
+        self.leading_thread: Optional[threading.Thread] = None
+
+    def current_token(self) -> Optional[FencingToken]:
+        """The fencing token of the CURRENT leadership, None when not
+        leading — the ``fence`` provider for :class:`FencedTransport`."""
+        if not self.is_leader:
+            return None
+        return FencingToken(self.identity, self._generation)
 
     # -- lock record ---------------------------------------------------------
 
@@ -107,6 +132,7 @@ class LeaderElector:
                 "leaseDurationSeconds": max(1, int(round(self.lease_duration))),
                 "acquireTime": rfc3339micro(now),
                 "renewTime": rfc3339micro(now),
+                "leaseTransitions": 0,
             },
         }
         try:
@@ -114,6 +140,7 @@ class LeaderElector:
         except NotFoundError:
             try:
                 self.server.create(RESOURCE_LEASES, record)
+                self._generation = 0
                 return True
             except Exception:
                 return False
@@ -133,21 +160,64 @@ class LeaderElector:
         # is far worse than waiting for it to release or rewrite the lease
         expired = renew is not None and now - renew > duration
         if holder == self.identity or expired or not holder:
-            if holder != self.identity:
-                transitions = int(spec.get("leaseTransitions") or 0)
-                record["spec"]["leaseTransitions"] = transitions + 1
-            else:
+            if holder == self.identity and self.is_leader:
+                # our own renewal: the fencing generation must stay stable
+                # for the whole tenure or every renew would fence ourselves
                 record["spec"]["acquireTime"] = spec.get("acquireTime") or rfc3339micro(now)
                 record["spec"]["leaseTransitions"] = int(spec.get("leaseTransitions") or 0)
+            else:
+                # any FRESH acquisition bumps the generation — including a
+                # restarted process with a stable configured identity taking
+                # its dead predecessor's expired lease.  Keying on the
+                # holder string alone would mint the predecessor's exact
+                # token and a paused twin could write through the fence.
+                transitions = int(spec.get("leaseTransitions") or 0)
+                record["spec"]["leaseTransitions"] = transitions + 1
             record["metadata"]["resourceVersion"] = (current.get("metadata") or {}).get(
                 "resourceVersion"
             )
             try:
                 self.server.update(RESOURCE_LEASES, record)
+                self._generation = int(record["spec"]["leaseTransitions"])
                 return True
             except (ConflictError, NotFoundError):
                 return False
         return False
+
+    def release(self) -> None:
+        """Graceful release: zero ``holderIdentity`` on our own lease so a
+        standby (or our own restart) acquires immediately instead of waiting
+        out ``lease_duration`` (client-go ReleaseOnCancel).  The lease object
+        itself survives — deleting it would reset ``leaseTransitions`` and
+        with it the monotonic generation the fencing tokens depend on."""
+        try:
+            current = self.server.get(RESOURCE_LEASES, self.namespace, self.lock_name)
+        except Exception:
+            return
+        spec = current.get("spec") or {}
+        if spec.get("holderIdentity") != self.identity:
+            return  # not ours: never clobber another holder's lease
+        record = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {
+                "name": self.lock_name,
+                "namespace": self.namespace,
+                "resourceVersion": (current.get("metadata") or {}).get("resourceVersion"),
+            },
+            "spec": {
+                "holderIdentity": "",
+                "leaseDurationSeconds": spec.get("leaseDurationSeconds"),
+                "acquireTime": spec.get("acquireTime"),
+                "renewTime": rfc3339micro(time.time()),
+                "leaseTransitions": int(spec.get("leaseTransitions") or 0),
+            },
+        }
+        try:
+            self.server.update(RESOURCE_LEASES, record)
+        except Exception as e:
+            # best effort: a failed release degrades to the lease expiring
+            log.warning("lease release failed (standby must wait it out): %s", e)
 
     # -- run loop ------------------------------------------------------------
 
@@ -161,12 +231,30 @@ class LeaderElector:
             if stop_event.wait(self.retry_period):
                 return
         if stop_event.is_set():
+            # stopped right as the acquire succeeded: we hold the lease but
+            # never led — a graceful stop must still hand it back
+            if self.release_on_stop:
+                self.release()
             return
         self.is_leader = True
         metrics.is_leader.set(1)
-        log.info("%s became leader", self.identity)
+        self.transitions += 1
+        metrics.leader_transitions.inc()
+        log.info("%s became leader (generation %d)", self.identity, self._generation)
         if self.on_started_leading:
-            self.on_started_leading()
+            # separate thread, like client-go's OnStartedLeading goroutine:
+            # the controller's cold start (informer LIST + cache sync) can
+            # outlast lease_duration on a big cluster, and running it inline
+            # would block renewal — the lease would expire MID cold start
+            # and a standby would steal leadership from a healthy leader
+            t = threading.Thread(target=self.on_started_leading, daemon=True,
+                                 name="leading-callback")
+            t.start()
+            # published only once started: a racing joiner must never see an
+            # unstarted Thread (join would raise) — and joining the elector
+            # thread first (see OperatorApp._stop_threads) makes this
+            # publication visible before anyone reads it
+            self.leading_thread = t
         while not stop_event.is_set():
             deadline = time.time() + self.renew_deadline
             renewed = False
@@ -180,18 +268,22 @@ class LeaderElector:
             if not renewed:
                 self.is_leader = False
                 metrics.is_leader.set(0)
+                self.transitions += 1
+                metrics.leader_transitions.inc()
                 log.error("%s lost leadership", self.identity)
                 if self.on_stopped_leading:
                     self.on_stopped_leading()
                 return
             if stop_event.wait(self.retry_period):
                 break
-        # clean stop: release the lease for a fast failover
+        # clean stop: zero holderIdentity for a fast failover.  A hard kill
+        # (release_on_stop=False, crash simulation) skips BOTH the release
+        # and the transition count — a SIGKILLed process could report
+        # neither, and the simulated crash must not skew the
+        # leader_transitions series operators alert on
         self.is_leader = False
         metrics.is_leader.set(0)
-        try:
-            current = self.server.get(RESOURCE_LEASES, self.namespace, self.lock_name)
-            if (current.get("spec") or {}).get("holderIdentity") == self.identity:
-                self.server.delete(RESOURCE_LEASES, self.namespace, self.lock_name)
-        except Exception:
-            pass
+        if self.release_on_stop:
+            self.transitions += 1
+            metrics.leader_transitions.inc()
+            self.release()

@@ -329,3 +329,31 @@ events_dropped = Counter(
     "(the local recorder tail still holds them)",
     REGISTRY,
 )
+
+# Crash-only / HA series (the crash-safety PR): leadership churn, cold-start
+# recovery latency, and writes rejected by the fencing layer.
+leader_transitions = Counter(
+    "tpujob_operator_leader_transitions_total",
+    "Leadership transitions observed by this instance (acquisitions plus "
+    "losses)",
+    REGISTRY,
+)
+cold_start_duration = LabeledHistogram(
+    "tpujob_operator_cold_start_duration_seconds",
+    "Cold-start recovery latency by stage: controller start -> informer "
+    "caches synced (caches_synced) and -> first completed sync (first_sync)",
+    REGISTRY,
+    ("stage",),
+    # cold starts are LIST-of-the-whole-cluster scale, not cache-hit scale:
+    # on a big cluster they can exceed the 15 s lease_duration — the default
+    # sub-10s latency buckets would collapse exactly the slow cold starts
+    # this metric exists to expose into +Inf
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+             120.0, 300.0, 600.0),
+)
+fenced_writes_rejected = Counter(
+    "tpujob_operator_fenced_writes_rejected_total",
+    "Mutating API calls rejected by write fencing (leadership lost locally, "
+    "or a stale fencing token caught server-side)",
+    REGISTRY,
+)

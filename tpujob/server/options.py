@@ -33,6 +33,11 @@ class ServerOption:
     lease_duration_s: float = 15.0
     renew_deadline_s: float = 5.0
     retry_period_s: float = 3.0
+    # write fencing (rides leader election): the controller's mutating API
+    # calls carry a (holder, lease-generation) token and are rejected the
+    # moment leadership is lost, so a deposed leader resuming mid-handover
+    # cannot double-create pods.  Only meaningful with leader election on.
+    enable_fencing: bool = True
     qps: float = 50.0
     burst: int = 100
     # crash-loop damper: decaying delay between a counted ExitCode restart
@@ -87,6 +92,14 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         dest="leader_election_namespace",
                         help="namespace for the leader-election Lease "
                              "(default: operator's own namespace)")
+    parser.add_argument("--fencing", dest="enable_fencing", action="store_true",
+                        default=True,
+                        help="fence the controller's writes on the leader-"
+                             "election token (default on; no-op without "
+                             "leader election)")
+    parser.add_argument("--no-fencing", dest="enable_fencing", action="store_false",
+                        help="disable write fencing (a deposed leader's in-"
+                             "flight writes are no longer rejected)")
     parser.add_argument("--lease-duration", type=float, default=15.0, dest="lease_duration_s")
     parser.add_argument("--renew-deadline", type=float, default=5.0, dest="renew_deadline_s")
     parser.add_argument("--retry-period", type=float, default=3.0, dest="retry_period_s")
