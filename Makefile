@@ -11,7 +11,7 @@ SHELL := /bin/bash
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci ci-fast native lint codegen-verify unit unit-fast test trace-smoke failover-smoke e2e soak bench-smoke bench-controller dryrun images clean
+.PHONY: ci ci-fast native lint codegen-verify unit unit-fast test trace-smoke failover-smoke write-path-smoke e2e soak bench-smoke bench-controller dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
@@ -46,9 +46,15 @@ trace-smoke:
 failover-smoke:
 	$(PY) scripts/failover_smoke.py
 
+# write-path smoke (~10 s): the churn bench's optimized run (no-op status
+# suppression + event coalescing + merge-patch writes) must beat the naive
+# control by >= 2x on API write calls, with trace completeness intact
+write-path-smoke:
+	$(PY) scripts/write_path_smoke.py
+
 # the tier-1 command from ROADMAP.md, verbatim (modulo $$-escaping for
 # make), so local and CI invocations agree on what "the tests pass" means
-test: trace-smoke failover-smoke
+test: trace-smoke failover-smoke write-path-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # the operator/controller/kube/api tests only — the model-path suites
@@ -83,13 +89,18 @@ bench-smoke:
 	$(PY) bench_models.py --quick
 
 # control-plane reconcile throughput, small JxW matrix: the indexed+batched
-# controller vs the scan+serial control (one JSON line per run)
+# controller vs the scan+serial control (one JSON line per run), plus the
+# write-path churn pair (optimized asserts suppressed ratio > 0.5 and trace
+# completeness; the --no-suppress --no-coalesce control is the baseline for
+# the >= 2x API-write-call reduction)
 bench-controller:
 	$(PY) bench_controller.py --jobs 10 --workers 4
 	$(PY) bench_controller.py --jobs 10 --workers 4 --mode scan --serial
 	$(PY) bench_controller.py --jobs 50 --workers 8
 	$(PY) bench_controller.py --jobs 50 --workers 8 --no-trace
 	$(PY) bench_controller.py --jobs 50 --workers 8 --mode scan --serial
+	$(PY) bench_controller.py --jobs 10 --workers 8 --churn 4
+	$(PY) bench_controller.py --jobs 10 --workers 8 --churn 4 --no-suppress --no-coalesce
 
 images:
 	scripts/build_image.sh

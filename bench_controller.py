@@ -20,6 +20,17 @@ Modes (for before/after comparison on the same machine):
 ``--create-latency`` models the apiserver round trip one create costs
 (default 2 ms).  Both modes pay it; slow-start batching overlaps it.
 
+Write-path churn mode (``--churn N``): after every job reaches Running, the
+bench rewrites every owned pod's (unchanged) status N times, ``
+--churn-interval`` apart — the redundant pod-status event storm that
+dominates control-plane write QPS at operator scale — and reports the
+write-path ledger alongside the usual percentiles: API write calls + QPS
+issued by the controller during the storm, status_writes written/suppressed,
+patch-vs-put bytes, events coalesced, and syncs per pod event.  With the
+write-path optimizations on (the default) the run asserts the suppressed
+ratio exceeds 0.5; ``--no-suppress --no-coalesce`` (and optionally
+``--no-patch``) reproduce the naive write path as the control.
+
 With tracing on, the run also asserts trace completeness: every completed
 sync yielded exactly one CLOSED root span carrying a queue-latency child,
 and every pod-creating sync carries API-call child spans.
@@ -59,6 +70,67 @@ class LatencyServer(InMemoryAPIServer):
         if self.create_latency > 0:
             time.sleep(self.create_latency)
         return super().create(resource, obj)
+
+
+class CountingTransport:
+    """ApiServer-surface proxy counting the CONTROLLER's API calls by verb —
+    the write-QPS ledger the churn mode reports.  The simulated kubelet and
+    the bench driver talk to the raw server underneath, so only
+    operator-issued traffic is counted."""
+
+    WRITE_VERBS = ("create", "update", "update_status", "patch",
+                   "patch_status", "delete")
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.calls: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def _count(self, verb: str) -> None:
+        with self._lock:
+            self.calls[verb] = self.calls.get(verb, 0) + 1
+
+    def write_calls(self) -> int:
+        with self._lock:
+            return sum(self.calls.get(v, 0) for v in self.WRITE_VERBS)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def create(self, *a, **kw):
+        self._count("create")
+        return self._inner.create(*a, **kw)
+
+    def get(self, *a, **kw):
+        self._count("get")
+        return self._inner.get(*a, **kw)
+
+    def list(self, *a, **kw):
+        self._count("list")
+        return self._inner.list(*a, **kw)
+
+    def update(self, *a, **kw):
+        self._count("update")
+        return self._inner.update(*a, **kw)
+
+    def update_status(self, *a, **kw):
+        self._count("update_status")
+        return self._inner.update_status(*a, **kw)
+
+    def patch(self, *a, **kw):
+        self._count("patch")
+        return self._inner.patch(*a, **kw)
+
+    def patch_status(self, *a, **kw):
+        self._count("patch_status")
+        return self._inner.patch_status(*a, **kw)
+
+    def delete(self, *a, **kw):
+        self._count("delete")
+        return self._inner.delete(*a, **kw)
+
+    def watch(self, *a, **kw):
+        return self._inner.watch(*a, **kw)
 
 
 def install_kubelet(server: InMemoryAPIServer) -> None:
@@ -195,9 +267,92 @@ def _check_trace_completeness(ctrl, syncs: int,
     return {"traces_sampled": len(traces), "traces_with_api_spans": with_api}
 
 
+def _run_churn(server, counted: CountingTransport, latencies, lat_lock,
+               rounds: int, interval: float, suppress: bool,
+               coalesce: bool) -> Dict:
+    """Redundant pod-status storm over every owned pod: rewrites each pod's
+    unchanged status ``rounds`` times and measures what the controller wrote
+    back.  Metric reads are deltas, so repeated in-process runs (the smoke
+    comparison) stay independent."""
+    from tpujob.server import metrics
+
+    owned = []
+    for obj in server.list(RESOURCE_PODS):
+        meta = obj.get("metadata") or {}
+        if c.LABEL_JOB_NAME in (meta.get("labels") or {}):
+            owned.append((meta.get("namespace"), meta.get("name"),
+                          obj.get("status") or {}))
+    w0 = counted.write_calls()
+    wr0 = metrics.status_writes.labels(result="written").value
+    sup0 = metrics.status_writes.labels(result="suppressed").value
+    co0 = metrics.syncs_coalesced.value
+    pb0 = metrics.status_patch_bytes.value
+    fb0 = metrics.status_full_bytes.value
+    with lat_lock:
+        syncs0 = len(latencies)
+    t0 = time.perf_counter()
+    events = 0
+    for _ in range(rounds):
+        for ns, name, status in owned:
+            server.update_status(RESOURCE_PODS, {
+                "metadata": {"namespace": ns, "name": name},
+                "status": status,
+            })
+            events += 1
+        time.sleep(interval)
+    # quiesce: the write window closes once no new syncs land for 0.5 s and
+    # the root-span ledger balances (nothing mid-flight)
+    deadline = time.monotonic() + 30
+    stable_since, last_n = None, -1
+    while time.monotonic() < deadline:
+        with lat_lock:
+            n = len(latencies)
+        started, closed = TRACER.counters()
+        if n == last_n and started == closed:
+            if stable_since is None:
+                stable_since = time.monotonic()
+            elif time.monotonic() - stable_since >= 0.5:
+                break
+        else:
+            stable_since, last_n = None, n
+        time.sleep(0.05)
+    elapsed = time.perf_counter() - t0
+    writes = counted.write_calls() - w0
+    written = metrics.status_writes.labels(result="written").value - wr0
+    suppressed = metrics.status_writes.labels(result="suppressed").value - sup0
+    with lat_lock:
+        churn_syncs = len(latencies) - syncs0
+    decisions = written + suppressed
+    report = {
+        "churn_rounds": rounds,
+        "churn_pod_events": events,
+        "churn_elapsed_s": round(elapsed, 4),
+        "churn_api_write_calls": writes,
+        "churn_api_write_qps": round(writes / elapsed, 2) if elapsed else 0.0,
+        "churn_syncs": churn_syncs,
+        "syncs_per_pod_event": round(churn_syncs / events, 4) if events else 0.0,
+        "status_writes_written": int(written),
+        "status_writes_suppressed": int(suppressed),
+        "suppressed_ratio": (round(suppressed / decisions, 4)
+                             if decisions else 0.0),
+        "syncs_coalesced": int(metrics.syncs_coalesced.value - co0),
+        "status_patch_bytes": int(metrics.status_patch_bytes.value - pb0),
+        "status_full_bytes": int(metrics.status_full_bytes.value - fb0),
+    }
+    if suppress and coalesce and report["suppressed_ratio"] <= 0.5:
+        raise AssertionError(
+            f"write-path churn: suppressed-write ratio "
+            f"{report['suppressed_ratio']} <= 0.5 (written={int(written)}, "
+            f"suppressed={int(suppressed)})")
+    return report
+
+
 def run_bench(jobs: int, workers: int, threadiness: int, mode: str,
               serial: bool, create_latency: float, timeout: float,
-              background_pods: int = 1000, trace: bool = True) -> Dict:
+              background_pods: int = 1000, trace: bool = True,
+              churn_rounds: int = 0, churn_interval: float = 0.3,
+              suppress: bool = True, coalesce: bool = True,
+              patch: bool = True) -> Dict:
     server = LatencyServer(create_latency=create_latency)
     # a busy cluster: pods the operator does not own and must not touch.
     # The indexed claim path never sees them; the scan control walks them
@@ -212,11 +367,15 @@ def run_bench(jobs: int, workers: int, threadiness: int, mode: str,
             "status": {"phase": "Running"},
         })
     install_kubelet(server)
-    clients = ClientSet(server)
+    counted = CountingTransport(server)
+    clients = ClientSet(counted)
     ctrl = TPUJobController(
         clients,
         config=ControllerConfig(threadiness=threadiness, resync_period=0,
-                                enable_tracing=trace),
+                                enable_tracing=trace,
+                                suppress_noop_status=suppress,
+                                status_patch=patch,
+                                settle_window_s=0.02 if coalesce else 0.0),
     )
     trace_started0, trace_closed0 = TRACER.counters()
     if mode == "scan":
@@ -252,6 +411,11 @@ def run_bench(jobs: int, workers: int, threadiness: int, mode: str,
         if pending:
             time.sleep(0.005)
     elapsed = time.perf_counter() - t0
+    churn_report: Dict = {}
+    if not pending and churn_rounds > 0:
+        churn_report = _run_churn(server, counted, latencies, lat_lock,
+                                  churn_rounds, churn_interval, suppress,
+                                  coalesce)
     stop.set()
     ctrl.factory.stop()
     if pending:
@@ -287,7 +451,11 @@ def run_bench(jobs: int, workers: int, threadiness: int, mode: str,
         "metric": "controller_reconcile",
         "mode": mode,
         "serial": serial,
+        "suppress": suppress,
+        "coalesce": coalesce,
+        "patch": patch,
         **trace_report,
+        **churn_report,
         "jobs": jobs,
         "workers": workers,
         "threadiness": threadiness,
@@ -320,6 +488,23 @@ def build_parser() -> argparse.ArgumentParser:
                    default=True,
                    help="disable per-sync tracing (the pre-flight-recorder "
                         "baseline; skips the trace-completeness assertion)")
+    p.add_argument("--churn", type=int, default=0, dest="churn_rounds",
+                   help="write-path churn mode: rewrite every owned pod's "
+                        "unchanged status this many times after bring-up and "
+                        "report the write-path ledger (0 disables)")
+    p.add_argument("--churn-interval", type=float, default=0.3,
+                   help="seconds between churn rounds (the storm spreads "
+                        "over rounds x interval of wall time)")
+    p.add_argument("--no-suppress", dest="suppress", action="store_false",
+                   default=True,
+                   help="disable no-op status-write suppression (control)")
+    p.add_argument("--no-coalesce", dest="coalesce", action="store_false",
+                   default=True,
+                   help="disable per-job event coalescing (control)")
+    p.add_argument("--no-patch", dest="patch", action="store_false",
+                   default=True,
+                   help="full-object status PUTs instead of merge patches "
+                        "(control)")
     return p
 
 
@@ -329,7 +514,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         result = run_bench(args.jobs, args.workers, args.threadiness, args.mode,
                            args.serial, args.create_latency, args.timeout,
                            background_pods=args.background_pods,
-                           trace=args.trace)
+                           trace=args.trace,
+                           churn_rounds=args.churn_rounds,
+                           churn_interval=args.churn_interval,
+                           suppress=args.suppress,
+                           coalesce=args.coalesce,
+                           patch=args.patch)
     except (TimeoutError, AssertionError) as e:
         print(f"FAIL: {e}", file=sys.stderr)
         return 1

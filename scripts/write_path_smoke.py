@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Write-path smoke: the churn benchmark's optimized run must beat the
+naive-control run by >= 2x on API write calls, end to end.
+
+Runs ``bench_controller.run_bench`` twice in-process on the same workload
+shape (J jobs x W workers + a redundant pod-status storm):
+
+1. **control** — ``--no-suppress --no-coalesce``: every changed sync writes,
+   every event enqueues its own sync (the pre-overhaul write path).
+2. **optimized** — suppression + coalescing + merge-patch writes on (the
+   defaults).
+
+Asserts, per the write-path acceptance bar:
+
+- control API write calls during the storm >= 2x the optimized run's;
+- the optimized run suppressed > 50% of its status-write decisions (checked
+  inside run_bench) and coalesced events;
+- trace completeness still holds for both runs (exactly one closed root
+  span per sync — checked inside run_bench).
+
+Wired as a ``make test`` prerequisite (``make write-path-smoke``);
+budget ~10 s.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_controller import run_bench
+
+SHAPE = dict(jobs=6, workers=4, threadiness=4, mode="indexed", serial=False,
+             create_latency=0.0, timeout=60.0, background_pods=50,
+             trace=True, churn_rounds=4, churn_interval=0.3)
+
+
+def main() -> int:
+    control = run_bench(suppress=False, coalesce=False, **SHAPE)
+    optimized = run_bench(suppress=True, coalesce=True, **SHAPE)
+
+    c_writes = control["churn_api_write_calls"]
+    o_writes = optimized["churn_api_write_calls"]
+    if c_writes < 2 * max(1, o_writes):
+        raise AssertionError(
+            f"write-path smoke: control issued {c_writes} API write call(s) "
+            f"during the storm vs optimized {o_writes} — less than the "
+            "required 2x reduction")
+    if optimized["syncs_coalesced"] <= 0:
+        raise AssertionError("write-path smoke: no events were coalesced")
+    if optimized["churn_syncs"] >= control["churn_syncs"]:
+        raise AssertionError(
+            f"write-path smoke: coalescing did not reduce syncs "
+            f"({optimized['churn_syncs']} vs control {control['churn_syncs']})")
+    print(
+        "write-path-smoke: OK "
+        f"(writes {c_writes} -> {o_writes}, "
+        f"syncs {control['churn_syncs']} -> {optimized['churn_syncs']} "
+        f"for {optimized['churn_pod_events']} pod events, "
+        f"suppressed_ratio={optimized['suppressed_ratio']}, "
+        f"coalesced={optimized['syncs_coalesced']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -383,7 +383,17 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     # faithful RFC 7386 merge: stale keys SURVIVE a
                     # merge-patch, exactly like a real apiserver — a client
-                    # that merge-patches omit-empty statuses fails tests here
+                    # that merge-patches omit-empty statuses fails tests here.
+                    # A patch body carrying metadata.resourceVersion is an
+                    # optimistic-concurrency precondition: the apiserver
+                    # rejects the write with 409 when it no longer matches.
+                    want_rv = (patch.get("metadata") or {}).get("resourceVersion")
+                    cur_rv = (cur.get("metadata") or {}).get("resourceVersion")
+                    if want_rv is not None and str(want_rv) != str(cur_rv):
+                        self._fail(
+                            409, "Conflict",
+                            f"resourceVersion {want_rv} does not match {cur_rv}")
+                        return
                     merged = dict(cur.get("status") or {})
                     _rfc7386_merge(merged, patch.get("status") or {})
                     cur["status"] = merged

@@ -211,6 +211,55 @@ def test_update_status_clears_stale_fields(shim, transport):
     assert worker == {"succeeded": 2}, f"stale status keys survived: {worker}"
 
 
+def test_patch_status_merge_semantics(shim, transport):
+    """The write-path fast verb against the real dialect: merge-PATCH of
+    /status with RFC 7386 semantics — stale keys SURVIVE unless explicitly
+    nulled (which is why the controller's diff emits null deletions)."""
+    transport.create(c.PLURAL, _job("j1"))
+    transport.update_status(
+        c.PLURAL,
+        {"metadata": {"name": "j1", "namespace": "default"},
+         "status": {"replicaStatuses": {"Worker": {"active": 2}},
+                    "startTime": "t0"}},
+    )
+    # omitting a key keeps it; nulling deletes it
+    transport.patch_status(
+        c.PLURAL, "default", "j1",
+        {"replicaStatuses": {"Worker": {"succeeded": 2}}})
+    worker = transport.get(c.PLURAL, "default", "j1")["status"]["replicaStatuses"]["Worker"]
+    assert worker == {"active": 2, "succeeded": 2}, "merge dropped stale keys"
+    transport.patch_status(
+        c.PLURAL, "default", "j1",
+        {"replicaStatuses": {"Worker": {"active": None}}})
+    worker = transport.get(c.PLURAL, "default", "j1")["status"]["replicaStatuses"]["Worker"]
+    assert worker == {"succeeded": 2}, "null deletion did not remove the key"
+
+
+def test_patch_status_rv_precondition(shim, transport):
+    """A merge patch carrying metadata.resourceVersion is RV-checked (409 on
+    mismatch) — the optimistic-concurrency mode the restarts counter uses."""
+    transport.create(c.PLURAL, _job("j1"))
+    cur = transport.get(c.PLURAL, "default", "j1")
+    rv = cur["metadata"]["resourceVersion"]
+    out = transport.patch_status(
+        c.PLURAL, "default", "j1",
+        {"replicaStatuses": {"Worker": {"restarts": 1}}}, resource_version=rv)
+    assert out["status"]["replicaStatuses"]["Worker"]["restarts"] == 1
+    with pytest.raises(ConflictError):
+        transport.patch_status(
+            c.PLURAL, "default", "j1",
+            {"replicaStatuses": {"Worker": {"restarts": 99}}},
+            resource_version=rv)  # now stale
+    worker = transport.get(c.PLURAL, "default", "j1")["status"]["replicaStatuses"]["Worker"]
+    assert worker["restarts"] == 1, "conflicted patch mutated status"
+    # without a precondition the same patch lands (spec writers bumping the
+    # RV no longer conflict with status writes)
+    transport.patch_status(
+        c.PLURAL, "default", "j1", {"replicaStatuses": {"Worker": {"restarts": 2}}})
+    worker = transport.get(c.PLURAL, "default", "j1")["status"]["replicaStatuses"]["Worker"]
+    assert worker["restarts"] == 2
+
+
 def test_patch_merge(shim, transport):
     transport.create(c.PLURAL, _job("j1"))
     out = transport.patch(

@@ -46,6 +46,27 @@ def test_http_transport_crud(http_api):
         client.get("pods", "default", "p")
 
 
+def test_http_patch_status_route(http_api):
+    client = HTTPApiClient(http_api.address)
+    client.create("tpujobs", {"metadata": {"name": "j"}})
+    client.update_status("tpujobs", {"metadata": {"name": "j"},
+                                     "status": {"startTime": "t0",
+                                                "replicaStatuses": {"Worker": {"active": 1}}}})
+    out = client.patch_status("tpujobs", "default", "j",
+                              {"replicaStatuses": {"Worker": {"active": None,
+                                                              "succeeded": 1}}})
+    assert out["status"]["replicaStatuses"]["Worker"] == {"succeeded": 1}
+    assert out["status"]["startTime"] == "t0"
+    rv = out["metadata"]["resourceVersion"]
+    client.patch_status("tpujobs", "default", "j", {"startTime": "t1"},
+                        resource_version=rv)
+    with pytest.raises(ConflictError):
+        client.patch_status("tpujobs", "default", "j", {"startTime": "t2"},
+                            resource_version=rv)  # stale precondition
+    with pytest.raises(NotFoundError):
+        client.patch_status("tpujobs", "default", "absent", {"startTime": "x"})
+
+
 def test_http_watch_stream(http_api):
     client = HTTPApiClient(http_api.address)
     watch = client.watch("pods")

@@ -1,0 +1,541 @@
+"""API write-path tests: no-op status suppression, JSON-merge-patch status
+writes, conflict/timeout fallback discipline, server-side fencing of the
+patch verb, and work-queue event coalescing.
+
+The safety contract under test (ISSUE 5):
+
+- a suppressed write never drops a condition transition; terminal
+  transitions (Succeeded/Failed) and resync-driven drift repair always
+  write through;
+- a conflicted or timed-out patch falls back to refetch + re-diff, never a
+  blind full-object PUT that could resurrect stale fields;
+- fenced patches are rejected server-side exactly like PUTs.
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from tpujob.api import constants as c
+from tpujob.controller import status as st
+from tpujob.controller.job_base import ControllerConfig, _InstrumentedQueue
+from tpujob.controller.reconciler import TPUJobController
+from tpujob.kube.client import RESOURCE_TPUJOBS, ClientSet
+from tpujob.kube.errors import ConflictError, FencedError, ServerTimeoutError
+from tpujob.kube.fencing import FencedTransport, FencingToken
+from tpujob.kube.memserver import MODIFIED, InMemoryAPIServer
+from tpujob.runtime import WorkQueue
+from tpujob.server import metrics
+
+from tests.jobtestutil import Harness, new_tpujob
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def count_job_writes(server: InMemoryAPIServer):
+    """Count tpujob MODIFIED broadcasts (i.e. status writes that landed)."""
+    counts = {"n": 0}
+
+    def hook(ev_type, resource, obj):
+        if resource == RESOURCE_TPUJOBS and ev_type == MODIFIED:
+            counts["n"] += 1
+
+    server.hooks.append(hook)
+    return counts
+
+
+class VerbRecorder:
+    """Transport proxy recording (verb, resource) of every status write the
+    controller issues — the witness that the fallback path never degrades
+    to a full-object PUT."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.verbs = []
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def update_status(self, resource, obj):
+        self.verbs.append(("update_status", resource))
+        return self._inner.update_status(resource, obj)
+
+    def patch_status(self, resource, namespace, name, patch,
+                     resource_version=None):
+        self.verbs.append(("patch_status", resource))
+        return self._inner.patch_status(resource, namespace, name, patch,
+                                        resource_version=resource_version)
+
+    def job_puts(self):
+        return [v for v in self.verbs if v == ("update_status", RESOURCE_TPUJOBS)]
+
+
+class FlakyPatchStatus(VerbRecorder):
+    """Fails the first queued errors on patch_status, then passes through."""
+
+    def __init__(self, inner, failures):
+        super().__init__(inner)
+        self._failures = list(failures)
+
+    def patch_status(self, resource, namespace, name, patch,
+                     resource_version=None):
+        if resource == RESOURCE_TPUJOBS and self._failures:
+            raise self._failures.pop(0)
+        return super().patch_status(resource, namespace, name, patch,
+                                    resource_version=resource_version)
+
+
+class WrappedHarness(Harness):
+    """Harness whose controller speaks through a transport wrapper while the
+    assertions read the raw server underneath."""
+
+    def __init__(self, wrap, config=None):
+        self.server = InMemoryAPIServer()
+        self.transport = wrap(self.server)
+        self.clients = ClientSet(self.transport)
+        self.controller = TPUJobController(self.clients, config=config)
+
+
+def suppressed_count() -> float:
+    return metrics.status_writes.labels(result="suppressed").value
+
+
+# ---------------------------------------------------------------------------
+# semantic diff unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_merge_patch_none_on_volatile_only_change():
+    old = {
+        "conditions": [{"type": "Running", "status": "True",
+                        "lastUpdateTime": "a", "lastTransitionTime": "t"}],
+        "replicaStatuses": {"Worker": {"active": 3}},
+        "lastReconcileTime": "x",
+    }
+    new = {
+        "conditions": [{"type": "Running", "status": "True",
+                        "lastUpdateTime": "b", "lastTransitionTime": "t"}],
+        "replicaStatuses": {"Worker": {"active": 3}},
+        "lastReconcileTime": "y",
+    }
+    assert st.status_merge_patch(old, new) is None
+
+
+def test_merge_patch_nulls_removed_keys():
+    # omit-empty serialization drops zeroed fields; the patch must delete
+    # them explicitly or they survive server-side forever
+    patch = st.status_merge_patch(
+        {"replicaStatuses": {"Worker": {"active": 2, "failed": 1}}},
+        {"replicaStatuses": {"Worker": {"failed": 1}}},
+    )
+    assert patch == {"replicaStatuses": {"Worker": {"active": None}}}
+
+
+def test_merge_patch_ships_whole_condition_list_raw():
+    old = {"conditions": [{"type": "Created", "status": "True",
+                           "lastUpdateTime": "a"}]}
+    new = {"conditions": [{"type": "Created", "status": "True",
+                           "lastUpdateTime": "b"},
+                          {"type": "Running", "status": "True",
+                           "lastUpdateTime": "b"}]}
+    patch = st.status_merge_patch(old, new)
+    # lists are atomic under merge patch: the full raw list ships,
+    # volatile fields included
+    assert patch["conditions"] == new["conditions"]
+
+
+def test_patch_touches_restarts_detection():
+    assert st.patch_touches_restarts(
+        {"replicaStatuses": {"Worker": {"restarts": 3}}})
+    assert st.patch_touches_restarts({"replicaStatuses": {"Worker": None}})
+    assert st.patch_touches_restarts({"replicaStatuses": None})
+    assert not st.patch_touches_restarts(
+        {"replicaStatuses": {"Worker": {"active": 1}}})
+    assert not st.patch_touches_restarts({"conditions": []})
+
+
+# ---------------------------------------------------------------------------
+# no-op suppression safety
+# ---------------------------------------------------------------------------
+
+
+def test_noop_syncs_suppress_status_writes():
+    h = Harness()
+    writes = count_job_writes(h.server)
+    h.submit(new_tpujob())
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    settled = writes["n"]
+    sup0 = suppressed_count()
+    for _ in range(5):
+        h.sync()
+    assert writes["n"] == settled, "a no-op sync wrote status"
+    assert suppressed_count() > sup0, "suppression was silent, not counted"
+
+
+def test_condition_transition_never_suppressed():
+    h = Harness()
+    h.submit(new_tpujob(restart_policy="ExitCode"))
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    writes = count_job_writes(h.server)
+    h.set_pod_phase("test-job", "Worker", 1, "Failed", exit_code=137)
+    # one sync round: later rounds see the recreated pod and flip the job
+    # back to Running, which is not what this test is about
+    h.controller.factory.sync_all()
+    h.controller.sync_handler("default/test-job")
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_RESTARTING)
+    assert job.status.replica_statuses["Worker"].restarts == 1
+    assert writes["n"] > 0, "the Restarting transition was suppressed"
+
+
+def test_terminal_transition_writes_through():
+    h = Harness()
+    h.submit(new_tpujob())
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    writes = count_job_writes(h.server)
+    h.set_pod_phase("test-job", "Master", 0, "Succeeded")
+    h.sync()
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_SUCCEEDED)
+    assert job.status.completion_time
+    assert writes["n"] > 0
+    # terminal state settled: further syncs are pure no-ops again
+    settled = writes["n"]
+    h.sync()
+    assert writes["n"] == settled
+
+
+def test_resync_drift_repair_not_suppressed():
+    """A foreign/corrupt write that wipes the server-side status must be
+    repaired by the next (resync-driven) sync: the recomputed status diffs
+    against the drifted cache and writes through."""
+    h = Harness()
+    h.submit(new_tpujob())
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_RUNNING)
+    # wipe the status server-side (unconditional write, no RV)
+    h.server.update_status(RESOURCE_TPUJOBS, {
+        "metadata": {"namespace": "default", "name": "test-job"},
+        "status": {},
+    })
+    h.sync()  # informers observe the wipe, the sync recomputes + rewrites
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_RUNNING), "drift was not repaired"
+    assert job.status.replica_statuses["Worker"].active == 3
+
+
+def test_patch_write_survives_concurrent_spec_bump():
+    """The point of the merge-patch verb: a status write whose diff touches
+    only derived fields must land even though a concurrent spec/metadata
+    write bumped the object's resourceVersion (the full-object PUT would
+    have 409'd and requeued)."""
+    h = WrappedHarness(VerbRecorder)
+    h.submit(new_tpujob())
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    # a user updates the job object; the JOB informer does not see it
+    raw = h.server.get(RESOURCE_TPUJOBS, "default", "test-job")
+    raw["metadata"].setdefault("labels", {})["touched"] = "yes"
+    h.server.update(RESOURCE_TPUJOBS, raw)
+    # a pod transition forces a derived-fields status write from the now
+    # RV-stale cache (Master succeeded -> terminal transition, no restarts)
+    h.set_pod_phase("test-job", "Master", 0, "Succeeded")
+    h.controller.factory.informer("pods").sync_once()
+    h.controller.sync_handler("default/test-job")
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_SUCCEEDED)
+    assert job.metadata.labels.get("touched") == "yes"
+    assert not h.transport.job_puts(), "status went out as a full PUT"
+
+
+# ---------------------------------------------------------------------------
+# conflict / timeout fallback discipline
+# ---------------------------------------------------------------------------
+
+
+def test_restart_conflict_rebases_via_patch_never_put():
+    """The stale-cache restarts conflict (see test_controller's rebase test)
+    must resolve through refetch + restarts-only RV-checked patch — the
+    count lands on the fresh object and no full PUT is ever issued."""
+    h = WrappedHarness(VerbRecorder)
+    h.submit(new_tpujob(restart_policy="ExitCode"))
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    fresh = h.get_job()
+    fresh.status.replica_statuses["Worker"].restarts = 5
+    h.server.update_status(RESOURCE_TPUJOBS, fresh.to_dict())
+    h.set_pod_phase("test-job", "Worker", 1, "Failed", exit_code=137)
+    h.controller.factory.informer("pods").sync_once()
+    h.controller.sync_handler("default/test-job")
+    got = h.get_job()
+    assert got.status.replica_statuses["Worker"].restarts == 6
+    assert not h.transport.job_puts(), "conflict fallback used a full PUT"
+
+
+def test_spurious_conflict_on_patch_requeues_and_rediffs():
+    """An injected 409 on a derived-fields patch (the chaos schedule's
+    spurious conflict): the sync requeues and the NEXT sync re-diffs
+    against the cache and writes cleanly — no blind PUT in between."""
+    h = WrappedHarness(
+        lambda s: FlakyPatchStatus(s, [ConflictError("chaos: injected 409")]))
+    h.submit(new_tpujob())
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync(rounds=4)
+    job = h.get_job()
+    assert h.check_condition(job, c.JOB_RUNNING)
+    assert not h.transport.job_puts()
+
+
+def test_timeout_on_patch_restashes_deltas_no_double_count():
+    """A 504 mid status-write: the sync raises (workqueue backoff), the
+    executed pod deletion's restart delta survives on the ledger, and the
+    retry sync persists it exactly once."""
+    h = WrappedHarness(lambda s: FlakyPatchStatus(s, []))
+    h.submit(new_tpujob(restart_policy="ExitCode"))
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    # arm the fault AFTER bring-up, so it lands on the restart write
+    h.transport._failures.append(ServerTimeoutError("chaos: 504"))
+    h.set_pod_phase("test-job", "Worker", 1, "Failed", exit_code=137)
+    h.controller.factory.informer("pods").sync_once()
+    with pytest.raises(ServerTimeoutError):
+        h.controller.sync_handler("default/test-job")
+    h.sync(rounds=4)  # retry syncs: fold the carried delta, write it
+    got = h.get_job()
+    # exactly once: not lost to the 504, not double-counted by the retries
+    # (the recreated pod has flipped the job back to Running by now)
+    assert got.status.replica_statuses["Worker"].restarts == 1
+    assert not h.transport.job_puts()
+
+
+def test_stale_write_dropped_when_job_recreated_mid_sync():
+    """A job deleted and recreated under the same name while a sync of the
+    OLD incarnation is in flight: the stale status (terminal condition,
+    restart counts) must not be born onto the new object.  The PUT path got
+    this via the dead incarnation's resourceVersion; the patch path must
+    check object identity itself."""
+    h = Harness()
+    h.submit(new_tpujob(restart_policy="ExitCode"))
+    h.sync()
+    h.set_all_phases("test-job", "Running")
+    h.sync()
+    # capture the OLD incarnation mid-sync, with a would-be terminal status
+    old_job = h.get_job()
+    old_job.status.replica_statuses["Worker"].restarts = 7
+    import tpujob.controller.status as stmod
+    stmod.update_job_conditions(
+        old_job.status, c.JOB_FAILED, stmod.REASON_JOB_FAILED, "stale failure")
+    # delete + recreate: the informer cache now holds the NEW incarnation
+    h.clients.tpujobs.delete("default", "test-job")
+    h.submit(new_tpujob())
+    h.controller.factory.sync_all()
+    h.controller.update_status_handler(old_job)  # the in-flight stale write
+    newborn = h.get_job()
+    assert not h.check_condition(newborn, c.JOB_FAILED), (
+        "the dead incarnation's terminal condition landed on the new job")
+    rs = newborn.status.replica_statuses.get("Worker")
+    assert rs is None or rs.restarts == 0, (
+        "the dead incarnation's restart count landed on the new job")
+
+
+def test_fenced_patch_rejected_server_side():
+    """patch_status participates in write fencing exactly like PUTs: a
+    stale token is rejected at the storage layer with FencedError."""
+    server = InMemoryAPIServer()
+    server.create("leases", {
+        "metadata": {"namespace": "default", "name": "tpujob-operator"},
+        "spec": {"holderIdentity": "leader-b", "leaseTransitions": 3},
+    })
+    server.enable_fence_validation()
+    server.create(RESOURCE_TPUJOBS, new_tpujob().to_dict())
+    stale = FencedTransport(
+        server, lambda: FencingToken("leader-a", 2))  # deposed leader
+    with pytest.raises(FencedError):
+        stale.patch_status(RESOURCE_TPUJOBS, "default", "test-job",
+                           {"startTime": "now"})
+    assert ("patch_status", RESOURCE_TPUJOBS) in [
+        (v, r) for v, r, _ in server.fence_rejections]
+    live = FencedTransport(server, lambda: FencingToken("leader-b", 3))
+    out = live.patch_status(RESOURCE_TPUJOBS, "default", "test-job",
+                            {"startTime": "now"})
+    assert out["status"]["startTime"] == "now"
+
+
+# ---------------------------------------------------------------------------
+# memserver patch_status + shared-snapshot fan-out
+# ---------------------------------------------------------------------------
+
+
+def test_memserver_patch_status_merges_and_deletes():
+    s = InMemoryAPIServer()
+    s.create(RESOURCE_TPUJOBS, {"metadata": {"name": "j"}})
+    s.update_status(RESOURCE_TPUJOBS, {
+        "metadata": {"name": "j"},
+        "status": {"replicaStatuses": {"Worker": {"active": 2, "restarts": 1}},
+                   "startTime": "t0"},
+    })
+    out = s.patch_status(RESOURCE_TPUJOBS, "default", "j", {
+        "replicaStatuses": {"Worker": {"active": None, "succeeded": 2}},
+    })
+    worker = out["status"]["replicaStatuses"]["Worker"]
+    assert worker == {"restarts": 1, "succeeded": 2}
+    assert out["status"]["startTime"] == "t0"  # untouched keys survive
+    # only .status was touched: name/uid/creation metadata survive
+    assert out["metadata"]["name"] == "j"
+    assert out["metadata"]["uid"]
+
+
+def test_memserver_patch_status_rv_precondition():
+    s = InMemoryAPIServer()
+    s.create(RESOURCE_TPUJOBS, {"metadata": {"name": "j"}})
+    cur = s.get(RESOURCE_TPUJOBS, "default", "j")
+    rv = cur["metadata"]["resourceVersion"]
+    s.patch_status(RESOURCE_TPUJOBS, "default", "j", {"startTime": "a"},
+                   resource_version=rv)  # matching RV passes
+    with pytest.raises(ConflictError):
+        s.patch_status(RESOURCE_TPUJOBS, "default", "j", {"startTime": "b"},
+                       resource_version=rv)  # now stale
+    # no precondition: cannot conflict
+    s.patch_status(RESOURCE_TPUJOBS, "default", "j", {"startTime": "c"})
+    assert s.get(RESOURCE_TPUJOBS, "default", "j")["status"]["startTime"] == "c"
+
+
+def test_watch_fanout_shares_one_snapshot_per_event():
+    """Satellite: the fan-out must deliver ONE immutable snapshot per event
+    to every subscriber (and hook), deep-copying only at the read API
+    boundary."""
+    s = InMemoryAPIServer()
+    seen = []
+    s.hooks.append(lambda t, r, obj: seen.append(obj))
+    w1 = s.watch("pods")
+    w2 = s.watch("pods")
+    s.create("pods", {"metadata": {"name": "p", "namespace": "default"}})
+    e1, e2 = w1.poll(timeout=1), w2.poll(timeout=1)
+    assert e1.object is e2.object, "subscribers got per-subscriber copies"
+    assert seen and seen[0] is e1.object, "hooks got their own copy"
+    # the read boundary still isolates callers from the store
+    got = s.get("pods", "default", "p")
+    assert got is not e1.object
+    got["metadata"]["labels"] = {"mutated": "yes"}
+    assert "labels" not in s.get("pods", "default", "p")["metadata"]
+
+
+# ---------------------------------------------------------------------------
+# work-queue coalescing + stamp semantics
+# ---------------------------------------------------------------------------
+
+
+def test_add_coalesced_absorbs_burst_into_one_item():
+    q = _InstrumentedQueue(WorkQueue())
+    co0 = metrics.syncs_coalesced.value
+    for _ in range(10):
+        q.add_coalesced("ns/j", 0.05)
+    assert metrics.syncs_coalesced.value - co0 == 9
+    assert q.get(timeout=1.0) == "ns/j"
+    q.pop_due("ns/j")
+    q.done("ns/j")
+    assert q.get(timeout=0.15) is None, "burst left extra queue items"
+    # the window ended at dequeue: the next event schedules a fresh sync
+    q.add_coalesced("ns/j", 0.02)
+    assert q.get(timeout=1.0) == "ns/j"
+
+
+def test_add_coalesced_zero_window_is_immediate():
+    q = _InstrumentedQueue(WorkQueue())
+    q.add_coalesced("k", 0.0)
+    assert q.get(timeout=0.2) == "k"
+
+
+def test_stamp_keeps_earliest_due():
+    """An immediate add makes a delayed key actionable NOW: the earlier due
+    stamp must win, or queue_latency would read ~0 for an item that
+    actually waited (and the first enqueue's stamp would be lost)."""
+    q = _InstrumentedQueue(WorkQueue())
+    q.add_after("k", 30.0)
+    q.add("k")
+    t0 = time.monotonic()
+    assert q.get(timeout=1.0) == "k"
+    due = q.pop_due("k")
+    assert due is not None and due <= t0 + 0.5, "later stamp overwrote the earlier one"
+
+
+def test_coalescing_controller_integration():
+    """A burst of redundant pod-status rewrites on a running job collapses
+    into a few syncs, none of which writes status."""
+    import threading
+
+    server = InMemoryAPIServer()
+    clients = ClientSet(server)
+    ctrl = TPUJobController(clients, config=ControllerConfig(
+        threadiness=2, resync_period=0, settle_window_s=0.04))
+    syncs = {"n": 0}
+    inner = ctrl.sync_handler
+
+    def counting_sync(key):
+        syncs["n"] += 1
+        return inner(key)
+
+    ctrl.sync_handler = counting_sync
+    writes = count_job_writes(server)
+    stop = threading.Event()
+    try:
+        ctrl.run(stop, threadiness=2)
+        server.create(RESOURCE_TPUJOBS, new_tpujob(workers=2).to_dict())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            pods = server.list("pods")
+            if len(pods) == 3:
+                break
+            time.sleep(0.01)
+        for pod in server.list("pods"):
+            server.update_status("pods", {
+                "metadata": {"namespace": pod["metadata"]["namespace"],
+                             "name": pod["metadata"]["name"]},
+                "status": {"phase": "Running", "containerStatuses": [
+                    {"name": c.DEFAULT_CONTAINER_NAME, "ready": True}]},
+            })
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            job = server.get(RESOURCE_TPUJOBS, "default", "test-job")
+            conds = {cond.get("type") for cond in
+                     (job.get("status") or {}).get("conditions") or []
+                     if cond.get("status") == "True"}
+            if c.JOB_RUNNING in conds:
+                break
+            time.sleep(0.01)
+        time.sleep(0.2)  # settle
+        syncs0, writes0 = syncs["n"], writes["n"]
+        co0 = metrics.syncs_coalesced.value
+        # the storm: 3 pods x 8 redundant rewrites = 24 events in a burst
+        for _ in range(8):
+            for pod in server.list("pods"):
+                server.update_status("pods", {
+                    "metadata": {"namespace": pod["metadata"]["namespace"],
+                                 "name": pod["metadata"]["name"]},
+                    "status": pod["status"],
+                })
+        time.sleep(0.6)  # several settle windows + processing
+        assert syncs["n"] - syncs0 <= 8, (
+            f"{syncs['n'] - syncs0} syncs for 24 coalescable events")
+        assert metrics.syncs_coalesced.value > co0
+        assert writes["n"] == writes0, "redundant churn caused status writes"
+    finally:
+        stop.set()
+        ctrl.factory.stop()

@@ -107,6 +107,21 @@ class ControllerConfig:
     # structured logger (rate-limited per job); <= 0 disables the dump
     slow_sync_threshold_s: float = 5.0
     flight_recorder_size: int = 256  # timeline entries retained per job
+    # --- API write-path knobs (status persistence proportional to change) ---
+    # skip the status write when the recomputed status is semantically
+    # identical to the informer-cached one (volatile timestamp refreshes do
+    # not count); counted as status_writes_total{result="suppressed"}
+    suppress_noop_status: bool = True
+    # ship status writes as a JSON-merge-patch of only the changed fields
+    # instead of a full-object PUT (False restores the PUT path, e.g. for a
+    # transport without the verb or as a bench control)
+    status_patch: bool = True
+    # per-job-key event coalescing: a pod/service/job watch event schedules
+    # the sync this many seconds out, and every further event on the same
+    # key inside the window rides that one sync instead of enqueueing its
+    # own — a 256-pod slice coming up triggers a handful of syncs, not 256.
+    # <= 0 disables (every event enqueues immediately, the pre-PR behavior).
+    settle_window_s: float = 0.02
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -118,22 +133,31 @@ def expectation_key(job_key: str, rtype: str, kind: str) -> str:
 class _InstrumentedQueue:
     """WorkQueue proxy stamping when each key became due, so dequeue can
     observe true queue latency (add→get for immediate adds, due→get for
-    delayed ones — client-go's workqueue_queue_duration_seconds role).
+    delayed ones — client-go's workqueue_queue_duration_seconds role), plus
+    per-key event coalescing for the storm path.
 
-    First stamp wins while a key is queued (matching the queue's dedup);
-    the stamp is popped at dequeue.  Everything else delegates to the
-    wrapped queue (which may be the native C++ one).
+    The EARLIEST due stamp wins while a key is queued: an immediate add
+    makes a delayed key actionable now, and a later duplicate must not
+    overwrite the first enqueue's stamp — either way queue_latency would be
+    misstated for the coalesced batch.  The stamp is popped at dequeue.
+    Everything else delegates to the wrapped queue (which may be the native
+    C++ one).
     """
 
     def __init__(self, inner):
         self._inner = inner
         self._due: Dict[str, float] = {}
+        # keys with a coalescing add_after in flight (scheduled, not yet
+        # dequeued): further event adds for them are absorbed
+        self._coalescing: set = set()
         self._lock = threading.Lock()
 
     def _stamp(self, key: str, delay: float) -> None:
         due = time.monotonic() + delay
         with self._lock:
-            self._due.setdefault(key, due)
+            cur = self._due.get(key)
+            if cur is None or due < cur:
+                self._due[key] = due
 
     def add(self, key: str) -> None:
         self._stamp(key, 0.0)
@@ -142,6 +166,32 @@ class _InstrumentedQueue:
     def add_after(self, key: str, delay: float) -> None:
         self._stamp(key, delay)
         self._inner.add_after(key, delay)
+
+    def add_coalesced(self, key: str, window: float) -> None:
+        """Event-driven add with burst dedup: the first event schedules the
+        sync ``window`` seconds out; every further event on the same key
+        before that sync is DEQUEUED rides along (counted, not enqueued).
+
+        Dequeue—not promotion—bounds the absorb phase: an event arriving
+        after the worker picked the key up must trigger a fresh sync, or a
+        change landing mid-sync would go unseen until resync (the inner
+        queue's dirty-while-processing handling then collapses it into one
+        follow-up sync, exactly like client-go).
+        """
+        if window <= 0:
+            self.add(key)
+            return
+        with self._lock:
+            if key in self._coalescing:
+                absorbed = True
+            else:
+                absorbed = False
+                self._coalescing.add(key)
+        if absorbed:
+            metrics.syncs_coalesced.inc()
+            return
+        self._stamp(key, window)
+        self._inner.add_after(key, window)
 
     def add_rate_limited(self, key: str) -> None:
         # no stamp: the inner queue computes the backoff delay internally,
@@ -156,6 +206,9 @@ class _InstrumentedQueue:
 
     def pop_due(self, key: str) -> Optional[float]:
         with self._lock:
+            # the key is being dequeued: end its coalescing window so the
+            # next event schedules a fresh sync
+            self._coalescing.discard(key)
             return self._due.pop(key, None)
 
     def __len__(self) -> int:
@@ -233,6 +286,15 @@ class JobController:
     def enqueue_job(self, key: str) -> None:
         self.queue.add(key)
 
+    def enqueue_job_event(self, key: str) -> None:
+        """Enqueue driven by an object watch event (pod/service/job change):
+        burst events on one job coalesce into a single sync behind a short
+        settle window (``settle_window_s``), so a 256-pod slice coming up —
+        or an event-storm replay — costs a handful of syncs, not one per
+        event.  Direct workflow enqueues (job creation, resync, deadline
+        requeues) stay immediate via :meth:`enqueue_job`."""
+        self.queue.add_coalesced(key, self.config.settle_window_s)
+
     # ------------------------------------------------------------------
     # pod/service event handlers (jobcontroller/pod.go:20-160)
     # ------------------------------------------------------------------
@@ -265,7 +327,7 @@ class JobController:
         rtype = self._replica_type_of(obj)
         if rtype:
             self.expectations.observe_add(expectation_key(key, rtype, "pods"))
-        self.enqueue_job(key)
+        self.enqueue_job_event(key)
 
     def update_pod(self, old: Dict[str, Any], new: Dict[str, Any]) -> None:
         if (old.get("metadata") or {}).get("resourceVersion") == (
@@ -274,7 +336,7 @@ class JobController:
             return
         key = self._owner_job_key(new) or self._owner_job_key(old)
         if key is not None:
-            self.enqueue_job(key)
+            self.enqueue_job_event(key)
 
     def delete_pod(self, obj: Dict[str, Any]) -> None:
         key = self._owner_job_key(obj)
@@ -283,7 +345,7 @@ class JobController:
         rtype = self._replica_type_of(obj)
         if rtype:
             self.expectations.observe_del(expectation_key(key, rtype, "pods"))
-        self.enqueue_job(key)
+        self.enqueue_job_event(key)
 
     def add_service(self, obj: Dict[str, Any]) -> None:
         key = self._owner_job_key(obj)
@@ -292,7 +354,7 @@ class JobController:
         rtype = self._replica_type_of(obj)
         if rtype:
             self.expectations.observe_add(expectation_key(key, rtype, "services"))
-        self.enqueue_job(key)
+        self.enqueue_job_event(key)
 
     def update_service(self, old: Dict[str, Any], new: Dict[str, Any]) -> None:
         self.update_pod(old, new)
@@ -304,7 +366,7 @@ class JobController:
         rtype = self._replica_type_of(obj)
         if rtype:
             self.expectations.observe_del(expectation_key(key, rtype, "services"))
-        self.enqueue_job(key)
+        self.enqueue_job_event(key)
 
     # ------------------------------------------------------------------
     # claim / adopt / orphan (jobcontroller/pod.go:165-196)

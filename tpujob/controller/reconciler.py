@@ -14,9 +14,10 @@ cluster-spec injection in place of the NCCL wiring:
 from __future__ import annotations
 
 import calendar
+import json
 import logging
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from tpujob.api import constants as c
 from tpujob.api.defaults import set_defaults_tpujob
@@ -227,7 +228,10 @@ class TPUJobController(JobController):
             (new.get("metadata") or {}).get("resourceVersion")
         ):
             return  # periodic resync replay, nothing changed
-        self.enqueue_job(self.job_key_of(new))
+        # coalesced: most job MODIFIED events are the echo of our own status
+        # writes, and they burst together with the pod events of the same
+        # reconcile round — one settled sync covers them all
+        self.enqueue_job_event(self.job_key_of(new))
 
     def _on_job_delete(self, obj: Dict) -> None:
         metrics.jobs_deleted.inc()
@@ -336,8 +340,7 @@ class TPUJobController(JobController):
             self._cleanup_ttl(job)
             if self.config.enable_gang_scheduling:
                 self._delete_pod_group(job)
-            if job.status != old_status:
-                self.update_status_handler(job)
+            self._persist_status(job, old_status)
             return True
 
         # backoff limit (controller.go:391-453, 520-556)
@@ -385,8 +388,7 @@ class TPUJobController(JobController):
                 return self._fail_job(job, old_status, pods, services,
                                       self._backoff_message(job, reason))
 
-        if job.status != old_status:
-            self.update_status_handler(job)
+        self._persist_status(job, old_status)
         return True
 
     # ------------------------------------------------------------------
@@ -837,8 +839,7 @@ class TPUJobController(JobController):
         metrics.jobs_failed.inc()
         if self.config.enable_gang_scheduling:
             self._delete_pod_group(job)
-        if job.status != old_status:
-            self.update_status_handler(job)
+        self._persist_status(job, old_status)
         return True
 
     # ------------------------------------------------------------------
@@ -936,15 +937,200 @@ class TPUJobController(JobController):
     # write-back handlers (injectable for tests)
     # ------------------------------------------------------------------
 
+    def _persist_status(self, job: TPUJob, old_status) -> None:
+        """Persist the sync's recomputed status iff it changed.
+
+        ``old_status`` is the informer-cached status snapshotted at sync
+        start: when the recomputed object equals it field for field, the
+        sync was a pure no-op and nothing is written (counted as
+        suppressed).  Anything else goes through the injectable
+        ``update_status_handler``, where the semantic diff decides between
+        a merge-patch write and suppression of volatile-only refreshes."""
+        if job.status == old_status:
+            if self.config.suppress_noop_status:
+                metrics.status_writes.labels(result="suppressed").inc()
+            return
+        self.update_status_handler(job)
+
     def _update_job_status(self, job: TPUJob) -> None:
         with TRACER.span("phase", phase="status_update"):
             self._write_job_status(job)
 
     def _write_job_status(self, job: TPUJob) -> None:
-        job.status.last_reconcile_time = st.now_iso()
         deltas = self._restart_deltas.pop(job.key, None)
+        if self.config.status_patch and hasattr(
+            self.clients.tpujobs.server, "patch_status"
+        ):
+            self._patch_job_status(job, deltas)
+        else:
+            self._put_job_status(job, deltas)
+
+    # -- merge-patch write path (the default) ---------------------------
+
+    def _patch_job_status(self, job: TPUJob, deltas: Optional[Dict[str, int]]) -> None:
+        """Ship the semantic diff between the recomputed status and the
+        informer-cached one as a JSON-merge-patch of /status.
+
+        Three write classes fall out of the diff:
+
+        - **empty diff** — the sync re-derived exactly what the cache (and
+          therefore, to our best knowledge, the server) already holds: skip
+          the write entirely (``status_writes_total{result="suppressed"}``).
+          Terminal transitions (Succeeded/Failed first landing) always
+          write through, and a cache that drifted from the recomputed truth
+          (a resync repairing a foreign/corrupt status write) diffs nonzero
+          by construction — suppression can never swallow either.
+        - **derived-fields-only diff** — conditions, phase counters,
+          timestamps: patched WITHOUT a resourceVersion precondition.
+          Last-writer-wins per key is safe (every such field is recomputed
+          from live pods each sync), and the patch no longer 409s against
+          concurrent spec/metadata writers the way the full-object PUT did —
+          that conflict/refetch/retry loop was pure overhead.
+        - **cumulative-counter diff** (``restarts``) — history, not derived
+          state: patched WITH the cached resourceVersion.  On conflict the
+          executed deletions are rebased onto the freshly read object via a
+          restarts-only RV-checked patch (client-go RetryOnConflict
+          discipline), never a blind full-object write that could resurrect
+          this sync's stale view of everything else.
+        """
+        ns = job.metadata.namespace or "default"
+        name = job.metadata.name
+        cached = self.job_informer.store.get(ns, name)
+        if not self._same_incarnation(cached, job):
+            # the cache now holds a DIFFERENT incarnation of ns/name (the
+            # job was deleted and recreated mid-sync): this sync's status —
+            # terminal conditions, restart counts — belongs to the dead
+            # object and must not be born onto the new one.  The full-object
+            # PUT got this protection for free (it carried the dead
+            # incarnation's resourceVersion and 409/404'd); the patch path
+            # must check identity itself.  The deltas die with the old
+            # incarnation, exactly like the NotFound path.
+            logger_for_job(log, job).info(
+                "job was recreated mid-sync; dropping the stale status write")
+            return
+        old = (cached or {}).get("status")
+        old = old if isinstance(old, dict) else {}
+        patch = st.status_merge_patch(old, job.status.to_dict())
+        if patch is None:
+            # a semantically empty diff can never hide a condition
+            # transition (terminal ones included): is_finished depends only
+            # on condition type/status, which the volatile strip preserves —
+            # equality here implies the cache already shows the same
+            # terminal/non-terminal state
+            if self.config.suppress_noop_status:
+                # the cached status already reflects everything this sync
+                # computed — including any carried restart deltas, which are
+                # therefore persisted; dropping them here is what retires a
+                # delta whose lost-response write actually landed
+                metrics.status_writes.labels(result="suppressed").inc()
+                return
+            # suppression disabled: write the volatile-only drift too, so
+            # the cache converges the way it did under a full PUT — a
+            # stamp-only patch would leave the refreshed condition
+            # timestamps un-persisted and the object-equality gate upstream
+            # dirty on every subsequent sync
+            patch = st.raw_status_merge_patch(old, job.status.to_dict())
+        job.status.last_reconcile_time = st.now_iso()
+        patch["lastReconcileTime"] = job.status.last_reconcile_time
+        rv = None
+        if st.patch_touches_restarts(patch):
+            rv = ((cached or {}).get("metadata") or {}).get("resourceVersion")
+        try:
+            self.clients.tpujobs.patch_status(ns, name, patch, resource_version=rv)
+        except NotFoundError:
+            return
+        except ConflictError:
+            logger_for_job(log, job).info(
+                "status patch conflicted (stale cache); requeueing")
+        except Exception:
+            # transient transport failure: the recreations of this sync are
+            # already executed — re-stash their deltas so the next sync
+            # folds them in instead of silently undercounting
+            self._restash_deltas(job, deltas)
+            raise
+        else:
+            self._count_patch_write(patch, job.status.to_dict())
+            return
+        if deltas:
+            self._rebase_restart_deltas(job, deltas)
+        # rate-limited, not immediate: the cache stays stale for the whole
+        # watch-latency window after the conflicting write, so an immediate
+        # requeue would spin patch-409 against the apiserver (client-go
+        # RetryOnConflict backs off the same way)
+        self.queue.add_rate_limited(job.key)
+
+    @staticmethod
+    def _same_incarnation(cached: Optional[Dict], job: TPUJob) -> bool:
+        """Whether ``cached`` (the informer's current ns/name entry) is the
+        same object incarnation the sync was computed for.  A store miss
+        passes — the server's 404 resolves it; missing uids (hand-built test
+        objects) pass open."""
+        if cached is None:
+            return True
+        cached_uid = (cached.get("metadata") or {}).get("uid")
+        return (not cached_uid or not job.metadata.uid
+                or cached_uid == job.metadata.uid)
+
+    @staticmethod
+    def _count_patch_write(patch: Dict[str, Any], full: Dict[str, Any]) -> None:
+        metrics.status_writes.labels(result="written").inc()
+        metrics.status_patch_bytes.inc(
+            len(json.dumps(patch, separators=(",", ":"))))
+        metrics.status_full_bytes.inc(
+            len(json.dumps(full, separators=(",", ":"))))
+
+    def _rebase_restart_deltas(self, job: TPUJob, deltas: Dict[str, int]) -> None:
+        """A conflicted restarts write: refetch the fresh object, fold the
+        executed deletions onto ITS counters, and ship a restarts-only
+        RV-checked patch.  Every other status field is recomputed from pods
+        on the requeued sync anyway — writing it from this sync's stale base
+        would resurrect exactly the stale fields the 409 protected."""
+        ns = job.metadata.namespace or "default"
+        name = job.metadata.name
+        try:
+            for _ in range(3):
+                try:
+                    fresh = self.clients.tpujobs.get(ns, name)
+                except NotFoundError:
+                    deltas = None  # job gone: nothing left to count
+                    return
+                if (job.metadata.uid and fresh.metadata.uid
+                        and fresh.metadata.uid != job.metadata.uid):
+                    # ns/name was deleted and recreated: the counted
+                    # restarts belong to the dead incarnation — folding them
+                    # onto the newborn would trip its backoffLimit early
+                    deltas = None
+                    return
+                rebase: Dict[str, Any] = {"replicaStatuses": {}}
+                for rtype, d in deltas.items():
+                    rs = fresh.status.replica_statuses.get(rtype)
+                    base = rs.restarts if rs is not None else 0
+                    rebase["replicaStatuses"][rtype] = {"restarts": base + d}
+                try:
+                    self.clients.tpujobs.patch_status(
+                        ns, name, rebase,
+                        resource_version=fresh.metadata.resource_version)
+                    self._count_patch_write(rebase, fresh.status.to_dict())
+                    deltas = None
+                    return
+                except NotFoundError:
+                    deltas = None
+                    return
+                except ConflictError:
+                    continue
+        finally:
+            # rebase exhausted or died mid-flight (transient transport
+            # error): keep the ledger for the next sync
+            self._restash_deltas(job, deltas)
+
+    # -- full-object PUT path (status_patch=False, and transports without
+    #    the patch verb) --------------------------------------------------
+
+    def _put_job_status(self, job: TPUJob, deltas: Optional[Dict[str, int]]) -> None:
+        job.status.last_reconcile_time = st.now_iso()
         try:
             self.clients.tpujobs.update_status(job)
+            metrics.status_writes.labels(result="written").inc()
             return
         except NotFoundError:
             return
@@ -972,11 +1158,16 @@ class TPUJobController(JobController):
                     except NotFoundError:
                         deltas = None  # job gone: nothing left to count
                         return
+                    if (job.metadata.uid and fresh.metadata.uid
+                            and fresh.metadata.uid != job.metadata.uid):
+                        deltas = None  # recreated under the same name
+                        return
                     for rtype, d in deltas.items():
                         rs = fresh.status.replica_statuses.setdefault(rtype, ReplicaStatus())
                         rs.restarts += d
                     try:
                         self.clients.tpujobs.update_status(fresh)
+                        metrics.status_writes.labels(result="written").inc()
                         deltas = None
                         break
                     except NotFoundError:

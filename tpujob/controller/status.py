@@ -1,13 +1,16 @@
-"""Job condition state machine.
+"""Job condition state machine and status diffing.
 
 Mirrors reference ``pkg/controller.v1/pytorch/status.go:226-272`` (condition
 set/filter logic with Running↔Restarting mutual exclusion and terminal-state
-handling) and the replica-status bookkeeping (``status.go:162-182``).
+handling) and the replica-status bookkeeping (``status.go:162-182``), plus
+the semantic status diff the write path uses to suppress no-op writes and to
+ship JSON-merge-patches of only the changed fields.
 """
 from __future__ import annotations
 
+import copy
 import time
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 from tpujob.api import constants as c
 from tpujob.api.types import JobCondition, JobStatus, ReplicaStatus
@@ -124,3 +127,105 @@ def update_replica_statuses(status: JobStatus, rtype: str, pod: Pod) -> None:
         rs.succeeded += 1
     elif phase == "Failed":
         rs.failed += 1
+
+
+# ---------------------------------------------------------------------------
+# semantic status diffing (the API write path's no-op filter + patch builder)
+# ---------------------------------------------------------------------------
+
+# Fields that change on every sync without carrying state: re-setting an
+# identical condition refreshes only its lastUpdateTime, and the controller
+# stamps lastReconcileTime at write time.  Treating these as changes would
+# turn every sync of a running job into a status write — exactly the
+# redundant write QPS this diff exists to eliminate.  lastTransitionTime is
+# NOT volatile: it moves only on real condition transitions.
+_VOLATILE_TOP = ("lastReconcileTime",)
+_VOLATILE_CONDITION = ("lastUpdateTime",)
+
+_MISSING = object()
+
+
+def _strip_volatile(status: Dict[str, Any]) -> Dict[str, Any]:
+    out = copy.deepcopy(status)
+    for k in _VOLATILE_TOP:
+        out.pop(k, None)
+    for cond in out.get("conditions") or []:
+        if isinstance(cond, dict):
+            for k in _VOLATILE_CONDITION:
+                cond.pop(k, None)
+    return out
+
+
+def _merge_diff(old: Dict[str, Any], new: Dict[str, Any]) -> Dict[str, Any]:
+    """RFC 7386 merge patch transforming ``old`` into ``new``.
+
+    Dicts recurse; lists are atomic (a changed list ships whole — merge
+    patch has no per-element semantics); keys present in ``old`` but absent
+    in ``new`` become explicit ``None`` deletions, which matters because the
+    status serialization omits zero-valued fields — without the null, stale
+    server-side keys (``active: 2`` on a completed job) would survive the
+    merge forever."""
+    patch: Dict[str, Any] = {}
+    for k, v in new.items():
+        ov = old.get(k, _MISSING)
+        if ov is _MISSING:
+            patch[k] = v
+        elif isinstance(v, dict) and isinstance(ov, dict):
+            sub = _merge_diff(ov, v)
+            if sub:
+                patch[k] = sub
+        elif v != ov:
+            patch[k] = v
+    for k in old:
+        if k not in new:
+            patch[k] = None
+    return patch
+
+
+def status_merge_patch(
+    old: Optional[Dict[str, Any]], new: Dict[str, Any]
+) -> Optional[Dict[str, Any]]:
+    """The JSON-merge-patch that brings status dict ``old`` to ``new``, or
+    ``None`` when the two are semantically identical (volatile timestamp
+    refreshes do not count as change).
+
+    When a condition changed semantically, the patch carries the ENTIRE raw
+    ``new`` conditions list (volatile fields included): conditions are a
+    list, atomic under merge patch, so a partial rendering would drop
+    history."""
+    n_old = _strip_volatile(old or {})
+    n_new = _strip_volatile(new)
+    patch = _merge_diff(n_old, n_new)
+    if not patch:
+        return None
+    if "conditions" in patch and new.get("conditions") is not None:
+        patch["conditions"] = copy.deepcopy(new["conditions"])
+    return patch
+
+
+def raw_status_merge_patch(
+    old: Optional[Dict[str, Any]], new: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Volatile-INCLUSIVE merge patch: every differing key ships, timestamp
+    refreshes included.  Used when no-op suppression is disabled — the write
+    must land the refreshed volatile fields in the cache, or the
+    object-equality gate upstream would see drift forever and write every
+    sync (a self-sustaining write storm a full PUT never had)."""
+    return _merge_diff(old or {}, new)
+
+
+def patch_touches_restarts(patch: Dict[str, Any]) -> bool:
+    """Whether a status merge patch writes (or deletes) a cumulative
+    ``restarts`` counter.  Such writes must be resourceVersion-checked:
+    ``restarts`` is history, not derived state — a merge patch built from a
+    stale cache would silently regress it, where every other status field is
+    recomputed from live pods each sync and self-heals."""
+    rs = patch.get("replicaStatuses", _MISSING)
+    if rs is _MISSING:
+        return False
+    if not isinstance(rs, dict):
+        return True  # null-delete of the whole map drops counters
+    for entry in rs.values():
+        if not isinstance(entry, dict) or "restarts" in entry:
+            return True
+    return False

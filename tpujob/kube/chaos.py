@@ -38,7 +38,9 @@ FAULT_KILL_WATCH = "kill-watch"
 FAULT_COMPACT = "compact"
 FAULT_DUPLICATE_EVENT = "duplicate-event"
 
-MUTATING_VERBS = ("create", "update", "update_status", "patch", "delete")
+MUTATING_VERBS = (
+    "create", "update", "update_status", "patch", "patch_status", "delete",
+)
 
 
 @dataclass
@@ -284,6 +286,22 @@ class FaultInjectingAPIServer:
     ) -> Dict[str, Any]:
         return self._mutate(
             "patch", lambda: self.inner.patch(resource, namespace, name, patch)
+        )
+
+    def patch_status(
+        self,
+        resource: str,
+        namespace: str,
+        name: str,
+        patch: Dict[str, Any],
+        resource_version: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        return self._mutate(
+            "patch_status",
+            lambda: self.inner.patch_status(
+                resource, namespace, name, patch,
+                resource_version=resource_version,
+            ),
         )
 
     def delete(self, resource: str, namespace: str, name: str) -> None:

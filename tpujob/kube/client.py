@@ -58,13 +58,30 @@ class TypedClient(Generic[T]):
 
 
 class TPUJobInterface(TypedClient[TPUJob]):
-    """Typed TPUJob client with the UpdateStatus subresource."""
+    """Typed TPUJob client with the UpdateStatus/PatchStatus subresource."""
 
     def __init__(self, server: InMemoryAPIServer):
         super().__init__(server, RESOURCE_TPUJOBS, TPUJob)
 
     def update_status(self, job: TPUJob) -> TPUJob:
         return TPUJob.from_dict(self.server.update_status(self.resource, job.to_dict()))
+
+    def patch_status(
+        self,
+        namespace: str,
+        name: str,
+        patch: Dict,
+        resource_version: Optional[str] = None,
+    ) -> TPUJob:
+        """JSON-merge-patch of only the changed status fields (the write-path
+        fast verb); ``resource_version`` optionally makes the write
+        RV-preconditioned (409 on mismatch)."""
+        return TPUJob.from_dict(
+            self.server.patch_status(
+                self.resource, namespace, name, patch,
+                resource_version=resource_version,
+            )
+        )
 
 
 class PodInterface(TypedClient[Pod]):

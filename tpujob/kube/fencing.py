@@ -123,6 +123,9 @@ class KillSwitchTransport:
     def patch(self, *a, **kw):
         return self._call("patch", *a, **kw)
 
+    def patch_status(self, *a, **kw):
+        return self._call("patch_status", *a, **kw)
+
     def delete(self, *a, **kw):
         return self._call("delete", *a, **kw)
 
@@ -183,6 +186,15 @@ class FencedTransport:
               patch: Dict[str, Any]) -> Dict[str, Any]:
         return self._fenced(
             "patch", lambda: self._inner.patch(resource, namespace, name, patch))
+
+    def patch_status(self, resource: str, namespace: str, name: str,
+                     patch: Dict[str, Any],
+                     resource_version: Optional[str] = None) -> Dict[str, Any]:
+        return self._fenced(
+            "patch_status",
+            lambda: self._inner.patch_status(
+                resource, namespace, name, patch,
+                resource_version=resource_version))
 
     def delete(self, resource: str, namespace: str, name: str) -> None:
         return self._fenced(

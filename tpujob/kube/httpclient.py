@@ -222,6 +222,26 @@ class HTTPApiClient:
     def patch(self, resource: str, namespace: str, name: str, patch: Dict) -> Dict[str, Any]:
         return self._request("PATCH", f"/api/{resource}/{namespace or 'default'}/{name}", patch)
 
+    def patch_status(
+        self,
+        resource: str,
+        namespace: str,
+        name: str,
+        patch: Dict,
+        resource_version: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """JSON-merge-patch of the status subresource; ``resource_version``
+        (optional) rides as a query param and becomes a server-side
+        precondition (409 on mismatch)."""
+        q = ""
+        if resource_version is not None:
+            q = "?resourceVersion=" + urllib.parse.quote(str(resource_version))
+        return self._request(
+            "PATCH",
+            f"/api/{resource}/{namespace or 'default'}/{name}/status{q}",
+            patch,
+        )
+
     def delete(self, resource: str, namespace: str, name: str) -> None:
         self._request("DELETE", f"/api/{resource}/{namespace or 'default'}/{name}")
 

@@ -12,6 +12,8 @@ Routes:
     PUT    /api/{resource}                    update (body: object)
     PUT    /api/{resource}/status             update_status (body: object)
     PATCH  /api/{resource}/{ns}/{name}        strategic-merge patch
+    PATCH  /api/{resource}/{ns}/{name}/status[?resourceVersion=N]
+                                              JSON-merge-patch of .status only
     DELETE /api/{resource}/{ns}/{name}        delete
     GET    /watch/{resource}[?initial=1]      ndjson watch stream
     GET    /healthz                           liveness
@@ -115,9 +117,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(e)
 
     def do_PATCH(self):
-        _, parts, _ = self._route()
+        _, parts, query = self._route()
         try:
-            if len(parts) == 4 and parts[0] == "api":
+            if len(parts) == 5 and parts[0] == "api" and parts[4] == "status":
+                rv = (query.get("resourceVersion") or [None])[0]
+                self._json(200, self.backend.patch_status(
+                    parts[1], parts[2], parts[3], self._body(),
+                    resource_version=rv))
+            elif len(parts) == 4 and parts[0] == "api":
                 self._json(200, self.backend.patch(parts[1], parts[2], parts[3], self._body()))
             else:
                 self._json(404, {"message": f"no route {self.path}"})

@@ -286,8 +286,13 @@ class SharedInformer:
                     # stale.  Skip the event; resync/relist heals the drift.
                     log.exception("informer %s: event handler failed", self.resource)
 
-        self._thread = threading.Thread(target=loop, daemon=True, name=f"informer-{self.resource}")
-        self._thread.start()
+        # published only AFTER start: a concurrent stop() (hard_kill racing a
+        # cold start) must see either None or a started thread — joining a
+        # created-but-unstarted Thread raises RuntimeError (same discipline
+        # as LeaderElector.leading_thread)
+        thread = threading.Thread(target=loop, daemon=True, name=f"informer-{self.resource}")
+        thread.start()
+        self._thread = thread
 
     def stop(self) -> None:
         if self._watch is not None:

@@ -553,6 +553,33 @@ class KubeApiTransport:
             "PATCH", self._item(resource, namespace, name), patch, content_type=ct
         )
 
+    def patch_status(
+        self,
+        resource: str,
+        namespace: str,
+        name: str,
+        patch: Dict,
+        resource_version: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """RFC 7386 merge patch of the ``/status`` subresource: the body is
+        ``{"status": <patch>}``, shipping only the changed fields — the
+        write-path fast verb.  Unlike :meth:`update_status`'s PUT, a
+        merge patch without a precondition cannot 409 against concurrent
+        spec/metadata writers (their writes bump the object RV, which a
+        patch never asserts).  ``resource_version``, when given, is embedded
+        as ``metadata.resourceVersion`` — the apiserver then enforces it as
+        an optimistic-concurrency precondition (409 on mismatch), which the
+        caller uses for cumulative counters that must not regress."""
+        body: Dict[str, Any] = {"status": patch}
+        if resource_version is not None:
+            body["metadata"] = {"resourceVersion": str(resource_version)}
+        return self._request(
+            "PATCH",
+            self._item(resource, namespace, name, sub="status"),
+            body,
+            content_type="application/merge-patch+json",
+        )
+
     def delete(self, resource: str, namespace: str, name: str) -> None:
         self._request("DELETE", self._item(resource, namespace, name))
 

@@ -217,14 +217,26 @@ class InMemoryAPIServer:
         return (meta.get("namespace") or "default", name)
 
     def _broadcast(self, ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
-        ev = WatchEvent(ev_type, resource, copy.deepcopy(obj))
+        """Fan one committed object out to history, every subscriber and every
+        hook as ONE shared snapshot.
+
+        ``obj`` must be the committed object dict, which is immutable after
+        commit (every mutating verb installs a freshly built dict instead of
+        editing in place) — so a single reference can ride every watch queue
+        and the history buffer without per-subscriber deep copies.  At
+        operator scale the per-subscriber copy dominated fan-out cost: a
+        3-informer controller paid 3 full-object copies per event, plus one
+        per hook.  Consumers must treat event objects as read-only; the read
+        API boundary (get/list and the mutating verbs' return values) still
+        deep-copies."""
+        ev = WatchEvent(ev_type, resource, obj)
         obj_ns = (obj.get("metadata") or {}).get("namespace") or "default"
         self._history.append((self._rv, resource, obj_ns, ev))
         for res, ns, w in list(self._watches):
             if (res is None or res == resource) and (ns is None or ns == obj_ns):
                 w._put(ev)
         for hook in list(self.hooks):
-            hook(ev_type, resource, copy.deepcopy(obj))
+            hook(ev_type, resource, ev.object)
 
     def _remove_watch(self, watch: Watch) -> None:
         with self._lock:
@@ -264,7 +276,8 @@ class InMemoryAPIServer:
             for _, res, ns, ev in list(self._history)[-count:]:
                 for wres, wns, w in list(self._watches):
                     if (wres is None or wres == res) and (wns is None or wns == ns):
-                        w._put(WatchEvent(ev.type, ev.resource, copy.deepcopy(ev.object)))
+                        # share the history event's immutable snapshot
+                        w._put(WatchEvent(ev.type, ev.resource, ev.object))
                 replayed += 1
             return replayed
 
@@ -360,6 +373,48 @@ class InMemoryAPIServer:
             self._broadcast(MODIFIED, resource, merged)
             return copy.deepcopy(merged)
 
+    def patch_status(
+        self,
+        resource: str,
+        namespace: str,
+        name: str,
+        patch: Dict[str, Any],
+        resource_version: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """JSON-merge-patch (RFC 7386) applied to the ``.status`` subresource
+        only: dicts merge recursively, ``None`` deletes a key, lists replace
+        wholesale.
+
+        ``resource_version`` is an OPTIONAL precondition: when given, a
+        mismatch with the current object raises Conflict (the semantics a
+        real apiserver gives a merge patch whose body carries
+        ``metadata.resourceVersion``).  Without it the patch is
+        last-writer-wins per key — the point of the verb: a status write
+        that touches only derived fields no longer 409s against concurrent
+        spec/metadata writers the way a full-object PUT does."""
+        with self._lock:
+            self._fence_check("patch_status", resource)
+            key = (namespace or "default", name)
+            current = self._store(resource).objects.get(key)
+            if current is None:
+                raise NotFoundError(f"{resource} {namespace}/{name} not found")
+            cur_rv = (current.get("metadata") or {}).get("resourceVersion")
+            if resource_version is not None and str(resource_version) != str(cur_rv):
+                raise ConflictError(
+                    f"{resource} {key[0]}/{key[1]}: resourceVersion "
+                    f"{resource_version} != {cur_rv}"
+                )
+            merged = copy.deepcopy(current)
+            status = merged.get("status")
+            if not isinstance(status, dict):
+                status = {}
+                merged["status"] = status
+            _merge(status, patch)
+            merged["metadata"]["resourceVersion"] = self._next_rv()
+            self._store(resource).objects[key] = merged
+            self._broadcast(MODIFIED, resource, merged)
+            return copy.deepcopy(merged)
+
     def patch(self, resource: str, namespace: str, name: str, patch: Dict[str, Any]) -> Dict[str, Any]:
         """Strategic-merge-ish patch (recursive dict merge; lists replaced)."""
         with self._lock:
@@ -379,11 +434,15 @@ class InMemoryAPIServer:
         with self._lock:
             self._fence_check("delete", resource)
             key = (namespace or "default", name)
-            obj = self._store(resource).objects.pop(key, None)
-            if obj is None:
+            popped = self._store(resource).objects.pop(key, None)
+            if popped is None:
                 raise NotFoundError(f"{resource} {namespace}/{name} not found")
             # deletes bump the collection RV like a real apiserver, so the
-            # DELETED event has its own resume point in the watch history
+            # DELETED event has its own resume point in the watch history.
+            # The RV lands on a fresh copy: the popped dict is the object the
+            # last commit broadcast, and event snapshots are immutable —
+            # mutating it would corrupt the shared history/queue entries.
+            obj = copy.deepcopy(popped)
             obj["metadata"]["resourceVersion"] = self._next_rv()
             self._broadcast(DELETED, resource, obj)
             if self._enable_gc:
@@ -394,10 +453,11 @@ class InMemoryAPIServer:
         if not owner_uid:
             return
         for resource, store in list(self._stores.items()):
-            for key, obj in list(store.objects.items()):
-                refs = ((obj.get("metadata") or {}).get("ownerReferences")) or []
+            for key, popped in list(store.objects.items()):
+                refs = ((popped.get("metadata") or {}).get("ownerReferences")) or []
                 if any(r.get("uid") == owner_uid and r.get("controller") for r in refs):
                     store.objects.pop(key, None)
+                    obj = copy.deepcopy(popped)  # see delete(): events are immutable
                     obj["metadata"]["resourceVersion"] = self._next_rv()
                     self._broadcast(DELETED, resource, obj)
                     self._gc_dependents((obj.get("metadata") or {}).get("uid"))
@@ -464,13 +524,15 @@ class InMemoryAPIServer:
                     if (resource is None or res == resource) and (
                         namespace is None or ns == namespace
                     ):
-                        w._put(WatchEvent(ev.type, ev.resource, copy.deepcopy(ev.object)))
+                        # replayed events share the stored immutable snapshot
+                        w._put(WatchEvent(ev.type, ev.resource, ev.object))
             elif send_initial:
                 resources = [resource] if resource else list(self._stores)
                 for res in resources:
                     for (ns, _), obj in self._store(res).objects.items():
                         if namespace is None or ns == namespace:
-                            w._put(WatchEvent(ADDED, res, copy.deepcopy(obj)))
+                            # committed objects are immutable: share, don't copy
+                            w._put(WatchEvent(ADDED, res, obj))
             if not w.closed:
                 # a replay bigger than the queue overflowed the stream
                 # before it ever went live: hand the (terminated) watch back

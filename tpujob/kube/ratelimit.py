@@ -50,7 +50,8 @@ class RateLimitedTransport:
     """
 
     _LIMITED = frozenset(
-        {"create", "get", "list", "update", "update_status", "patch", "delete"}
+        {"create", "get", "list", "update", "update_status", "patch",
+         "patch_status", "delete"}
     )
 
     def __init__(self, transport, qps: float, burst: int):

@@ -361,6 +361,12 @@ class TracingTransport:
         return self._traced_call("patch", resource, self._inner.patch,
                                  namespace, name, patch)
 
+    def patch_status(self, resource, namespace, name, patch,
+                     resource_version=None):
+        return self._traced_call("patch_status", resource,
+                                 self._inner.patch_status, namespace, name,
+                                 patch, resource_version=resource_version)
+
     def delete(self, resource, namespace, name):
         return self._traced_call("delete", resource, self._inner.delete,
                                  namespace, name)

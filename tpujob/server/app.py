@@ -120,6 +120,9 @@ class OperatorApp:
                 enable_tracing=opt.enable_tracing,
                 slow_sync_threshold_s=opt.slow_sync_threshold_s,
                 flight_recorder_size=opt.flight_recorder_size,
+                suppress_noop_status=opt.suppress_noop_status,
+                status_patch=opt.status_patch,
+                settle_window_s=opt.settle_window_s,
             ),
         )
         self.monitoring: Optional[MonitoringServer] = None

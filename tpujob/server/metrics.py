@@ -357,3 +357,35 @@ fenced_writes_rejected = Counter(
     "or a stale fencing token caught server-side)",
     REGISTRY,
 )
+
+# API write-path series (the write-path overhaul): status persistence
+# proportional to CHANGE, not to sync count.  A sync whose recomputed status
+# is semantically identical to the informer-cached one skips the write
+# (result="suppressed"); real writes ship a JSON-merge-patch of only the
+# changed fields, and burst events per job coalesce into one sync.
+status_writes = LabeledCounter(
+    "tpujob_operator_status_writes_total",
+    "Job status write decisions per sync: result=written (a status write "
+    "was issued) or result=suppressed (the recomputed status matched the "
+    "informer cache semantically and the write was skipped)",
+    REGISTRY,
+    ("result",),
+)
+syncs_coalesced = Counter(
+    "tpujob_operator_syncs_coalesced_total",
+    "Object events absorbed into an already-scheduled sync by the "
+    "per-job-key settle window (each would have been its own sync without "
+    "coalescing)",
+    REGISTRY,
+)
+status_patch_bytes = Counter(
+    "tpujob_operator_status_patch_bytes_total",
+    "Serialized bytes of status merge patches actually shipped",
+    REGISTRY,
+)
+status_full_bytes = Counter(
+    "tpujob_operator_status_full_bytes_total",
+    "Serialized bytes the same status writes would have shipped as "
+    "full-object PUTs (the patch-vs-put payload baseline)",
+    REGISTRY,
+)

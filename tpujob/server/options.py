@@ -54,6 +54,11 @@ class ServerOption:
     # rate-limited per job (<= 0 disables the dump)
     slow_sync_threshold_s: float = 5.0
     flight_recorder_size: int = 256  # timeline entries retained per job
+    # API write path: no-op status suppression, merge-patch status writes,
+    # and per-job event coalescing (see docs/monitoring "write QPS at scale")
+    suppress_noop_status: bool = True
+    status_patch: bool = True
+    settle_window_s: float = 0.02
 
 
 class _LazyVersionAction(argparse.Action):
@@ -130,6 +135,28 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--flight-recorder-size", type=int, default=256,
                         dest="flight_recorder_size",
                         help="timeline entries retained per job for /debug/jobs")
+    parser.add_argument("--suppress-noop-status", dest="suppress_noop_status",
+                        action="store_true", default=True,
+                        help="skip status writes when the recomputed status "
+                             "matches the informer cache semantically "
+                             "(default on)")
+    parser.add_argument("--no-suppress-noop-status", dest="suppress_noop_status",
+                        action="store_false",
+                        help="write status on every changed sync, even when "
+                             "only volatile timestamps moved")
+    parser.add_argument("--status-patch", dest="status_patch",
+                        action="store_true", default=True,
+                        help="ship status writes as a JSON-merge-patch of "
+                             "only the changed fields (default on)")
+    parser.add_argument("--no-status-patch", dest="status_patch",
+                        action="store_false",
+                        help="restore full-object status PUTs")
+    parser.add_argument("--settle-window", type=float, default=0.02,
+                        dest="settle_window_s",
+                        help="per-job event-coalescing window in seconds: "
+                             "burst watch events on one job collapse into a "
+                             "single sync scheduled this far out (<=0 "
+                             "disables coalescing)")
 
 
 def parse_options(argv: Optional[List[str]] = None) -> ServerOption:
