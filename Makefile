@@ -11,7 +11,7 @@ SHELL := /bin/bash
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci ci-fast native lint codegen-verify unit unit-fast test trace-smoke failover-smoke write-path-smoke e2e soak bench-smoke bench-controller dryrun images clean
+.PHONY: ci ci-fast native lint codegen-verify unit unit-fast test trace-smoke failover-smoke write-path-smoke read-path-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
@@ -52,9 +52,16 @@ failover-smoke:
 write-path-smoke:
 	$(PY) scripts/write_path_smoke.py
 
+# read-path smoke (~10 s): under churn past forced compactions, paged
+# LISTs + watch bookmarks must relist >= 5x fewer objects than the
+# unpaged/bookmark-less control, with both informer caches converging to
+# the server's exact object/RV map
+read-path-smoke:
+	$(PY) scripts/read_path_smoke.py
+
 # the tier-1 command from ROADMAP.md, verbatim (modulo $$-escaping for
 # make), so local and CI invocations agree on what "the tests pass" means
-test: trace-smoke failover-smoke write-path-smoke
+test: trace-smoke failover-smoke write-path-smoke read-path-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # the operator/controller/kube/api tests only — the model-path suites
@@ -101,6 +108,14 @@ bench-controller:
 	$(PY) bench_controller.py --jobs 50 --workers 8 --mode scan --serial
 	$(PY) bench_controller.py --jobs 10 --workers 8 --churn 4
 	$(PY) bench_controller.py --jobs 10 --workers 8 --churn 4 --no-suppress --no-coalesce
+
+# read path at scale: 100k-object cold-start/relist curve — the paged +
+# bookmark run vs the unpaged/bookmark-less control, asserting the >= 5x
+# relisted-object reduction and store convergence (slow; not part of `ci`)
+bench-controller-objects:
+	$(PY) bench_controller.py --objects 100000 --timeout 500
+	$(PY) bench_controller.py --objects 100000 --timeout 500 --no-paging --no-bookmarks
+	$(PY) scripts/read_path_smoke.py --objects 100000 --timeout 500
 
 images:
 	scripts/build_image.sh

@@ -34,6 +34,17 @@ ratio exceeds 0.5; ``--no-suppress --no-coalesce`` (and optionally
 With tracing on, the run also asserts trace completeness: every completed
 sync yielded exactly one CLOSED root span carrying a queue-latency child,
 and every pod-creating sync carries API-call child spans.
+
+Read-path mode (``--objects N``, N > 0): a six-figure-object cold-start /
+relist benchmark instead of the reconcile-throughput run.  Pre-loads N
+noise pods, cold-starts the controller (paged informer LISTs + watch
+bookmarks by default) measuring wall time, LIST pages and the tracemalloc
+peak, then churns a quiet-resource storm past forced partial compactions
+and watch kills, measuring how many objects the informers had to relist
+and diff to heal.  ``--no-paging``/``--no-bookmarks`` reproduce the
+pre-overhaul read path as the control: every reconnect then degrades to a
+410-forced relist of the whole world.  Both modes assert the informer cache
+converged to the server's exact object/resourceVersion map.
 """
 from __future__ import annotations
 
@@ -52,6 +63,7 @@ from tpujob.controller.job_base import ControllerConfig
 from tpujob.controller.reconciler import TPUJobController
 from tpujob.kube.client import RESOURCE_PODS, RESOURCE_SERVICES, RESOURCE_TPUJOBS, ClientSet
 from tpujob.kube.control import gen_labels
+from tpujob.kube.informers import Store
 from tpujob.kube.memserver import ADDED, InMemoryAPIServer
 from tpujob.kube.objects import Pod, Service
 from tpujob.obs.trace import TRACER
@@ -108,6 +120,10 @@ class CountingTransport:
     def list(self, *a, **kw):
         self._count("list")
         return self._inner.list(*a, **kw)
+
+    def list_page(self, *a, **kw):
+        self._count("list_page")
+        return self._inner.list_page(*a, **kw)
 
     def update(self, *a, **kw):
         self._count("update")
@@ -398,7 +414,7 @@ def run_bench(jobs: int, workers: int, threadiness: int, mode: str,
     ctrl.sync_handler = timed_sync
 
     stop = threading.Event()
-    ctrl.run(stop, threadiness)
+    threads = ctrl.run(stop, threadiness)
     names = [f"bench-{i:04d}" for i in range(jobs)]
     t0 = time.perf_counter()
     for name in names:
@@ -417,6 +433,13 @@ def run_bench(jobs: int, workers: int, threadiness: int, mode: str,
                                   churn_rounds, churn_interval, suppress,
                                   coalesce)
     stop.set()
+    # join the workers BEFORE reading any ledger: a worker blocked in its
+    # last queue.get can still pick up a trailing coalesced enqueue (due
+    # ~settle_window after the final status write) and run one more sync
+    # AFTER the ledger briefly read balanced — the root span then lands in
+    # the NEXT in-process run's trace-completeness window (flaky by timing)
+    for t in threads:
+        t.join(timeout=10)
     ctrl.factory.stop()
     if pending:
         raise TimeoutError(
@@ -471,6 +494,187 @@ def run_bench(jobs: int, workers: int, threadiness: int, mode: str,
     }
 
 
+def _informers_of(ctrl) -> Tuple:
+    return (ctrl.job_informer, ctrl.pod_informer, ctrl.service_informer)
+
+
+def _wait_healed(ctrl, server, deadline_s: float = 60.0) -> float:
+    """Wait until every informer stream is live again and the pod cache
+    holds exactly the server's pod count; returns the heal wall time."""
+    t0 = time.perf_counter()
+    deadline = time.monotonic() + deadline_s
+    want = server.object_count(RESOURCE_PODS)
+    while time.monotonic() < deadline:
+        live = all(
+            inf._watch is not None and not getattr(inf._watch, "closed", False)
+            for inf in _informers_of(ctrl))
+        if live and ctrl.pod_informer.store.count() == want:
+            return time.perf_counter() - t0
+        time.sleep(0.02)
+    raise AssertionError(
+        f"read bench: informers did not heal within {deadline_s}s "
+        f"(pod cache {ctrl.pod_informer.store.count()} vs server {want})")
+
+
+def _store_converged(ctrl, server) -> bool:
+    """The acceptance bar's convergence check: the informer cache must hold
+    the server's exact (namespace, name) -> resourceVersion map."""
+    want = {
+        Store._key(o): (o.get("metadata") or {}).get("resourceVersion")
+        for o in server.list(RESOURCE_PODS)
+    }
+    have = {
+        Store._key(o): (o.get("metadata") or {}).get("resourceVersion")
+        for o in ctrl.pod_informer.store.list()
+    }
+    return want == have
+
+
+def run_read_bench(objects: int, paging: bool = True, bookmarks: bool = True,
+                   page_size: int = 500, history: int = 2048,
+                   bookmark_every: int = 100, jobs: int = 5, workers: int = 2,
+                   churn_rounds: int = 5, churn_batch: int = 300,
+                   compact_keep: int = 150, timeout: float = 300.0) -> Dict:
+    """Cold-start + relist benchmark at ``objects`` noise pods.
+
+    Phase 1 (cold start): the controller's informers LIST the world —
+    paged (``page_size`` per chunk) or in one unpaged call — while
+    tracemalloc records the transient allocation peak.  Phase 2 (churn):
+    ``churn_rounds`` batches of writes on a resource NO informer watches
+    advance the global RV; after each batch the history is partially
+    compacted (the newest ``compact_keep`` events survive, like etcd
+    compacting old revisions) and every watch stream is killed.  With
+    bookmarks on, each informer's resume point rode the bookmark cadence
+    past the compaction horizon, so reconnects resume with zero data
+    traffic; without them every reconnect 410s into a relist of the world.
+    """
+    import tracemalloc
+
+    from tpujob.server import metrics
+
+    if bookmark_every >= compact_keep:
+        raise ValueError("bookmark_every must be < compact_keep, or the "
+                         "newest bookmark can predate the compaction horizon")
+    server = InMemoryAPIServer(
+        history_size=history,
+        bookmark_every=bookmark_every if bookmarks else 0,
+    )
+    for i in range(objects):
+        server.create(RESOURCE_PODS, {
+            "metadata": {"name": f"noise-{i:06d}", "namespace": "default",
+                         "labels": {"app": "unrelated"}},
+            "spec": {"containers": [{"name": "app", "image": "noise"}]},
+            "status": {"phase": "Running"},
+        })
+    install_kubelet(server)
+    clients = ClientSet(server)
+    ctrl = TPUJobController(
+        clients,
+        config=ControllerConfig(
+            threadiness=2, resync_period=0, enable_tracing=False,
+            informer_page_size=page_size if paging else 0,
+            watch_bookmarks=bookmarks,
+            cache_sync_timeout_s=max(timeout, 60.0),
+        ),
+    )
+
+    relists0 = metrics.relists.value
+    pages0 = metrics.list_pages_total.value
+    diffed0 = metrics.relist_objects_diffed.value
+    marks0 = metrics.watch_bookmarks.value
+    compactions0 = metrics.history_compactions.value
+    cold_hist = metrics.cold_start_duration.labels(stage="caches_synced")
+    cold_sum0, cold_n0 = cold_hist.sum, cold_hist.value
+
+    stop = threading.Event()
+    tracemalloc.start()
+    t0 = time.perf_counter()
+    threads = ctrl.run(stop, 2)
+    cold_start_s = time.perf_counter() - t0
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    cold_pages = metrics.list_pages_total.value - pages0
+
+    try:
+        names = [f"readbench-{i:03d}" for i in range(jobs)]
+        for name in names:
+            server.create(RESOURCE_TPUJOBS, job_dict(name, workers))
+        pending = set(names)
+        deadline = time.monotonic() + timeout
+        while pending and time.monotonic() < deadline:
+            pending = {
+                n for n in pending
+                if not _is_running(server.get(RESOURCE_TPUJOBS, "default", n))}
+            if pending:
+                time.sleep(0.01)
+        if pending:
+            raise TimeoutError(
+                f"{len(pending)}/{jobs} jobs not Running after {timeout:.0f}s")
+
+        # churn on a resource no informer watches: the global RV advances
+        # (and bookmarks fan out) while every informer stream stays quiet
+        server.create("events", {"metadata": {"name": "read-churn"}})
+        churn_relists0 = metrics.relists.value
+        churn_diffed0 = metrics.relist_objects_diffed.value
+        heal_total = 0.0
+        # churn-phase allocation peak: a 410-forced relist transiently
+        # holds the whole freshly-copied world NEXT TO the old cache, so
+        # the control's peak here scales with the cluster while a
+        # bookmark-resumed stream allocates nothing
+        tracemalloc.start()
+        t_churn = time.perf_counter()
+        for r in range(churn_rounds):
+            for i in range(churn_batch):
+                server.patch("events", "default", "read-churn",
+                             {"tick": r * churn_batch + i})
+            server.compact(keep_last=compact_keep)
+            server.kill_watches()
+            heal_total += _wait_healed(ctrl, server)
+        churn_elapsed = time.perf_counter() - t_churn
+        _, churn_peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        converged = _store_converged(ctrl, server)
+    finally:
+        stop.set()
+        for t in threads:  # see run_bench: ledgers only after workers exit
+            t.join(timeout=10)
+        ctrl.factory.stop()
+    if not converged:
+        raise AssertionError(
+            "read bench: informer cache diverged from the server store")
+
+    cold_metric_s = cold_hist.sum - cold_sum0 if cold_hist.value > cold_n0 else 0.0
+    return {
+        "metric": "read_path",
+        "objects": objects,
+        "paging": paging,
+        "bookmarks": bookmarks,
+        "page_size": page_size if paging else 0,
+        "history_size": history,
+        "jobs": jobs,
+        "cold_start_s": round(cold_start_s, 4),
+        "cold_start_caches_synced_s": round(cold_metric_s, 4),
+        "cold_start_pages": int(cold_pages),
+        "cold_start_peak_mb": round(peak / 1e6, 2),
+        "churn_rounds": churn_rounds,
+        "churn_events": churn_rounds * churn_batch,
+        "churn_elapsed_s": round(churn_elapsed, 4),
+        "churn_heal_s": round(heal_total, 4),
+        "churn_peak_mb": round(churn_peak / 1e6, 2),
+        "churn_relists": int(metrics.relists.value - churn_relists0),
+        "churn_relist_objects_diffed": int(
+            metrics.relist_objects_diffed.value - churn_diffed0),
+        "relists": int(metrics.relists.value - relists0),
+        "relist_objects_diffed": int(
+            metrics.relist_objects_diffed.value - diffed0),
+        "list_pages": int(metrics.list_pages_total.value - pages0),
+        "watch_bookmarks": int(metrics.watch_bookmarks.value - marks0),
+        "history_compactions": int(
+            metrics.history_compactions.value - compactions0),
+        "converged": converged,
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--jobs", type=int, default=50, help="J: number of TPUJobs")
@@ -505,11 +709,40 @@ def build_parser() -> argparse.ArgumentParser:
                    default=True,
                    help="full-object status PUTs instead of merge patches "
                         "(control)")
+    p.add_argument("--objects", type=int, default=0,
+                   help="read-path mode: pre-load this many noise pods and "
+                        "run the cold-start/relist benchmark instead of the "
+                        "reconcile-throughput run (0 disables)")
+    p.add_argument("--page-size", type=int, default=500,
+                   help="read-path mode: informer LIST chunk size")
+    p.add_argument("--no-paging", dest="paging", action="store_false",
+                   default=True,
+                   help="read-path control: one unpaged LIST per relist")
+    p.add_argument("--no-bookmarks", dest="bookmarks", action="store_false",
+                   default=True,
+                   help="read-path control: no watch BOOKMARK events — "
+                        "reconnects after compaction degrade to relists")
+    p.add_argument("--history", type=int, default=2048,
+                   help="read-path mode: bounded watch-history length "
+                        "(smaller = more natural compaction pressure)")
+    p.add_argument("--read-churn", type=int, default=5, dest="read_churn",
+                   help="read-path mode: churn/compaction/kill rounds")
     return p
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.objects > 0:
+        try:
+            result = run_read_bench(
+                args.objects, paging=args.paging, bookmarks=args.bookmarks,
+                page_size=args.page_size, history=args.history,
+                churn_rounds=args.read_churn, timeout=args.timeout)
+        except (TimeoutError, AssertionError, ValueError) as e:
+            print(f"FAIL: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(result))
+        return 0
     try:
         result = run_bench(args.jobs, args.workers, args.threadiness, args.mode,
                            args.serial, args.create_latency, args.timeout,

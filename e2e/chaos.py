@@ -506,7 +506,9 @@ def _soak_harness(
     clients, terminal-flip tracker, and the flattened kubelet scripts."""
     prefix = f"{prefix_letter}{seed}"
     cases = cases if cases is not None else matrix(prefix)
-    inner = InMemoryAPIServer()
+    # bookmark cadence on: quiet informer streams keep their resume points
+    # near the head, so compaction faults force resumes, not world-relists
+    inner = InMemoryAPIServer(bookmark_every=25)
     if fence:
         inner.enable_fence_validation("default", "tpujob-operator")
     chaos = FaultInjectingAPIServer(inner, seed=seed, config=config or SOAK_CHAOS)
@@ -562,16 +564,26 @@ SOAK_CHAOS = ChaosConfig(
     kill_watch_every=20,
     compact_every=45,
     duplicate_event_rate=0.05,
+    # read-path faults: pages dropped mid-LIST, continue tokens expiring
+    # under the walk, and watch deaths right after a bookmark advanced the
+    # resume point — partial-LIST recovery, not just whole-call faults
+    page_error_rate=0.05,
+    continue_expire_rate=0.05,
+    bookmark_kill_every=35,
 )
 
 # controller knobs for the soak: healing must be observable within seconds,
-# not the production 12h resync / 20min workqueue ceiling
+# not the production 12h resync / 20min workqueue ceiling.  The informer
+# page size is tiny so every relist is a REAL multi-page walk at soak
+# object counts — otherwise the mid-pagination faults above would never
+# land on a continuation
 SOAK_OPT_OVERRIDES = dict(
     threadiness=2,
     resync_period_s=1.0,
     workqueue_max_backoff_s=0.25,
     restart_backoff_s=0.05,
     restart_backoff_max_s=0.4,
+    informer_page_size=2,
 )
 
 

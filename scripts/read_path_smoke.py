@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Read-path smoke: under churn past forced compactions, the paged+bookmark
+informers must relist >= 5x fewer objects than the pre-overhaul control,
+end to end, with both modes converging to identical stores.
+
+Runs ``bench_controller.run_read_bench`` twice in-process on the same
+workload shape (N noise pods + a quiet-resource churn storm with partial
+history compaction and watch kills after every round):
+
+1. **control** — ``--no-paging --no-bookmarks``: every reconnect's resume
+   point predates the compaction horizon, so each watch death degrades to
+   a 410-forced unpaged relist of the world (the pre-overhaul read path).
+2. **optimized** — continue-token paged LISTs + watch BOOKMARK events on
+   (the defaults): bookmarks keep even quiet streams' resume points ahead
+   of compaction, so reconnects resume with zero data traffic.
+
+Asserts, per the read-path acceptance bar:
+
+- control relisted+diffed objects during the storm >= 5x the optimized
+  run's (the relist event volume reduction);
+- the optimized run performed fewer relists and its churn-phase allocation
+  peak stayed flat (a relist transiently holds the freshly copied world
+  next to the old cache; a resumed stream allocates nothing);
+- the optimized cold start actually paged (several LIST chunks);
+- both runs converged to the server's exact object/resourceVersion map
+  (checked inside run_read_bench, which raises otherwise).
+
+Wired as a ``make test`` prerequisite (``make read-path-smoke``);
+budget ~10 s at the default shape.  ``--objects 100000`` is the
+full-scale comparison (``make bench-controller-objects``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_controller import run_read_bench
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--objects", type=int, default=4000,
+                        help="noise pods pre-loaded into the cluster")
+    parser.add_argument("--timeout", type=float, default=240.0)
+    args = parser.parse_args(argv)
+
+    shape = dict(objects=args.objects, timeout=args.timeout)
+    control = run_read_bench(paging=False, bookmarks=False, **shape)
+    optimized = run_read_bench(paging=True, bookmarks=True, **shape)
+    print(json.dumps(control))
+    print(json.dumps(optimized))
+
+    c_diffed = control["churn_relist_objects_diffed"]
+    o_diffed = optimized["churn_relist_objects_diffed"]
+    if c_diffed < 5 * max(1, o_diffed):
+        raise AssertionError(
+            f"read-path smoke: control relisted+diffed {c_diffed} object(s) "
+            f"during the storm vs optimized {o_diffed} — less than the "
+            "required 5x reduction")
+    if optimized["churn_relists"] >= max(1, control["churn_relists"]):
+        raise AssertionError(
+            f"read-path smoke: relist count did not drop "
+            f"({optimized['churn_relists']} vs control "
+            f"{control['churn_relists']})")
+    if optimized["churn_peak_mb"] >= control["churn_peak_mb"]:
+        raise AssertionError(
+            f"read-path smoke: churn allocation peak did not drop "
+            f"({optimized['churn_peak_mb']}MB vs control "
+            f"{control['churn_peak_mb']}MB) — relists should dominate the "
+            "control's transient memory")
+    if optimized["cold_start_pages"] <= 3:
+        raise AssertionError(
+            f"read-path smoke: cold start fetched only "
+            f"{optimized['cold_start_pages']} page(s) — paging did not "
+            "engage")
+    if optimized["watch_bookmarks"] <= 0:
+        raise AssertionError("read-path smoke: no BOOKMARK was consumed")
+    print(
+        "read-path-smoke: OK "
+        f"(relisted objects {c_diffed} -> {o_diffed}, "
+        f"relists {control['churn_relists']} -> {optimized['churn_relists']}, "
+        f"churn peak {control['churn_peak_mb']}MB -> "
+        f"{optimized['churn_peak_mb']}MB, "
+        f"heal {control['churn_heal_s']}s -> {optimized['churn_heal_s']}s, "
+        f"bookmarks={optimized['watch_bookmarks']}, both stores converged)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -238,16 +238,33 @@ class _Handler(BaseHTTPRequestHandler):
                     self._serve_watch(r, qs)
                 else:
                     sel = _parse_selector(qs)
-                    items = self.backend.list(r.plural, r.namespace, sel)
                     limit = (qs.get("limit") or [None])[0]
-                    if limit is not None:
-                        items = items[: int(limit)]
-                    self._json(200, {
-                        "kind": KIND_OF[r.plural] + "List",
-                        "apiVersion": "v1",
-                        "metadata": {"resourceVersion": str(self.backend._rv)},
-                        "items": items,
-                    })
+                    cont = (qs.get("continue") or [None])[0]
+                    if limit is not None or cont is not None:
+                        # apiserver chunking (KEP-365): each chunk served
+                        # from one storage snapshot; an expired continue
+                        # token is an HTTP 410 Expired Status (unlike the
+                        # watch path, where the 410 rides the stream)
+                        page = self.backend.list_page(
+                            r.plural, r.namespace, sel,
+                            limit=int(limit or 0), continue_token=cont)
+                        meta = {"resourceVersion": page.get("resourceVersion")}
+                        if page.get("continue"):
+                            meta["continue"] = page["continue"]
+                        self._json(200, {
+                            "kind": KIND_OF[r.plural] + "List",
+                            "apiVersion": "v1",
+                            "metadata": meta,
+                            "items": page["items"],
+                        })
+                    else:
+                        items = self.backend.list(r.plural, r.namespace, sel)
+                        self._json(200, {
+                            "kind": KIND_OF[r.plural] + "List",
+                            "apiVersion": "v1",
+                            "metadata": {"resourceVersion": str(self.backend._rv)},
+                            "items": items,
+                        })
             elif r.sub == "log" and r.plural == "pods":
                 self.backend.get("pods", r.namespace, r.name)  # 404 if absent
                 text = self.backend.pod_logs(r.namespace, r.name)
@@ -437,19 +454,26 @@ class _Handler(BaseHTTPRequestHandler):
           was compacted away (that is how a real apiserver reports it)
         - ``timeoutSeconds``: server closes a healthy stream at the
           deadline; clients must treat it as a normal reconnect point
+        - ``allowWatchBookmarks=true``: BOOKMARK events (an object carrying
+          only ``metadata.resourceVersion``) ride the stream so a quiet
+          client's resume point tracks the head
         """
         rv = (qs.get("resourceVersion") or [None])[0]
         timeout_s = (qs.get("timeoutSeconds") or [None])[0]
+        bookmarks = (qs.get("allowWatchBookmarks") or ["false"])[0] in (
+            "true", "1")
         deadline = (
             time.monotonic() + float(timeout_s) if timeout_s is not None else None
         )
         try:
             if rv is None or rv == "0":
                 watch = self.backend.watch(
-                    r.plural, namespace=r.namespace, send_initial=True)
+                    r.plural, namespace=r.namespace, send_initial=True,
+                    allow_bookmarks=bookmarks)
             else:
                 watch = self.backend.watch(
-                    r.plural, namespace=r.namespace, resource_version=rv)
+                    r.plural, namespace=r.namespace, resource_version=rv,
+                    allow_bookmarks=bookmarks)
         except GoneError as e:
             # a real apiserver answers 200 and puts the 410 Status in the
             # first watch event, NOT in the HTTP status line

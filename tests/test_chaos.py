@@ -16,10 +16,13 @@ from jobtestutil import Harness, new_tpujob
 from tpujob.api import constants as c
 from tpujob.controller.job_base import ControllerConfig
 from tpujob.kube.chaos import (
+    FAULT_BOOKMARK_KILL,
     FAULT_COMPACT,
     FAULT_CONFLICT,
+    FAULT_DROP_PAGE,
     FAULT_DUPLICATE_EVENT,
     FAULT_ERROR,
+    FAULT_EXPIRE_CONTINUE,
     FAULT_KILL_WATCH,
     FAULT_TIMEOUT_DROPPED,
     FAULT_TIMEOUT_LOST,
@@ -67,6 +70,72 @@ def test_fault_schedule_covers_every_kind():
     assert {FAULT_KILL_WATCH, FAULT_COMPACT, FAULT_DUPLICATE_EVENT} <= stream
     # reads are never failed, only slowed
     assert {s.decision("list", n).kind for n in range(400)} == {None}
+
+
+def test_read_path_fault_schedule_deterministic_and_scoped():
+    """The paged-LIST fault verbs draw from their own seeded streams: page
+    drops only on list_page, continue expiry only on list_continue, and
+    neither bleeds into the pre-existing verbs' schedules."""
+    cfg = ChaosConfig(page_error_rate=0.3, continue_expire_rate=0.3,
+                      bookmark_kill_every=4)
+    s = FaultSchedule(11, cfg)
+    page_kinds = {s.decision("list_page", n).kind for n in range(200)}
+    assert page_kinds == {FAULT_DROP_PAGE, None}
+    cont_kinds = {s.decision("list_continue", n).kind for n in range(200)}
+    assert cont_kinds == {FAULT_EXPIRE_CONTINUE, None}
+    assert {s.decision("list", n).kind for n in range(200)} == {None}
+    stream = {k for n in range(1, 20) for k in s.stream_faults(n)}
+    assert FAULT_BOOKMARK_KILL in stream
+    # same seed, same answers — the reproducibility witness covers the new
+    # verbs too
+    a = FaultSchedule(11, cfg).describe(("list_page", "list_continue"), 100)
+    assert a == FaultSchedule(11, cfg).describe(("list_page", "list_continue"), 100)
+
+
+def test_injector_drops_pages_and_expires_continue_tokens():
+    from tpujob.kube.errors import GoneError
+
+    chaos = FaultInjectingAPIServer(seed=5, config=ChaosConfig(
+        error_rate=0, timeout_rate=0, conflict_rate=0, latency_rate=0,
+        page_error_rate=0.15, continue_expire_rate=0.15))
+    for i in range(8):
+        chaos.inner.create("pods", {"metadata": {"name": f"p{i}"}})
+    drops = expiries = walks = 0
+    for _ in range(60):
+        token = None
+        try:
+            while True:
+                page = chaos.list_page("pods", limit=2, continue_token=token)
+                token = page["continue"] or None
+                if token is None:
+                    walks += 1
+                    break
+        except ApiError as e:
+            if isinstance(e, GoneError):
+                expiries += 1
+            else:
+                drops += 1
+    assert drops and expiries and walks  # every outcome occurred
+    assert chaos.fault_count("drop-page") == drops
+    assert chaos.fault_count("expire-continue") == expiries
+
+
+def test_injector_bookmark_kill_emits_then_kills():
+    chaos = FaultInjectingAPIServer(seed=5, config=ChaosConfig(
+        error_rate=0, timeout_rate=0, conflict_rate=0, latency_rate=0,
+        bookmark_kill_every=1))
+    w = chaos.watch("pods", allow_bookmarks=True)
+    chaos.create("pods", {"metadata": {"name": "a"}})
+    assert chaos.fault_count("bookmark-kill") == 1
+    assert w.closed  # killed after the bookmark went out
+    evs = []
+    ev = w.poll()
+    while ev is not None:
+        evs.append(ev.type)
+        ev = w.poll()
+    # the bookmark was delivered BEFORE the stream died: the resume point
+    # the reconnect must use
+    assert evs == ["ADDED", "BOOKMARK"]
 
 
 # ---------------------------------------------------------------------------

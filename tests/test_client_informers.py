@@ -284,6 +284,199 @@ def test_establish_list_failure_keeps_stream_closed_and_retries():
     assert informer.store.get("default", "gap") is not None
 
 
+def test_paged_establish_no_spurious_deletes_and_complete_view():
+    """A paged establish must upsert across pages and sweep stale entries
+    only once the LAST page landed: the sweep never fires on a partial
+    view, so no live object on a later page is ever 'deleted'."""
+    from tpujob.kube.informers import SharedInformer
+    from tpujob.server import metrics
+
+    server = InMemoryAPIServer()
+    for i in range(7):
+        server.create("pods", _podd(f"p{i}"))
+    inf = SharedInformer(server, RESOURCE_PODS, page_size=2)
+    adds, deletes = [], []
+    inf.on_add(lambda o: adds.append(o["metadata"]["name"]))
+    inf.on_delete(lambda o: deletes.append(o["metadata"]["name"]))
+    pages0 = metrics.list_pages_total.value
+    inf.sync_once()
+    assert sorted(adds) == [f"p{i}" for i in range(7)]
+    assert deletes == []
+    assert metrics.list_pages_total.value - pages0 == 4  # ceil(7/2)
+    # a genuinely deleted object IS swept on the next full paged view
+    server.delete("pods", "default", "p3")
+    inf._watch.stop()
+    while inf._watch.poll() is not None:  # drop the DELETED event: the
+        pass                              # relist must find it via the sweep
+    inf.sync_once()
+    assert deletes == ["p3"]
+    assert inf.store.count() == 6
+
+
+def test_paged_relist_emits_minimal_event_diff():
+    """410-forced relist over a populated cache: only the objects that
+    actually changed in the gap dispatch events — the incremental relist,
+    not a world rebuild."""
+    server = InMemoryAPIServer()
+    for i in range(6):
+        server.create("pods", _podd(f"p{i}"))
+    inf = SharedInformerFor(server, page_size=2)
+    inf.informer.sync_once()
+    inf.reset()
+    # gap: one object changes, then the resume point is compacted away
+    server.patch("pods", "default", "p2", {"spec": {"nodeName": "n9"}})
+    server.kill_watches()
+    server.compact()
+    inf.informer._reconnect()  # 410 -> paged incremental relist
+    inf.informer.sync_once()
+    assert inf.adds == []
+    assert inf.deletes == []
+    assert inf.updates == ["p2"]  # the minimal diff: exactly what changed
+
+
+def test_paged_establish_survives_continue_token_expiry():
+    """A continue token expiring mid-pagination (410) restarts the walk on
+    a fresh snapshot inside the same establish — the cache converges and
+    no spurious deletes fire."""
+    from tpujob.kube.errors import GoneError as Gone
+    from tpujob.kube.informers import SharedInformer
+
+    server = InMemoryAPIServer()
+    for i in range(6):
+        server.create("pods", _podd(f"p{i}"))
+    real_list_page = server.list_page
+    state = {"calls": 0}
+
+    def flaky_list_page(*args, **kwargs):
+        state["calls"] += 1
+        if state["calls"] == 2:  # the FIRST continuation of the first walk
+            raise Gone("chaos: continue token expired")
+        return real_list_page(*args, **kwargs)
+
+    server.list_page = flaky_list_page
+    inf = SharedInformer(server, RESOURCE_PODS, page_size=2)
+    deletes = []
+    inf.on_delete(lambda o: deletes.append(o["metadata"]["name"]))
+    inf.sync_once()
+    assert inf.store.count() == 6
+    assert deletes == []
+    assert state["calls"] >= 4  # walk restarted after the injected 410
+
+
+def test_paged_establish_drop_page_aborts_without_partial_sweep():
+    """A page fetch 500ing mid-walk aborts the establish (watch stopped,
+    error surfaced) WITHOUT sweeping: the cache keeps its pre-fault view
+    plus the already-applied pages, and the retry converges."""
+    import pytest
+
+    from tpujob.kube.errors import ApiError
+    from tpujob.kube.informers import SharedInformer
+
+    server = InMemoryAPIServer()
+    for i in range(6):
+        server.create("pods", _podd(f"p{i}"))
+    inf = SharedInformer(server, RESOURCE_PODS, page_size=2)
+    inf.sync_once()
+    assert inf.store.count() == 6
+    # the stream dies, the gap's events are compacted away (the resume
+    # point is now unservable), and the healing relist's SECOND page 500s
+    server.kill_watches()
+    server.patch("pods", "default", "p0", {"spec": {"nodeName": "n1"}})
+    server.compact()
+    real_list_page = server.list_page
+    state = {"calls": 0}
+
+    def dropping_list_page(*args, **kwargs):
+        state["calls"] += 1
+        if state["calls"] == 2:
+            raise ApiError("chaos: injected 500 on list_page")
+        return real_list_page(*args, **kwargs)
+
+    server.list_page = dropping_list_page
+    deletes = []
+    inf.on_delete(lambda o: deletes.append(o["metadata"]["name"]))
+    with pytest.raises(ApiError):
+        inf._reconnect()
+    assert deletes == []  # no sweep on the aborted partial view
+    assert inf.store.count() == 6
+    assert getattr(inf._watch, "closed", False)  # still retryable
+    inf._reconnect()  # the retry heals
+    inf.sync_once()
+    assert inf.store.count() == 6 and deletes == []
+
+
+def test_bookmark_advanced_resume_survives_compaction():
+    """The tentpole's quiet-watch story at informer level: churn on ANOTHER
+    resource advances the pod informer's resume point via bookmarks, so a
+    stream death after compaction of older history costs a clean resume —
+    no relist, no data traffic."""
+    from tpujob.server import metrics
+
+    server = InMemoryAPIServer(bookmark_every=3)
+    server.create("pods", _podd("a"))
+    inf = SharedInformerFor(server, page_size=0)
+    inf.informer.sync_once()
+    inf.reset()
+    for i in range(9):  # quiet for pods; bookmarks fan out every 3 events
+        server.create("services", _podd(f"s{i}"))
+    inf.informer.sync_once()  # consume the queued bookmarks
+    marks = metrics.watch_bookmarks.value
+    # rv 10 = pod a + 9 services; bookmarks fired at rv 3, 6, 9
+    assert inf.informer._last_rv == "9"
+    relists0 = metrics.relists.value
+    server.kill_watches("pods")
+    server.compact(keep_last=2)  # horizon rv 9: the bookmark survives
+    inf.informer._reconnect()
+    inf.informer.sync_once()
+    assert metrics.relists.value == relists0  # resumed, never relisted
+    assert metrics.watch_bookmarks.value >= marks
+    assert inf.adds == [] and inf.deletes == []
+    # and the healed stream is live: a real event still arrives
+    server.create("pods", _podd("b"))
+    inf.informer.sync_once()
+    assert inf.informer.store.get("default", "b") is not None
+
+
+def test_reconnect_drains_queued_bookmark_before_resuming():
+    """A bookmark DELIVERED but not yet consumed when the stream dies is
+    the newest resume point we own: _reconnect must drain it first, or a
+    clean bookmark handoff turns into a 410 relist."""
+    from tpujob.server import metrics
+
+    server = InMemoryAPIServer()
+    server.create("pods", _podd("a"))
+    inf = SharedInformerFor(server, page_size=0)
+    inf.informer.sync_once()
+    for i in range(5):
+        server.create("services", _podd(f"s{i}"))
+    server.emit_bookmarks()  # queued on the stream, NOT yet consumed
+    server.kill_watches("pods")
+    server.compact(keep_last=2)
+    relists0 = metrics.relists.value
+    inf.informer._reconnect()  # must drain the bookmark, then resume
+    assert metrics.relists.value == relists0
+    assert inf.informer._last_rv == str(server._rv)
+
+
+class SharedInformerFor:
+    """Pod informer + recorded handler dispatches (test helper)."""
+
+    def __init__(self, server, page_size=0):
+        from tpujob.kube.informers import SharedInformer
+
+        self.informer = SharedInformer(
+            server, RESOURCE_PODS, page_size=page_size, bookmarks=True)
+        self.adds, self.updates, self.deletes = [], [], []
+        self.informer.on_add(lambda o: self.adds.append(o["metadata"]["name"]))
+        self.informer.on_update(
+            lambda o, n: self.updates.append(n["metadata"]["name"]))
+        self.informer.on_delete(
+            lambda o: self.deletes.append(o["metadata"]["name"]))
+
+    def reset(self):
+        del self.adds[:], self.updates[:], self.deletes[:]
+
+
 def test_resume_replay_overflow_degrades_to_relist():
     """A resume whose gap replay overflows the stream's bounded queue hands
     back an already-closed watch; the informer must degrade to a relist

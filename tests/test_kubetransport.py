@@ -303,6 +303,59 @@ def test_unknown_resource_rejected(shim, transport):
 
 
 # ---------------------------------------------------------------------------
+# paged LIST (apiserver chunking: limit/continue)
+# ---------------------------------------------------------------------------
+
+
+def test_paged_list_over_rest(shim, transport):
+    """list_page speaks the real ?limit=&continue= dialect: chunks walk one
+    snapshot and the final chunk carries no continue token."""
+    for i in range(7):
+        transport.create(c.PLURAL, _job(f"j{i}"))
+    page = transport.list_page(c.PLURAL, limit=3)
+    assert len(page["items"]) == 3 and page["continue"]
+    assert page["resourceVersion"]
+    names = [o["metadata"]["name"] for o in page["items"]]
+    transport.create(c.PLURAL, _job("late"))  # invisible to this walk
+    token = page["continue"]
+    while token:
+        page = transport.list_page(c.PLURAL, limit=3, continue_token=token)
+        names += [o["metadata"]["name"] for o in page["items"]]
+        token = page["continue"]
+    assert names == [f"j{i}" for i in range(7)]
+
+
+def test_paged_list_expired_continue_is_410_over_rest(shim, transport):
+    """An expired continue token must map onto GoneError through the REST
+    Status-object path (HTTP 410 reason=Expired) — the signal the informer
+    keys its restart-pagination on."""
+    from tpujob.kube.errors import GoneError
+
+    for i in range(4):
+        transport.create(c.PLURAL, _job(f"j{i}"))
+    page = transport.list_page(c.PLURAL, limit=2)
+    shim.backend.compact()
+    with pytest.raises(GoneError):
+        transport.list_page(c.PLURAL, limit=2, continue_token=page["continue"])
+
+
+def test_paged_informer_over_rest(shim, transport):
+    """A page-size informer syncs over the real REST dialect: several
+    chunks, complete cache, no spurious deletes."""
+    for i in range(5):
+        transport.create(c.PLURAL, _job(f"j{i}"))
+    inf = SharedInformer(transport, c.PLURAL, page_size=2)
+    deletes = []
+    inf.on_delete(lambda o: deletes.append(o["metadata"]["name"]))
+    inf.sync_once()
+    try:
+        assert inf.store.count() == 5
+        assert deletes == []
+    finally:
+        inf._watch.stop()
+
+
+# ---------------------------------------------------------------------------
 # watch streams
 # ---------------------------------------------------------------------------
 
@@ -329,6 +382,28 @@ def test_watch_stream_delivers_events(shim, transport):
         assert events[0].object["metadata"]["name"] == "j1"
     finally:
         w.stop()
+
+
+def test_watch_bookmarks_over_rest(shim, transport):
+    """allowWatchBookmarks=true: BOOKMARK events ride the stream, advance
+    last_rv, and carry no object payload — and a watch that did NOT opt in
+    never sees them."""
+    plain = transport.watch(c.PLURAL)
+    w = transport.watch(c.PLURAL, allow_bookmarks=True)
+    try:
+        transport.create(c.PLURAL, _job("j1"))
+        shim.backend.emit_bookmarks()
+        events = _drain(w, 2)
+        assert [e.type for e in events] == ["ADDED", "BOOKMARK"]
+        mark_rv = events[1].object["metadata"]["resourceVersion"]
+        assert w.last_rv == mark_rv
+        assert events[1].object.get("spec") is None  # no data payload
+        plain_events = _drain(plain, 1)
+        assert [e.type for e in plain_events] == ["ADDED"]
+        assert plain.poll(timeout=0.2) is None  # no bookmark leaked
+    finally:
+        w.stop()
+        plain.stop()
 
 
 def test_watch_closed_on_stream_death(shim, transport):

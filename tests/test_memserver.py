@@ -9,7 +9,13 @@ from tpujob.kube.errors import (
     GoneError,
     NotFoundError,
 )
-from tpujob.kube.memserver import ADDED, DELETED, MODIFIED, InMemoryAPIServer
+from tpujob.kube.memserver import (
+    ADDED,
+    BOOKMARK,
+    DELETED,
+    MODIFIED,
+    InMemoryAPIServer,
+)
 
 
 def pod(name, ns="default", labels=None, owner_uid=None):
@@ -266,6 +272,134 @@ def test_compact_forces_gone_on_resume():
     s.compact()
     with pytest.raises(GoneError):
         s.watch("pods", resource_version=str(rv))
+
+
+def test_list_page_walks_a_pinned_snapshot():
+    """Paged LIST: every page comes from the snapshot pinned by the first
+    page — writes landing mid-walk are invisible until the next LIST."""
+    s = InMemoryAPIServer()
+    for i in range(10):
+        s.create("pods", pod(f"p{i}"))
+    page = s.list_page("pods", limit=3)
+    assert len(page["items"]) == 3 and page["continue"]
+    assert page["resourceVersion"] == str(s._rv)
+    s.create("pods", pod("late"))  # after the snapshot: not in this walk
+    s.delete("pods", "default", "p9")  # deletions don't shrink it either
+    names = [o["metadata"]["name"] for o in page["items"]]
+    token = page["continue"]
+    while token:
+        page = s.list_page("pods", limit=3, continue_token=token)
+        names += [o["metadata"]["name"] for o in page["items"]]
+        token = page["continue"]
+    assert names == [f"p{i}" for i in range(10)]
+    # a fresh LIST sees the post-snapshot world
+    fresh = {o["metadata"]["name"] for o in s.list_page("pods")["items"]}
+    assert fresh == {f"p{i}" for i in range(9)} | {"late"}
+
+
+def test_list_page_filters_and_unpaged_fallback():
+    s = InMemoryAPIServer()
+    s.create("pods", pod("a", labels={"app": "x"}))
+    s.create("pods", pod("b", labels={"app": "y"}))
+    s.create("pods", pod("c", ns="other", labels={"app": "x"}))
+    out = s.list_page("pods", label_selector={"app": "x"})
+    assert {o["metadata"]["name"] for o in out["items"]} == {"a", "c"}
+    assert out["continue"] == ""  # fits in one page
+    scoped = s.list_page("pods", namespace="other", limit=5)
+    assert [o["metadata"]["name"] for o in scoped["items"]] == ["c"]
+
+
+def test_list_page_continue_token_expires_on_compaction():
+    """compact() kills outstanding continue tokens with 410 Expired —
+    exactly like etcd compacting the snapshot revision mid-walk."""
+    s = InMemoryAPIServer()
+    for i in range(6):
+        s.create("pods", pod(f"p{i}"))
+    page = s.list_page("pods", limit=2)
+    s.compact()
+    with pytest.raises(GoneError):
+        s.list_page("pods", limit=2, continue_token=page["continue"])
+
+
+def test_list_page_continue_token_expires_when_history_rolls():
+    """Natural compaction: the bounded history evicting past the snapshot's
+    pinned RV expires the token — no explicit compact() needed."""
+    s = InMemoryAPIServer(history_size=4)
+    for i in range(6):
+        s.create("pods", pod(f"p{i}"))
+    page = s.list_page("pods", limit=2)
+    compactions0 = s.history_compactions
+    for i in range(8):  # roll the whole history window past the snapshot
+        s.create("pods", pod(f"q{i}"))
+    with pytest.raises(GoneError):
+        s.list_page("pods", limit=2, continue_token=page["continue"])
+    assert s.history_compactions > compactions0
+
+
+def test_partial_compaction_keeps_recent_resume_points():
+    """compact(keep_last=N): resume points inside the kept window stay
+    servable (the realistic etcd shape), older ones answer 410."""
+    s = InMemoryAPIServer()
+    old = s.create("pods", pod("old"))
+    for i in range(10):
+        s.create("pods", pod(f"p{i}"))
+    recent_rv = str(s._rv - 2)
+    s.compact(keep_last=5)
+    with pytest.raises(GoneError):
+        s.watch("pods", resource_version=old["metadata"]["resourceVersion"])
+    w = s.watch("pods", resource_version=recent_rv)  # survives
+    assert [e.object["metadata"]["name"] for e in (w.poll(), w.poll())] == [
+        "p8", "p9"]
+
+
+def test_bookmarks_advance_quiet_watch_resume_point():
+    """A watch on a QUIET resource rides bookmarks fanned out by churn on
+    another resource: its resume point tracks the head, so a reconnect
+    after compaction of older history resumes instead of relisting."""
+    s = InMemoryAPIServer(bookmark_every=3)
+    s.create("pods", pod("seed"))  # rv 1: both watches open past "0"
+    quiet = s.watch("services", allow_bookmarks=True)
+    plain = s.watch("configmaps")  # no bookmarks requested: stays stale
+    for i in range(6):
+        s.create("pods", pod(f"p{i}"))
+    marks = []
+    ev = quiet.poll()
+    while ev is not None:
+        assert ev.type == BOOKMARK
+        marks.append(ev.object["metadata"]["resourceVersion"])
+        ev = quiet.poll()
+    assert marks == ["3", "6"]
+    assert quiet.last_rv == "6"
+    assert plain.poll() is None and plain.last_rv == "1"
+    s.compact(keep_last=2)  # horizon is now rv 6: the bookmark survives
+    resumed = s.watch("services", resource_version=quiet.last_rv)
+    assert resumed.poll() is None  # clean resume, zero replay traffic
+    with pytest.raises(GoneError):  # the bookmark-less stream must relist
+        s.watch("configmaps", resource_version=plain.last_rv)
+
+
+def test_explicit_emit_bookmarks_and_compaction_counter():
+    s = InMemoryAPIServer()
+    w = s.watch("pods", allow_bookmarks=True)
+    s.create("pods", pod("a"))
+    assert s.emit_bookmarks() == 1
+    assert w.poll().type == ADDED
+    bm = w.poll()
+    assert bm.type == BOOKMARK
+    assert bm.object["metadata"]["resourceVersion"] == str(s._rv)
+    n0 = s.history_compactions
+    s.compact()
+    assert s.history_compactions == n0 + 1
+
+
+def test_kill_watches_by_resource():
+    s = InMemoryAPIServer()
+    wp = s.watch("pods")
+    ws = s.watch("services")
+    assert s.kill_watches("pods") == 1
+    assert wp.closed and not ws.closed
+    assert s.kill_watches() == 1  # the rest
+    assert ws.closed
 
 
 def test_overflow_during_initial_replay_not_registered():

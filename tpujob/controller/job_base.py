@@ -122,6 +122,21 @@ class ControllerConfig:
     # own — a 256-pod slice coming up triggers a handful of syncs, not 256.
     # <= 0 disables (every event enqueues immediately, the pre-PR behavior).
     settle_window_s: float = 0.02
+    # --- API read-path knobs (LIST/watch cost proportional to change) ---
+    # LIST chunk size for informer initial syncs and relists: continue-token
+    # paging keeps transient memory O(page) at six-figure object counts and
+    # makes mid-LIST faults recoverable per page.  <= 0 restores one unpaged
+    # LIST (the pre-overhaul read path; also the bench control).
+    informer_page_size: int = 500
+    # request watch BOOKMARK events so a quiet informer's resume point
+    # tracks the server head and a reconnect resumes instead of relisting
+    # the world after history compaction.  Only transports advertising
+    # supports_bookmarks honor it; False is the bench control.
+    watch_bookmarks: bool = True
+    # cold-start barrier budget: how long run() waits for every informer's
+    # initial LIST.  The 10s default fits test clusters; a six-figure
+    # object count (bench_controller --objects) needs minutes, not seconds.
+    cache_sync_timeout_s: float = 10.0
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
@@ -233,7 +248,9 @@ class JobController:
         # --namespace scopes every informer's list/watch, the way the
         # reference scopes its informer factories (app/server.go:111-114)
         self.factory = factory or InformerFactory(
-            clients.server, namespace=self.config.namespace
+            clients.server, namespace=self.config.namespace,
+            page_size=self.config.informer_page_size,
+            bookmarks=self.config.watch_bookmarks,
         )
         self.recorder = recorder or EventRecorder(clients)
         self.pod_control = PodControl(clients, self.recorder)
@@ -637,14 +654,14 @@ class JobController:
         self._run_started_mono = time.monotonic()
         self._first_sync_recorded = False
         self.factory.start(stop_event)
-        if not self.factory.wait_for_cache_sync():
+        if not self.factory.wait_for_cache_sync(self.config.cache_sync_timeout_s):
             raise RuntimeError("informer caches failed to sync")
         synced_s = time.monotonic() - self._run_started_mono
         metrics.cold_start_duration.labels(stage="caches_synced").observe(synced_s)
         self.flight.record(
             CONTROLLER_TIMELINE_KEY, "coldstart",
             f"informer caches synced in {synced_s * 1e3:.1f}ms "
-            f"({len(self.job_informer.store.list())} job(s) listed)",
+            f"({self.job_informer.store.count()} job(s) listed)",
             {"stage": "caches_synced", "duration_s": round(synced_s, 6)})
         # ledger reconstruction from durable state happens behind the
         # barrier, before the first dequeue

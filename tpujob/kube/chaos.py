@@ -24,7 +24,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from tpujob.kube.errors import ApiError, ConflictError, ServerTimeoutError
+from tpujob.kube.errors import ApiError, ConflictError, GoneError, ServerTimeoutError
 from tpujob.kube.memserver import InMemoryAPIServer
 from tpujob.server import metrics
 
@@ -37,6 +37,10 @@ FAULT_CONFLICT = "conflict"  # spurious 409 (e.g. a racing writer won)
 FAULT_KILL_WATCH = "kill-watch"
 FAULT_COMPACT = "compact"
 FAULT_DUPLICATE_EVENT = "duplicate-event"
+# read-path (paged LIST / bookmark) faults
+FAULT_DROP_PAGE = "drop-page"  # one page of a chunked LIST 500s mid-walk
+FAULT_EXPIRE_CONTINUE = "expire-continue"  # continue token answers 410
+FAULT_BOOKMARK_KILL = "bookmark-kill"  # bookmark delivered, then stream dies
 
 MUTATING_VERBS = (
     "create", "update", "update_status", "patch", "patch_status", "delete",
@@ -65,6 +69,17 @@ class ChaosConfig:
     kill_watch_every: int = 0
     compact_every: int = 0
     duplicate_event_rate: float = 0.0  # replay the newest event per mutation
+    # read-path faults (paged LIST / bookmarks), all default OFF:
+    # one page of a chunked LIST fails with a 500 mid-walk — the informer
+    # must abort and retry the whole establish, never sweep a partial view
+    page_error_rate: float = 0.0
+    # a continuation call's token answers 410 Expired (compaction outran
+    # the walk) — the informer must restart pagination on a fresh snapshot
+    continue_expire_rate: float = 0.0
+    # every N committed mutations: force a BOOKMARK to every bookmark
+    # watch, then kill one stream — the reconnect must resume from the
+    # just-advanced bookmark RV, not an older data-event RV
+    bookmark_kill_every: int = 0
 
 
 @dataclass(frozen=True)
@@ -98,6 +113,16 @@ class FaultSchedule:
             if r_latency < cfg.latency_rate
             else 0.0
         )
+        if verb == "list_page":
+            # chunked-LIST page fetch: can 500 mid-walk (dropped page)
+            if r_fault < cfg.page_error_rate:
+                return Decision(FAULT_DROP_PAGE, latency)
+            return Decision(None, latency)
+        if verb == "list_continue":
+            # continuation with a token: can answer 410 Expired
+            if r_fault < cfg.continue_expire_rate:
+                return Decision(FAULT_EXPIRE_CONTINUE, latency)
+            return Decision(None, latency)
         if verb not in MUTATING_VERBS:
             return Decision(None, latency)
         threshold = 0.0
@@ -125,6 +150,8 @@ class FaultSchedule:
             rng = random.Random(f"{self.seed}:dup:{mutation_n}")
             if rng.random() < cfg.duplicate_event_rate:
                 out.append(FAULT_DUPLICATE_EVENT)
+        if cfg.bookmark_kill_every and mutation_n % cfg.bookmark_kill_every == 0:
+            out.append(FAULT_BOOKMARK_KILL)
         return out
 
     def describe(self, verbs: Tuple[str, ...], n_calls: int) -> str:
@@ -176,6 +203,17 @@ class FaultInjectingAPIServer:
         return getattr(self.inner, "supports_resume", False)
 
     @property
+    def supports_paging(self) -> bool:
+        return getattr(self.inner, "supports_paging", False)
+
+    @property
+    def supports_bookmarks(self) -> bool:
+        return getattr(self.inner, "supports_bookmarks", False)
+
+    def emit_bookmarks(self) -> int:
+        return self.inner.emit_bookmarks()
+
+    @property
     def hooks(self) -> List[Callable[[str, str, Dict[str, Any]], None]]:
         return self.inner.hooks
 
@@ -224,6 +262,14 @@ class FaultInjectingAPIServer:
             elif kind == FAULT_DUPLICATE_EVENT:
                 if self.inner.replay_last(1):
                     self._record("watch", n, FAULT_DUPLICATE_EVENT)
+            elif kind == FAULT_BOOKMARK_KILL:
+                # advance every bookmark watch's resume point, THEN kill a
+                # stream: the reconnect must resume from the bookmark RV
+                # (the gap between bookmark and death is empty by design)
+                self.inner.emit_bookmarks()
+                rng = random.Random(f"{self.schedule.seed}:bkvictim:{n}")
+                if self.inner.kill_watch(rng.randrange(1 << 16)):
+                    self._record("watch", n, FAULT_BOOKMARK_KILL)
 
     def _mutate(self, verb: str, fn: Callable[[], Any]) -> Any:
         n = self._next(verb)
@@ -271,6 +317,37 @@ class FaultInjectingAPIServer:
     ) -> List[Dict[str, Any]]:
         return self._read(
             "list", lambda: self.inner.list(resource, namespace, label_selector)
+        )
+
+    def list_page(
+        self,
+        resource: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        limit: int = 0,
+        continue_token: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Paged LIST under fault injection: a page fetch can 500 mid-walk
+        (``page_error_rate``) and a continuation's token can expire with a
+        410 (``continue_expire_rate``) — the partial-LIST recovery paths the
+        informer must survive without sweeping a partial view."""
+        n = self._next("list_page")
+        d = self.schedule.decision("list_page", n)
+        if d.latency_s:
+            time.sleep(d.latency_s)
+        if d.kind == FAULT_DROP_PAGE:
+            self._record("list_page", n, d.kind)
+            raise ApiError(f"chaos: injected 500 on list_page (call {n})")
+        if continue_token:
+            m = self._next("list_continue")
+            dc = self.schedule.decision("list_continue", m)
+            if dc.kind == FAULT_EXPIRE_CONTINUE:
+                self._record("list_continue", m, dc.kind)
+                raise GoneError(
+                    f"chaos: injected 410 on continue token (call {m})")
+        return self.inner.list_page(
+            resource, namespace, label_selector,
+            limit=limit, continue_token=continue_token,
         )
 
     def update(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:

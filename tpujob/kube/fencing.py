@@ -114,6 +114,9 @@ class KillSwitchTransport:
     def list(self, *a, **kw):
         return self._call("list", *a, **kw)
 
+    def list_page(self, *a, **kw):
+        return self._call("list_page", *a, **kw)
+
     def update(self, *a, **kw):
         return self._call("update", *a, **kw)
 

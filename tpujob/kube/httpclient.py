@@ -77,6 +77,8 @@ class HTTPWatch:
                 if rv:
                     self.last_rv = str(rv)
                 if d["type"] != "BOOKMARK":
+                    # not the leading bookmark after all: a real event raced
+                    # the connect — hand it to the consumer
                     self._q.put(WatchEvent(d["type"], "", d["object"]))
                 return
         except Exception as e:
@@ -99,8 +101,8 @@ class HTTPWatch:
                     "resourceVersion")
                 if rv:
                     self.last_rv = str(rv)
-                if d["type"] == "BOOKMARK":
-                    continue  # carries the opening RV only, not an object
+                # mid-stream BOOKMARKs (requested via allow_bookmarks) are
+                # forwarded so informers advance their own resume point
                 self._q.put(WatchEvent(d["type"], "", d["object"]))
         except Exception as e:
             if not self._stopped.is_set():
@@ -204,14 +206,46 @@ class HTTPApiClient:
 
     def list(self, resource: str, namespace: Optional[str] = None,
              label_selector: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
+        params = self._list_params(namespace, label_selector)
+        q = ("?" + "&".join(params)) if params else ""
+        return self._request("GET", f"/api/{resource}{q}").get("items", [])
+
+    # list_page() serves the continue-token paged dialect; informers gate
+    # their chunked LISTs on this flag
+    supports_paging = True
+
+    def list_page(
+        self,
+        resource: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        limit: int = 0,
+        continue_token: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Paged LIST (``?limit=&continue=``) returning
+        ``{"items", "continue", "resourceVersion"}``.  An expired continue
+        token surfaces as :class:`GoneError` (410) — restart the LIST."""
+        params = self._list_params(namespace, label_selector)
+        params.append(f"limit={int(limit)}")
+        if continue_token:
+            params.append("continue=" + urllib.parse.quote(continue_token))
+        out = self._request("GET", f"/api/{resource}?" + "&".join(params))
+        meta = out.get("metadata") or {}
+        return {
+            "items": out.get("items") or [],
+            "continue": meta.get("continue") or "",
+            "resourceVersion": meta.get("resourceVersion"),
+        }
+
+    @staticmethod
+    def _list_params(namespace, label_selector) -> List[str]:
         params = []
         if namespace:
             params.append(f"namespace={namespace}")
         if label_selector:
             sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
             params.append(f"labelSelector={sel}")
-        q = ("?" + "&".join(params)) if params else ""
-        return self._request("GET", f"/api/{resource}{q}").get("items", [])
+        return params
 
     def update(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
         return self._request("PUT", f"/api/{resource}", obj)
@@ -248,6 +282,8 @@ class HTTPApiClient:
     # watch() accepts resource_version with 410-Gone semantics, so
     # informers resume after stream death instead of relisting
     supports_resume = True
+    # watch() accepts allow_bookmarks (mid-stream BOOKMARK resume points)
+    supports_bookmarks = True
 
     def watch(
         self,
@@ -255,6 +291,7 @@ class HTTPApiClient:
         send_initial: bool = False,
         namespace: Optional[str] = None,
         resource_version: Optional[str] = None,
+        allow_bookmarks: bool = False,
     ) -> HTTPWatch:
         if resource is None:
             raise InvalidError("HTTP transport requires a per-resource watch")
@@ -266,6 +303,8 @@ class HTTPApiClient:
         if resource_version is not None:
             params.append(
                 "resourceVersion=" + urllib.parse.quote(str(resource_version)))
+        if allow_bookmarks:
+            params.append("bookmarks=1")
         suffix = ("?" + "&".join(params)) if params else ""
         return HTTPWatch(f"{self.base_url}/watch/{resource}{suffix}",
                          initial_rv=resource_version)

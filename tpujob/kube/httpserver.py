@@ -9,13 +9,18 @@ Routes:
     POST   /api/{resource}                    create (body: object)
     GET    /api/{resource}/{ns}/{name}        get
     GET    /api/{resource}?namespace=&labelSelector=k=v,k2=v2   list
+    GET    /api/{resource}?limit=N[&continue=TOKEN]             paged list
+                                              (tokens pin a snapshot RV;
+                                              410 Expired once compacted)
     PUT    /api/{resource}                    update (body: object)
     PUT    /api/{resource}/status             update_status (body: object)
     PATCH  /api/{resource}/{ns}/{name}        strategic-merge patch
     PATCH  /api/{resource}/{ns}/{name}/status[?resourceVersion=N]
                                               JSON-merge-patch of .status only
     DELETE /api/{resource}/{ns}/{name}        delete
-    GET    /watch/{resource}[?initial=1]      ndjson watch stream
+    GET    /watch/{resource}[?initial=1][&bookmarks=1]   ndjson watch stream
+                                              (bookmarks=1 adds periodic
+                                              BOOKMARK resume-point events)
     GET    /healthz                           liveness
 """
 from __future__ import annotations
@@ -85,14 +90,36 @@ class _Handler(BaseHTTPRequestHandler):
             elif len(parts) == 2 and parts[0] == "api":
                 ns = (query.get("namespace") or [None])[0]
                 sel = _parse_selector((query.get("labelSelector") or [None])[0])
-                items = self.backend.list(parts[1], ns, sel)
-                self._json(200, {"kind": "List", "items": items})
+                limit = (query.get("limit") or [None])[0]
+                cont = (query.get("continue") or [None])[0]
+                if limit is not None or cont is not None:
+                    # paged LIST: continue tokens pin a snapshot RV; an
+                    # expired token surfaces as 410 via the ApiError path
+                    page = self.backend.list_page(
+                        parts[1], ns, sel,
+                        limit=int(limit or 0), continue_token=cont)
+                    self._json(200, {
+                        "kind": "List",
+                        "items": page["items"],
+                        "metadata": {
+                            "continue": page.get("continue") or "",
+                            "resourceVersion": page.get("resourceVersion"),
+                        },
+                    })
+                else:
+                    items = self.backend.list(parts[1], ns, sel)
+                    self._json(200, {"kind": "List", "items": items})
             elif len(parts) == 4 and parts[0] == "api":
                 self._json(200, self.backend.get(parts[1], parts[2], parts[3]))
             else:
                 self._json(404, {"message": f"no route {self.path}"})
         except ApiError as e:
             self._error(e)
+        except ValueError as e:
+            # malformed query input (e.g. ?limit=abc) is the client's
+            # error, not a dropped connection
+            self._json(400, {"kind": "Status", "reason": "BadRequest",
+                             "message": str(e)})
 
     def do_POST(self):
         _, parts, _ = self._route()
@@ -146,11 +173,13 @@ class _Handler(BaseHTTPRequestHandler):
         initial = (query.get("initial") or ["0"])[0] in ("1", "true")
         ns = (query.get("namespace") or [None])[0]
         rv = (query.get("resourceVersion") or [None])[0]
+        bookmarks = (query.get("bookmarks") or ["0"])[0] in ("1", "true")
         # resume-from-RV: replays events after rv, or raises GoneError
         # (410 response via do_GET's error path) when compacted — the
         # informer then relists
         watch = self.backend.watch(
-            resource, send_initial=initial, namespace=ns, resource_version=rv
+            resource, send_initial=initial, namespace=ns, resource_version=rv,
+            allow_bookmarks=bookmarks,
         )
         try:
             self.send_response(200)

@@ -14,7 +14,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tpujob.api import constants as c
 from tpujob.kube.errors import GoneError
-from tpujob.kube.memserver import ADDED, DELETED, MODIFIED, InMemoryAPIServer
+from tpujob.kube.memserver import (
+    ADDED,
+    BOOKMARK,
+    DELETED,
+    MODIFIED,
+    InMemoryAPIServer,
+)
 from tpujob.server import metrics
 
 log = logging.getLogger("tpujob.informers")
@@ -120,6 +126,16 @@ class Store:
         with self._lock:
             return self._objects.get((namespace or "default", name))
 
+    def count(self) -> int:
+        """Cached-object count without materializing a snapshot list —
+        size probes (cold-start logs, sync_once accounting) must not pay
+        an O(cluster) copy per call at six-figure object counts."""
+        with self._lock:
+            return len(self._objects)
+
+    def __len__(self) -> int:
+        return self.count()
+
     def list(self, namespace: Optional[str] = None) -> List[Dict[str, Any]]:
         """Snapshot list (objects shared read-only, see class docstring)."""
         with self._lock:
@@ -150,15 +166,28 @@ UpdateHandler = Callable[[Dict[str, Any], Dict[str, Any]], None]
 class SharedInformer:
     """Watch-fed cache + handler dispatch for one resource type."""
 
+    # how many times one _establish retries a pagination whose continue
+    # token expired (410 mid-LIST) before surfacing the error to the run
+    # loop's slower retry cadence
+    PAGED_LIST_ATTEMPTS = 3
+
     def __init__(
         self,
         server: InMemoryAPIServer,
         resource: str,
         namespace: Optional[str] = None,
+        page_size: int = 0,
+        bookmarks: bool = True,
     ):
         self.server = server
         self.resource = resource
         self.namespace = namespace  # None = cluster-wide (corev1.NamespaceAll)
+        # LIST chunk size for initial syncs and relists (0 = one unpaged
+        # LIST); only honored when the transport advertises supports_paging
+        self.page_size = page_size
+        # request BOOKMARK events so a quiet stream's resume point advances
+        # without data traffic; only honored with supports_bookmarks
+        self.bookmarks = bookmarks
         self.store = Store()
         self._add_handlers: List[Handler] = []
         self._update_handlers: List[UpdateHandler] = []
@@ -189,15 +218,29 @@ class SharedInformer:
 
     # -- run ----------------------------------------------------------------
 
+    def _watch_kwargs(self) -> Dict[str, Any]:
+        kw: Dict[str, Any] = {"namespace": self.namespace}
+        if self.bookmarks and getattr(self.server, "supports_bookmarks", False):
+            kw["allow_bookmarks"] = True
+        return kw
+
     def _establish(self) -> None:
         """Open the watch, then LIST (watch-first so no events are lost) and
-        reconcile the local cache against the fresh list."""
-        watch = self.server.watch(self.resource, namespace=self.namespace)
+        incrementally reconcile the local cache against the fresh list —
+        emitting only the real adds/updates/deletes the diff finds, never
+        rebuilding the world."""
+        watch = self.server.watch(self.resource, **self._watch_kwargs())
         # the stream's opening RV is a valid resume point even before any
         # event is handled (the initial state arrives via LIST, not events)
         opening_rv = getattr(watch, "last_rv", None)
         try:
-            initial = self.server.list(self.resource, namespace=self.namespace)
+            if self.page_size > 0 and getattr(self.server, "supports_paging", False):
+                self._paged_reconcile()
+            else:
+                # an unpaged LIST is the one-page degenerate of the same
+                # reconcile: same diff, same complete-view-only sweep
+                initial = self.server.list(self.resource, namespace=self.namespace)
+                self._reconcile_pages([initial])
         except Exception:
             # a live watch over an unreconciled stale cache is worse than no
             # watch: the run loop only retries while the stream reads closed,
@@ -210,26 +253,99 @@ class SharedInformer:
         # retrying every 0.5s must not inflate the relist ratio with
         # attempts that never healed anything
         metrics.relists.inc()
-        known = {Store._key(o) for o in initial}
+        self._synced.set()
+
+    def _paged_reconcile(self) -> None:
+        """Chunked LIST+reconcile (``?limit=&continue=``): pages stream
+        through the differ one at a time, so transient memory stays O(page)
+        instead of O(cluster), and the stale sweep runs only once the LAST
+        page landed — a partial view must never masquerade as the whole
+        world and emit spurious deletes.  A continue token expiring
+        mid-pagination (410: compaction outran the walk) restarts the LIST
+        on a fresh snapshot; the pages already applied were true committed
+        state, so re-diffing them is idempotent."""
+        for attempt in range(self.PAGED_LIST_ATTEMPTS):
+            try:
+                self._reconcile_pages(self._iter_pages())
+                return
+            except GoneError:
+                if attempt == self.PAGED_LIST_ATTEMPTS - 1:
+                    raise
+                log.info(
+                    "informer %s: continue token expired mid-LIST; "
+                    "restarting pagination on a fresh snapshot", self.resource)
+
+    def _iter_pages(self):
+        """Yield one chunk of objects per list_page call until the continue
+        token runs out."""
+        token = None
+        while True:
+            page = self.server.list_page(
+                self.resource, namespace=self.namespace,
+                limit=self.page_size, continue_token=token,
+            )
+            yield page.get("items") or []
+            token = page.get("continue") or None
+            if token is None:
+                return
+
+    def _reconcile_pages(self, pages) -> None:
+        """Diff each chunk against the cache as it arrives, then sweep the
+        stale entries — only after the view is COMPLETE.  A GoneError from
+        a lazy page fetch aborts before the sweep, so a partial view never
+        deletes live objects."""
+        known = set()
+        for items in pages:
+            metrics.list_pages_total.inc()
+            metrics.relist_objects_diffed.inc(len(items))
+            for obj in items:
+                known.add(Store._key(obj))
+                self._apply_listed(obj)
+        self._sweep_stale(known)
+
+    def _apply_listed(self, obj: Dict[str, Any]) -> None:
+        """Diff one listed object against the cache: dispatch an add only
+        for genuinely new objects, an update only when the resourceVersion
+        moved — an unchanged object costs an upsert and no handler call."""
+        old = self.store.get(*Store._key(obj))
+        self.store.upsert(obj)
+        if old is None:
+            self._dispatch_add(obj)
+        elif old.get("metadata", {}).get("resourceVersion") != obj.get(
+            "metadata", {}
+        ).get("resourceVersion"):
+            self._dispatch_update(old, obj)
+
+    def _sweep_stale(self, known: set) -> None:
+        """Remove cached objects absent from a COMPLETE listed view.  Only
+        ever called with every page consumed — sweeping against a partial
+        page set would delete live objects that simply live on later pages."""
         for stale in [o for o in self.store.list() if Store._key(o) not in known]:
             self.store.remove(stale)
             self._dispatch_delete(stale)
-        for obj in initial:
-            old = self.store.get(*Store._key(obj))
-            self.store.upsert(obj)
-            if old is None:
-                self._dispatch_add(obj)
-            elif old.get("metadata", {}).get("resourceVersion") != obj.get(
-                "metadata", {}
-            ).get("resourceVersion"):
-                self._dispatch_update(old, obj)
-        self._synced.set()
 
     def _reconnect(self) -> None:
         """Stream died: resume from the last-seen resourceVersion when the
         transport supports it, relisting only when the resume point is gone
         (410) or unknown — client-go reflector semantics; the reference
-        inherits them via its informers (controller.go:140-176)."""
+        inherits them via its informers (controller.go:140-176).  With
+        bookmarks on, the resume point of even a QUIET stream tracked the
+        server's head, so this path almost never degrades to a relist."""
+        # drain what the dead stream already delivered BEFORE resuming: a
+        # queued-but-unhandled event (a bookmark especially) is the newest
+        # resume point we own — discarding it would resume from an older RV
+        # and turn a clean bookmark handoff into a 410 relist
+        if self._watch is not None:
+            while True:
+                ev = self._watch.poll()
+                if ev is None:
+                    break
+                try:
+                    self._handle(ev.type, ev.object)
+                except Exception:
+                    log.exception(
+                        "informer %s: drain handler failed", self.resource)
+        had_stream = self._watch is not None
         if (
             getattr(self._watch, "gone", False)
             or self._last_rv is None
@@ -241,8 +357,8 @@ class SharedInformer:
         else:
             try:
                 resumed = self.server.watch(
-                    self.resource, namespace=self.namespace,
-                    resource_version=self._last_rv,
+                    self.resource, resource_version=self._last_rv,
+                    **self._watch_kwargs(),
                 )
             except GoneError:
                 log.info("informer %s: resume point %s expired; relisting",
@@ -259,19 +375,29 @@ class SharedInformer:
                 else:
                     self._watch = resumed
         # a stream counts as re-established only after the resume (or the
-        # relist it degraded to) actually succeeded
-        metrics.watch_reconnects.inc()
+        # relist it degraded to) actually succeeded; the very FIRST
+        # establish is an initial sync, not a reconnect
+        if had_stream:
+            metrics.watch_reconnects.inc()
 
     def run(self, stop_event: threading.Event) -> None:
-        """Start the watch loop in a background thread (client-go Run)."""
-        self._establish()
+        """Start the watch loop in a background thread (client-go
+        Reflector.Run).  The initial establish happens ON the thread with
+        the same retry cadence as reconnects: a paged cold start at 100k
+        objects is hundreds of page requests, and one transient 500 must
+        cost a 0.5s retry, not the whole controller process.  Callers gate
+        readiness on wait_for_cache_sync (bounded by the controller's
+        cache_sync_timeout_s) exactly as before."""
 
         def loop():
             while not stop_event.is_set():
-                if getattr(self._watch, "closed", False):
+                if self._watch is None or getattr(self._watch, "closed", False):
                     try:
                         self._reconnect()
-                    except Exception:
+                    except Exception as e:
+                        log.warning(
+                            "informer %s: establish/reconnect failed: %s; "
+                            "retrying", self.resource, e)
                         stop_event.wait(0.5)
                         continue
                 ev = self._watch.poll(timeout=0.05)
@@ -307,9 +433,11 @@ class SharedInformer:
         establishes the watch + initial list on first call.
         """
         if self._watch is None or getattr(self._watch, "closed", False):
-            n0 = len(self.store.list())
+            # count(), not len(list()): the pre/post size probes must not
+            # each snapshot the whole cache per resync pass
+            n0 = self.store.count()
             self._establish()
-            return max(len(self.store.list()), n0)
+            return max(self.store.count(), n0)
         n = 0
         while True:
             ev = self._watch.poll()
@@ -332,6 +460,11 @@ class SharedInformer:
                 newer = True  # opaque non-numeric RVs: keep last-seen semantics
             if newer:
                 self._last_rv = str(rv)
+        if ev_type == BOOKMARK:
+            # resume point advanced (above) with zero data traffic: nothing
+            # to cache, nothing to dispatch — the whole point of bookmarks
+            metrics.watch_bookmarks.inc()
+            return
         if ev_type == ADDED:
             old = self.store.get(*Store._key(obj))
             self.store.upsert(obj)
@@ -366,21 +499,28 @@ class SharedInformer:
 class InformerFactory:
     """SharedInformerFactory equivalent: one informer per resource, shared."""
 
-    def __init__(self, server: InMemoryAPIServer, namespace: Optional[str] = None):
+    def __init__(self, server: InMemoryAPIServer, namespace: Optional[str] = None,
+                 page_size: int = 0, bookmarks: bool = True):
         self.server = server
         self.namespace = namespace  # None = all namespaces; else scoped factory
+        self.page_size = page_size  # LIST chunk size for every informer
+        self.bookmarks = bookmarks  # request watch BOOKMARK events
         self._informers: Dict[str, SharedInformer] = {}
 
     def informer(self, resource: str) -> SharedInformer:
         if resource not in self._informers:
             self._informers[resource] = SharedInformer(
-                self.server, resource, namespace=self.namespace
+                self.server, resource, namespace=self.namespace,
+                page_size=self.page_size, bookmarks=self.bookmarks,
             )
         return self._informers[resource]
 
     def start(self, stop_event: threading.Event) -> None:
         for informer in self._informers.values():
-            if informer._watch is None:
+            # _thread guards double-starts (the initial establish now runs
+            # asynchronously on the informer thread); _watch preserves the
+            # old contract that a sync_once-driven informer stays manual
+            if informer._thread is None and informer._watch is None:
                 informer.run(stop_event)
 
     def sync_all(self) -> int:

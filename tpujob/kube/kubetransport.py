@@ -502,6 +502,37 @@ class KubeApiTransport:
             url = f"{url}?{q}"
         return self._request("GET", url).get("items") or []
 
+    # list_page() maps onto the apiserver's native limit/continue chunking
+    supports_paging = True
+
+    def list_page(
+        self,
+        resource: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        limit: int = 0,
+        continue_token: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Chunked LIST via the real K8s ``?limit=&continue=`` contract
+        (apiserver chunking, KEP-365): each chunk is served from the same
+        storage snapshot, and a continue token older than etcd's compacted
+        revision answers 410 ``Expired`` — surfaced as :class:`GoneError`
+        by the shared status mapping, so informers restart the LIST."""
+        url = self._collection(resource, namespace or self.namespace)
+        params = [f"limit={int(limit)}"]
+        sel = self._selector_q(label_selector)
+        if sel:
+            params.append(sel)
+        if continue_token:
+            params.append("continue=" + urllib.parse.quote(continue_token))
+        out = self._request("GET", f"{url}?{'&'.join(params)}")
+        meta = out.get("metadata") or {}
+        return {
+            "items": out.get("items") or [],
+            "continue": meta.get("continue") or "",
+            "resourceVersion": meta.get("resourceVersion"),
+        }
+
     def update(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
         obj = self._with_gvk(resource, obj)
         name = (obj.get("metadata") or {}).get("name") or ""
@@ -618,12 +649,16 @@ class KubeApiTransport:
     # would have ended a healthy stream anyway
     WATCH_TIMEOUT_S = 300
 
+    # watch() accepts allow_bookmarks (maps onto allowWatchBookmarks)
+    supports_bookmarks = True
+
     def watch(
         self,
         resource: Optional[str] = None,
         send_initial: bool = False,
         namespace: Optional[str] = None,
         resource_version: Optional[str] = None,
+        allow_bookmarks: bool = False,
     ) -> _RestWatch:
         """Streaming watch; scoped to ``namespace`` (or the transport's
         configured scope) when set, cluster-wide otherwise.
@@ -633,13 +668,16 @@ class KubeApiTransport:
         when compacted — then the caller must relist).  Unset, the watch
         starts at the current collection RV; ``send_initial`` omits the RV
         entirely so the apiserver synthesizes ADDED events for current
-        state."""
+        state.  ``allow_bookmarks`` maps onto ``allowWatchBookmarks=true``:
+        the apiserver's periodic BOOKMARK events ride the stream (the pump
+        forwards them) so the consumer's resume point tracks the head even
+        on a quiet watch."""
         if resource is None:
             raise InvalidError("the K8s API has no cross-resource watch")
         url = self._collection(resource, namespace or self.namespace)
         params = [
             "watch=true",
-            "allowWatchBookmarks=false",
+            "allowWatchBookmarks=" + ("true" if allow_bookmarks else "false"),
             f"timeoutSeconds={self.WATCH_TIMEOUT_S}",
         ]
         rv_param: Optional[str] = None

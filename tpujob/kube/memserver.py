@@ -31,11 +31,15 @@ from tpujob.kube.errors import (
     InvalidError,
     NotFoundError,
 )
+from tpujob.server import metrics
 
 # Event types on watch streams
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+# resume-point advance without data traffic: the object carries only
+# metadata.resourceVersion (K8s watch bookmark semantics)
+BOOKMARK = "BOOKMARK"
 
 
 def now_iso() -> str:
@@ -71,6 +75,7 @@ class Watch:
         self._stopped = False
         self.closed = False  # True once the stream can deliver no more events
         self.gone = False  # parity with the REST watch surface
+        self.bookmarks = False  # subscriber opted into BOOKMARK events
         # newest RV queued on the stream (opening RV until the first
         # event) — same semantics as _RestWatch.last_rv
         self.last_rv: Optional[str] = None
@@ -130,9 +135,17 @@ class InMemoryAPIServer:
     # watch() accepts resource_version with 410-Gone semantics (informers
     # resume instead of relisting); see KubeApiTransport.supports_resume
     supports_resume = True
+    # list_page() serves continue-token paged LISTs pinned to a snapshot
+    # resourceVersion; watch() accepts allow_bookmarks
+    supports_paging = True
+    supports_bookmarks = True
+
+    # concurrent paged LISTs each pin a snapshot; bound how many can be
+    # alive at once (oldest evicted — its continue tokens then 410)
+    MAX_LIST_SNAPSHOTS = 32
 
     def __init__(self, enable_gc: bool = True, history_size: int = 4096,
-                 watch_queue_size: int = 10000):
+                 watch_queue_size: int = 10000, bookmark_every: int = 0):
         self._lock = threading.RLock()
         self._watch_queue_size = watch_queue_size
         self._stores: Dict[str, _Store] = {}
@@ -144,6 +157,19 @@ class InMemoryAPIServer:
         self._history: "deque[Tuple[int, str, str, WatchEvent]]" = deque(
             maxlen=history_size
         )
+        # compaction-pressure ledger: explicit compact() calls plus events
+        # evicted by the history bound (each advances the oldest servable
+        # resume/continue point); mirrored to history_compactions_total
+        self.history_compactions = 0
+        # every N committed events, fan a BOOKMARK out to every
+        # bookmark-enabled watch so quiet streams' resume points keep up
+        # with the global RV (0 = only explicit emit_bookmarks() calls)
+        self._bookmark_every = bookmark_every
+        self._events_since_bookmark = 0
+        # paged-LIST snapshots: snapshot id -> (pinned rv, matching objects);
+        # objects are references to committed (immutable) dicts, so a
+        # snapshot costs one list of pointers, not a deep copy of the world
+        self._list_snapshots: Dict[str, Tuple[int, List[Dict[str, Any]]]] = {}
         self._enable_gc = enable_gc
         # hooks: callables invoked (event_type, resource, obj_dict) after commit
         self.hooks: List[Callable[[str, str, Dict[str, Any]], None]] = []
@@ -231,10 +257,20 @@ class InMemoryAPIServer:
         deep-copies."""
         ev = WatchEvent(ev_type, resource, obj)
         obj_ns = (obj.get("metadata") or {}).get("namespace") or "default"
+        if len(self._history) == self._history.maxlen:
+            # the bound evicts the oldest event: the compaction horizon
+            # advances exactly as etcd's compactor would move it
+            self.history_compactions += 1
+            metrics.history_compactions.inc()
         self._history.append((self._rv, resource, obj_ns, ev))
         for res, ns, w in list(self._watches):
             if (res is None or res == resource) and (ns is None or ns == obj_ns):
                 w._put(ev)
+        if self._bookmark_every > 0:
+            self._events_since_bookmark += 1
+            if self._events_since_bookmark >= self._bookmark_every:
+                self._events_since_bookmark = 0
+                self._emit_bookmarks_locked()
         for hook in list(self.hooks):
             hook(ev_type, resource, ev.object)
 
@@ -242,17 +278,67 @@ class InMemoryAPIServer:
         with self._lock:
             self._watches = [t for t in self._watches if t[2] is not watch]
 
-    def compact(self) -> None:
-        """Drop the buffered event history, like etcd compacting revisions:
-        any subsequent resume-from-resourceVersion older than the current RV
-        gets 410 Gone and must relist.  The chaos harness calls this to force
-        the informers' GoneError → relist path."""
+    def compact(self, keep_last: int = 0) -> None:
+        """Compact the buffered event history, like etcd compacting
+        revisions: any subsequent resume-from-resourceVersion older than the
+        new horizon gets 410 Gone and must relist, and paged-LIST continue
+        tokens pinned before the horizon expire (410 Expired).
+
+        ``keep_last=0`` (the default) drops everything — the chaos harness's
+        worst case.  ``keep_last=N`` keeps the newest N events, the realistic
+        etcd shape: OLD revisions die while recent resume points (e.g. a
+        just-delivered bookmark) stay servable."""
         with self._lock:
+            self.history_compactions += 1
+            metrics.history_compactions.inc()
+            if keep_last <= 0 or not self._history:
+                self._history.clear()
+                self._list_snapshots.clear()
+                return
+            kept = list(self._history)[-keep_last:]
             self._history.clear()
+            self._history.extend(kept)
+            horizon = self._history[0][0]
+            for snap_id, (rv, _) in list(self._list_snapshots.items()):
+                if rv < horizon - 1:
+                    del self._list_snapshots[snap_id]
+
+    def emit_bookmarks(self) -> int:
+        """Fan a BOOKMARK at the current RV out to every bookmark-enabled
+        watch (the periodic bookmark a real apiserver sends ~once a minute;
+        here explicit/cadence-driven so tests stay deterministic).  Returns
+        the number of streams bookmarked."""
+        with self._lock:
+            return self._emit_bookmarks_locked()
+
+    def _emit_bookmarks_locked(self) -> int:
+        mark = {"metadata": {"resourceVersion": str(self._rv)}}
+        n = 0
+        for res, _, w in list(self._watches):
+            if w.bookmarks:
+                w._put(WatchEvent(BOOKMARK, res or "", mark))
+                n += 1
+        return n
 
     def active_watch_count(self) -> int:
         with self._lock:
             return len(self._watches)
+
+    def object_count(self, resource: str) -> int:
+        """Stored-object count without the read boundary's deep copies —
+        convergence probes at 100k objects must not pay O(cluster) per poll."""
+        with self._lock:
+            return len(self._store(resource).objects)
+
+    def kill_watches(self, resource: Optional[str] = None) -> int:
+        """Abruptly terminate every active watch stream (optionally only the
+        ones subscribed to ``resource``); returns how many were killed."""
+        with self._lock:
+            victims = [w for res, _, w in self._watches
+                       if resource is None or res == resource]
+        for w in victims:
+            w.stop()
+        return len(victims)
 
     def kill_watch(self, index: int) -> bool:
         """Abruptly terminate the index-th active watch stream (mod the
@@ -322,6 +408,85 @@ class InMemoryAPIServer:
                 if match_labels(label_selector, labels):
                     out.append(copy.deepcopy(obj))
             return out
+
+    def list_page(
+        self,
+        resource: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        limit: int = 0,
+        continue_token: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Continue-token paged LIST (the K8s ``?limit=&continue=`` chunking
+        contract): returns ``{"items", "continue", "resourceVersion"}``.
+
+        The first page pins a snapshot at the current resourceVersion —
+        references to the committed (immutable) objects, so the snapshot is
+        O(pointers), and only the emitted page pays the deep copy the read
+        boundary requires.  Later pages walk the same snapshot regardless of
+        concurrent writes, exactly like an apiserver serving every chunk
+        from one etcd revision.  A token whose snapshot was compacted away
+        (explicit :meth:`compact`, snapshot-cache eviction, or the pinned RV
+        falling out of the bounded history window) raises
+        :class:`GoneError` (410 Expired) — the caller must restart the LIST.
+        ``limit <= 0`` returns everything in one page."""
+        with self._lock:
+            if continue_token:
+                return self._continue_page(resource, limit, continue_token)
+            snapshot = []
+            for (ns, _), obj in self._store(resource).objects.items():
+                if namespace and ns != namespace:
+                    continue
+                labels = (obj.get("metadata") or {}).get("labels") or {}
+                if match_labels(label_selector, labels):
+                    snapshot.append(obj)
+            rv = self._rv
+            if limit <= 0 or len(snapshot) <= limit:
+                return {
+                    "items": [copy.deepcopy(o) for o in snapshot],
+                    "continue": "",
+                    "resourceVersion": str(rv),
+                }
+            snap_id = uuid.uuid4().hex
+            while len(self._list_snapshots) >= self.MAX_LIST_SNAPSHOTS:
+                self._list_snapshots.pop(next(iter(self._list_snapshots)))
+            self._list_snapshots[snap_id] = (rv, snapshot)
+            return {
+                "items": [copy.deepcopy(o) for o in snapshot[:limit]],
+                "continue": f"{snap_id}:{limit}",
+                "resourceVersion": str(rv),
+            }
+
+    def _continue_page(self, resource: str, limit: int, token: str) -> Dict[str, Any]:
+        snap_id, _, off_s = token.partition(":")
+        try:
+            offset = int(off_s)
+        except (TypeError, ValueError):
+            raise InvalidError(f"malformed continue token {token!r}") from None
+        entry = self._list_snapshots.get(snap_id)
+        if entry is None:
+            raise GoneError(
+                f"continue token {token!r} expired (snapshot compacted away)")
+        rv, snapshot = entry
+        if self._history and rv < self._history[0][0] - 1:
+            # the pinned revision rolled out of the bounded history window:
+            # a real apiserver's etcd compacted it away
+            del self._list_snapshots[snap_id]
+            raise GoneError(
+                f"continue token {token!r} expired (snapshot rv {rv} "
+                f"predates history start {self._history[0][0]})")
+        page = snapshot[offset:offset + limit] if limit > 0 else snapshot[offset:]
+        next_offset = offset + len(page)
+        if next_offset >= len(snapshot):
+            self._list_snapshots.pop(snap_id, None)
+            next_token = ""
+        else:
+            next_token = f"{snap_id}:{next_offset}"
+        return {
+            "items": [copy.deepcopy(o) for o in page],
+            "continue": next_token,
+            "resourceVersion": str(rv),
+        }
 
     def update(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
         with self._lock:
@@ -470,6 +635,7 @@ class InMemoryAPIServer:
         send_initial: bool = False,
         namespace: Optional[str] = None,
         resource_version: Optional[str] = None,
+        allow_bookmarks: bool = False,
     ) -> Watch:
         """Subscribe to changes; ``namespace`` scopes the stream the way a
         namespaced list/watch URL scopes a real apiserver stream
@@ -479,13 +645,19 @@ class InMemoryAPIServer:
         greater are replayed before live events (atomically, so none are
         missed).  Raises GoneError when the requested rv predates the
         bounded history window, like an apiserver whose etcd compacted the
-        revision — the caller must relist."""
+        revision — the caller must relist.
+
+        ``allow_bookmarks``: opt into BOOKMARK events (cadence-driven via
+        ``bookmark_every`` or explicit :meth:`emit_bookmarks`) that advance
+        the stream's resume point without data traffic — how a quiet watch
+        stays ahead of history compaction."""
         with self._lock:
             if resource_version is not None and str(resource_version) == "0":
                 # K8s semantics: RV "0" = "any version" — serve the current
                 # state as synthetic ADDED events, then live
                 resource_version, send_initial = None, True
             w = Watch(self, maxsize=self._watch_queue_size)
+            w.bookmarks = bool(allow_bookmarks)
             # the stream's opening RV: the point the subscriber is synced to
             # BEFORE any replay — the only safe resume point to advertise
             # (last_rv advances as replayed events are queued, but queued

@@ -50,8 +50,8 @@ class RateLimitedTransport:
     """
 
     _LIMITED = frozenset(
-        {"create", "get", "list", "update", "update_status", "patch",
-         "patch_status", "delete"}
+        {"create", "get", "list", "list_page", "update", "update_status",
+         "patch", "patch_status", "delete"}
     )
 
     def __init__(self, transport, qps: float, burst: int):

@@ -350,6 +350,12 @@ class TracingTransport:
         return self._traced_call("list", resource, self._inner.list,
                                  namespace, label_selector)
 
+    def list_page(self, resource, namespace=None, label_selector=None,
+                  limit=0, continue_token=None):
+        return self._traced_call("list_page", resource, self._inner.list_page,
+                                 namespace, label_selector, limit=limit,
+                                 continue_token=continue_token)
+
     def update(self, resource, obj):
         return self._traced_call("update", resource, self._inner.update, obj)
 

@@ -123,6 +123,9 @@ class OperatorApp:
                 suppress_noop_status=opt.suppress_noop_status,
                 status_patch=opt.status_patch,
                 settle_window_s=opt.settle_window_s,
+                informer_page_size=opt.informer_page_size,
+                watch_bookmarks=opt.watch_bookmarks,
+                cache_sync_timeout_s=opt.cache_sync_timeout_s,
             ),
         )
         self.monitoring: Optional[MonitoringServer] = None

@@ -389,3 +389,36 @@ status_full_bytes = Counter(
     "full-object PUTs (the patch-vs-put payload baseline)",
     REGISTRY,
 )
+
+# API read-path series (the read-path overhaul): LIST/watch cost proportional
+# to what changed, at six-figure object counts.  Informer cold starts and
+# 410-forced relists page their LISTs (continue tokens pinned to a snapshot
+# resourceVersion), quiet watches ride periodic BOOKMARK events so their
+# resume points never fall behind compaction, and relists diff the listed
+# pages against the cache instead of rebuilding the world.
+list_pages_total = Counter(
+    "tpujob_operator_list_pages_total",
+    "LIST pages fetched by informers (paged initial syncs and relists; an "
+    "unpaged LIST counts as one page)",
+    REGISTRY,
+)
+watch_bookmarks = Counter(
+    "tpujob_operator_watch_bookmarks_total",
+    "Watch BOOKMARK events consumed by informers — each advances a stream's "
+    "resume point without any data traffic",
+    REGISTRY,
+)
+relist_objects_diffed = Counter(
+    "tpujob_operator_relist_objects_diffed_total",
+    "Objects fetched and diffed against the informer cache during LIST "
+    "reconciliations (initial syncs and relists) — the read-side traffic "
+    "a relist actually costs",
+    REGISTRY,
+)
+history_compactions = Counter(
+    "tpujob_operator_history_compactions_total",
+    "Compaction pressure on the in-memory API server's bounded watch "
+    "history: explicit compact() calls plus events evicted by the history "
+    "bound — each advances the oldest servable resume/continue point",
+    REGISTRY,
+)

@@ -59,6 +59,14 @@ class ServerOption:
     suppress_noop_status: bool = True
     status_patch: bool = True
     settle_window_s: float = 0.02
+    # API read path: continue-token paged informer LISTs (<= 0 = one
+    # unpaged LIST) and watch BOOKMARK resume points (see docs/monitoring
+    # "read QPS at scale")
+    informer_page_size: int = 500
+    watch_bookmarks: bool = True
+    # cold-start barrier budget: how long run() waits for every informer's
+    # initial LIST — six-figure object counts need minutes, not seconds
+    cache_sync_timeout_s: float = 120.0
 
 
 class _LazyVersionAction(argparse.Action):
@@ -157,6 +165,25 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                              "burst watch events on one job collapse into a "
                              "single sync scheduled this far out (<=0 "
                              "disables coalescing)")
+    parser.add_argument("--informer-page-size", type=int, default=500,
+                        dest="informer_page_size",
+                        help="LIST chunk size (?limit=&continue=) for "
+                             "informer initial syncs and relists; <=0 "
+                             "restores one unpaged LIST per relist")
+    parser.add_argument("--watch-bookmarks", dest="watch_bookmarks",
+                        action="store_true", default=True,
+                        help="request watch BOOKMARK events so quiet "
+                             "informer streams resume instead of relisting "
+                             "after history compaction (default)")
+    parser.add_argument("--no-watch-bookmarks", dest="watch_bookmarks",
+                        action="store_false",
+                        help="disable watch bookmarks (reconnects without "
+                             "recent data events degrade to relists)")
+    parser.add_argument("--cache-sync-timeout", type=float, default=120.0,
+                        dest="cache_sync_timeout_s",
+                        help="seconds to wait for the informers' initial "
+                             "LIST at cold start before failing; size to "
+                             "your object count (100k objects needs minutes)")
 
 
 def parse_options(argv: Optional[List[str]] = None) -> ServerOption:
