@@ -727,11 +727,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "(smaller = more natural compaction pressure)")
     p.add_argument("--read-churn", type=int, default=5, dest="read_churn",
                    help="read-path mode: churn/compaction/kill rounds")
+    p.add_argument("--lock-sentinel", action="store_true",
+                   help="run under the runtime lock-order sentinel "
+                        "(tpujob.analysis.lockgraph): every lock the run "
+                        "constructs records acquisition-order edges; the "
+                        "result gains a 'locks' block and the bench FAILS "
+                        "on any lock-order cycle (potential deadlock)")
     return p
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if not args.lock_sentinel:
+        return _run_cli(args, None)
+    from tpujob.analysis import lockgraph
+
+    with lockgraph.audit() as graph:
+        return _run_cli(args, graph)
+
+
+def _run_cli(args, lock_graph) -> int:
+    def _lock_verdict(result) -> int:
+        if lock_graph is None:
+            return 0
+        cycles = lock_graph.cycles()
+        result["locks"] = {**lock_graph.stats(), "cycles": len(cycles)}
+        if cycles:
+            print(f"FAIL: lock-order cycles detected: {cycles}",
+                  file=sys.stderr)
+            return 1
+        return 0
+
     if args.objects > 0:
         try:
             result = run_read_bench(
@@ -741,8 +767,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         except (TimeoutError, AssertionError, ValueError) as e:
             print(f"FAIL: {e}", file=sys.stderr)
             return 1
+        rc = _lock_verdict(result)
         print(json.dumps(result))
-        return 0
+        return rc
     try:
         result = run_bench(args.jobs, args.workers, args.threadiness, args.mode,
                            args.serial, args.create_latency, args.timeout,
@@ -756,8 +783,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (TimeoutError, AssertionError) as e:
         print(f"FAIL: {e}", file=sys.stderr)
         return 1
+    rc = _lock_verdict(result)
     print(json.dumps(result))
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

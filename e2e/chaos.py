@@ -51,6 +51,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from e2e.cluster import E2ECluster
 from e2e.kubelet import KubeletSim, PodScript
+from tpujob.analysis import lockgraph
 from tpujob.api import constants as c
 from tpujob.api.types import TPUJob
 from tpujob.controller.job_base import expectation_key
@@ -251,9 +252,12 @@ class PreemptionStorm:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "PreemptionStorm":
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="preemption-storm")
-        self._thread.start()
+        # start before publish: a concurrent stop() must never see (and
+        # join) a created-but-unstarted Thread (TPL001)
+        storm = threading.Thread(target=self._loop, daemon=True,
+                                 name="preemption-storm")
+        storm.start()
+        self._thread = storm
         return self
 
     def stop(self) -> None:
@@ -266,8 +270,8 @@ class PreemptionStorm:
         while remaining > 0 and not self._stop.wait(self.interval):
             try:
                 pods = self.clients.pods.list()
-            except Exception:
-                continue
+            except Exception:  # noqa: TPL005 - the storm rides the faulted
+                continue  # transport; a failed list is just a skipped tick
             running = sorted(
                 (p for p in pods
                  if p.status.phase == "Running"
@@ -493,6 +497,17 @@ def check_trace_invariants(
     return problems, stats
 
 
+def _lock_audit_report(seed: int) -> Dict[str, Any]:
+    """The soak's deadlock-audit verdict: raises on any lock-order cycle,
+    returns the graph stats (edges, long holds) for the report."""
+    cycles = lockgraph.GRAPH.cycles()
+    if cycles:
+        raise AssertionError(
+            f"seed {seed}: lock-order cycles detected (potential deadlock): "
+            f"{cycles}")
+    return {**lockgraph.GRAPH.stats(), "cycles": 0}
+
+
 def _soak_harness(
     seed: int,
     prefix_letter: str,
@@ -600,7 +615,26 @@ def run_soak(
     Returns a report dict; raises AssertionError listing every violated
     invariant.  The fault schedule is a pure function of ``seed`` — rerun
     with the same seed to reproduce the same injection schedule.
+
+    Runs under the lock-order sentinel: every soak doubles as a deadlock
+    audit, and a cyclic lock-acquisition order fails the run
+    (``report["locks"]``).
     """
+    with lockgraph.audit():
+        report = _run_soak_inner(seed, config, cases, storm_kills, timeout,
+                                 opt_overrides)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_soak_inner(
+    seed: int,
+    config: Optional[ChaosConfig],
+    cases: Optional[List[JobCase]],
+    storm_kills: int,
+    timeout: float,
+    opt_overrides: Optional[Dict[str, Any]],
+) -> Dict[str, Any]:
     prefix, cases, inner, chaos, admin, tracker, scripts = _soak_harness(
         seed, "s", config, cases)
     started = time.monotonic()
@@ -738,7 +772,25 @@ def run_crash_soak(
     cold restart must rebuild from durable state behind the cache-sync
     barrier and converge the full matrix without double-creating pods or
     losing restart accounting.  The kill/restart schedule is seeded.
+
+    Runs under the lock-order sentinel (see :func:`run_soak`).
     """
+    with lockgraph.audit():
+        report = _run_crash_soak_inner(seed, config, cases, kills,
+                                       storm_kills, timeout, opt_overrides)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_crash_soak_inner(
+    seed: int,
+    config: Optional[ChaosConfig],
+    cases: Optional[List[JobCase]],
+    kills: int,
+    storm_kills: int,
+    timeout: float,
+    opt_overrides: Optional[Dict[str, Any]],
+) -> Dict[str, Any]:
     prefix, cases, inner, chaos, admin, tracker, scripts = _soak_harness(
         seed, "c", config, cases)
     rng = random.Random(f"{seed}:controller-kill")
@@ -820,7 +872,24 @@ def run_failover_soak(
     noticed, and by the server-side token check when the harness resurrects
     the elector's stale belief (the paused-then-resumed race).  Invariant
     7: zero writes accepted from a fenced leader.
+
+    Runs under the lock-order sentinel (see :func:`run_soak`).
     """
+    with lockgraph.audit():
+        report = _run_failover_soak_inner(seed, config, cases, storm_kills,
+                                          timeout, opt_overrides)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_failover_soak_inner(
+    seed: int,
+    config: Optional[ChaosConfig],
+    cases: Optional[List[JobCase]],
+    storm_kills: int,
+    timeout: float,
+    opt_overrides: Optional[Dict[str, Any]],
+) -> Dict[str, Any]:
     prefix, cases, inner, chaos, admin, tracker, scripts = _soak_harness(
         seed, "f", config, cases, fence=True)
     rng = random.Random(f"{seed}:failover")
@@ -894,8 +963,8 @@ def run_failover_soak(
                     return "rejected"
                 except (NotFoundError, AlreadyExistsError):
                     return "accepted"  # reached storage: fencing failed
-                except Exception:
-                    continue  # injected chaos fault, not a fencing verdict
+                except Exception:  # noqa: TPL005 - injected chaos fault,
+                    continue  # not a fencing verdict: retry the probe
                 return "accepted"
             return "inconclusive"
 

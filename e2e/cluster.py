@@ -50,11 +50,14 @@ class E2ECluster:
         self._thread: Optional[threading.Thread] = None
 
     def __enter__(self) -> "E2ECluster":
-        self._thread = threading.Thread(
+        # start before publish: a concurrent __exit__ must never see (and
+        # join) a created-but-unstarted Thread (TPL001)
+        app_thread = threading.Thread(
             target=self.app.run, kwargs={"block": True}, daemon=True,
             name="operator-app",
         )
-        self._thread.start()
+        app_thread.start()
+        self._thread = app_thread
         deadline = time.monotonic() + 5
         while (time.monotonic() < deadline
                and not self.app.controller.job_informer.has_synced()):

@@ -66,9 +66,12 @@ class KubeletSim:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "KubeletSim":
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="kubelet-sim")
-        self._thread.start()
+        # start before publish: a concurrent stop() must never see (and
+        # join) a created-but-unstarted Thread (TPL001)
+        loop = threading.Thread(target=self._loop, daemon=True,
+                                name="kubelet-sim")
+        loop.start()
+        self._thread = loop
         return self
 
     def stop(self) -> None:
@@ -182,8 +185,8 @@ class KubeletSim:
         while not self._stop.is_set():
             try:
                 pods = self.clients.pods.list()
-            except Exception:
-                pods = []
+            except Exception:  # noqa: TPL005 - poll loop under chaos: a
+                pods = []  # failed list is an empty tick, retried next poll
             now = time.monotonic()
             for pod in pods:
                 uid = pod.metadata.uid or pod.metadata.name

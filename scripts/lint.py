@@ -1,112 +1,25 @@
 #!/usr/bin/env python
-"""Dependency-free linter for the repo: the ``linter_config.yaml`` tier of
-the reference CI, scoped to what matters without external tools.
+"""tpulint CLI — thin wrapper over the AST rule engine.
 
-Checks:
-1. every Python file byte-compiles (syntax),
-2. unused imports (the bug class the round-1 advisor actually found),
-3. tabs / trailing whitespace in Python sources.
+The checks themselves live in ``tpujob/analysis`` (``engine.py`` +
+``rules/*.py``): syntax (TPL000), unused imports (TPL100), whitespace
+(TPL101), and the repo-specific concurrency/transport invariants
+TPL001-TPL005.  See ``docs/analysis/README.md`` for the rule catalog and
+the waiver/baseline workflow.
 
-Exit 0 = clean.  ``# noqa`` on the import line suppresses check 2.
+Usage (all flags forwarded to the engine):
+
+    python scripts/lint.py                 # make lint
+    python scripts/lint.py --write-baseline  # make lint-baseline
+    python scripts/lint.py --list-rules
+    python scripts/lint.py --select TPL002,TPL003
 """
-from __future__ import annotations
-
-import ast
-import py_compile
 import sys
 from pathlib import Path
 
-ROOT = Path(__file__).resolve().parent.parent
-SCAN_DIRS = ("tpujob", "e2e", "tests", "scripts")
-TOP_FILES = ("bench.py", "bench_models.py", "__graft_entry__.py")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-
-def iter_sources():
-    for d in SCAN_DIRS:
-        yield from sorted((ROOT / d).rglob("*.py"))
-    for f in TOP_FILES:
-        p = ROOT / f
-        if p.exists():
-            yield p
-
-
-def unused_imports(path: Path, tree: ast.AST, source: str) -> list:
-    lines = source.splitlines()
-    if path.name == "__init__.py":
-        return []  # re-export surface
-
-    imported = {}  # local name -> (lineno, shown name)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                local = a.asname or a.name.partition(".")[0]
-                imported[local] = (node.lineno, a.name)
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "__future__":
-                continue  # compiler directive, not a binding
-            for a in node.names:
-                if a.name == "*":
-                    continue
-                local = a.asname or a.name
-                imported[local] = (node.lineno, a.name)
-
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            base = node
-            while isinstance(base, ast.Attribute):
-                base = base.value
-            if isinstance(base, ast.Name):
-                used.add(base.id)
-    # names referenced in __all__ strings or docstring doctests count as used
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            used.update(w for w in imported if w in node.value.split())
-
-    out = []
-    for local, (lineno, shown) in sorted(imported.items(), key=lambda kv: kv[1][0]):
-        if local in used:
-            continue
-        line = lines[lineno - 1] if lineno <= len(lines) else ""
-        if "noqa" in line:
-            continue
-        out.append((lineno, f"unused import {shown!r}"))
-    return out
-
-
-def whitespace_problems(source: str) -> list:
-    out = []
-    for i, line in enumerate(source.splitlines(), 1):
-        if "\t" in line:
-            out.append((i, "tab character"))
-        if line != line.rstrip():
-            out.append((i, "trailing whitespace"))
-    return out
-
-
-def main() -> int:
-    problems = 0
-    for path in iter_sources():
-        rel = path.relative_to(ROOT)
-        try:
-            py_compile.compile(str(path), doraise=True, cfile=None)
-        except py_compile.PyCompileError as e:
-            print(f"{rel}: syntax error: {e.msg}")
-            problems += 1
-            continue
-        source = path.read_text()
-        tree = ast.parse(source)
-        for lineno, msg in unused_imports(path, tree, source) + whitespace_problems(source):
-            print(f"{rel}:{lineno}: {msg}")
-            problems += 1
-    if problems:
-        print(f"\nlint: {problems} problem(s)")
-        return 1
-    print("lint: clean")
-    return 0
-
+from tpujob.analysis.engine import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -433,11 +433,17 @@ def test_check_invariants_flags_violations():
 def test_chaos_smoke_soak_converges_with_invariants():
     """Tier-1 smoke: the full 5-job matrix under one seeded schedule —
     API faults, watch kills, compaction, duplicates, preemption storm —
-    converges with every invariant intact in a few seconds."""
+    converges with every invariant intact in a few seconds, and the
+    lock-order sentinel (enabled for every soak) reports a cycle-free
+    acquisition graph: the soak doubles as a deadlock audit."""
     report = run_soak(seed=11, storm_kills=4, timeout=45.0)
     assert report["invariants"] == "ok"
     assert report["jobs"] == len(matrix("s11")) == 5
     assert report["api_faults"] > 0
+    # the sentinel actually watched the run (instrumented locks acquired)
+    # and found no cyclic lock order
+    assert report["locks"]["cycles"] == 0
+    assert report["locks"]["acquisitions"] > 0
 
 
 @pytest.mark.slow

@@ -1,0 +1,216 @@
+"""Lock-order sentinel: zero-overhead-when-disabled factories, edge
+recording, AB/BA cycle detection across two threads, RLock reentrancy,
+long-hold ledger, and the soak-facing stats surface."""
+import threading
+import time
+
+import pytest
+
+from tpujob.analysis import lockgraph
+from tpujob.analysis.lockgraph import LockGraph, SentinelLock, SentinelRLock
+
+
+@pytest.fixture
+def graph():
+    return LockGraph(long_hold_s=0.05)
+
+
+def _locks(graph, *names):
+    return [SentinelLock(n, graph) for n in names]
+
+
+# ---------------------------------------------------------------------------
+# factories: the deflake guard's "zero overhead when disabled" is structural
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_factories_return_plain_stdlib_locks():
+    prev = lockgraph.enable(False)
+    try:
+        lock = lockgraph.new_lock("x")
+        rlock = lockgraph.new_rlock("x")
+        # literally the stdlib primitives: the disabled path costs nothing
+        assert type(lock) is type(threading.Lock())
+        assert type(rlock) is type(threading.RLock())
+    finally:
+        lockgraph.enable(prev)
+
+
+def test_enabled_factories_return_sentinels_and_restore():
+    prev = lockgraph.enable(True)
+    try:
+        assert isinstance(lockgraph.new_lock("x"), SentinelLock)
+        assert isinstance(lockgraph.new_rlock("x"), SentinelRLock)
+    finally:
+        assert lockgraph.enable(prev) is True
+
+
+# ---------------------------------------------------------------------------
+# edge recording + cycles
+# ---------------------------------------------------------------------------
+
+
+def test_ab_ba_cycle_across_two_threads_detected(graph):
+    """The canonical deadlock shape: thread 1 takes A then B, thread 2
+    takes B then A.  Run sequentially (each order completes), the graph
+    still carries both edges — and reports the cycle a real interleaving
+    would wedge on."""
+    la, lb = _locks(graph, "A", "B")
+
+    def order_ab():
+        with la:
+            with lb:
+                pass
+
+    def order_ba():
+        with lb:
+            with la:
+                pass
+
+    t1 = threading.Thread(target=order_ab)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=order_ba)
+    t2.start()
+    t2.join()
+
+    assert graph.edges() == {("A", "B"): 1, ("B", "A"): 1}
+    assert graph.cycles() == [["A", "B"]]
+
+
+def test_consistent_order_is_cycle_free(graph):
+    la, lb, lc = _locks(graph, "A", "B", "C")
+    for _ in range(3):
+        with la:
+            with lb:
+                with lc:
+                    pass
+    assert graph.cycles() == []
+    assert graph.edges()[("A", "B")] == 3
+    assert graph.edges()[("A", "C")] == 3
+    assert graph.edges()[("B", "C")] == 3
+
+
+def test_three_node_cycle_detected(graph):
+    la, lb, lc = _locks(graph, "A", "B", "C")
+    for first, second in ((la, lb), (lb, lc), (lc, la)):
+        t = threading.Thread(target=lambda f=first, s=second: (
+            f.acquire(), s.acquire(), s.release(), f.release()))
+        t.start()
+        t.join()
+    assert graph.cycles() == [["A", "B", "C"]]
+
+
+def test_same_name_nesting_is_not_a_cycle_but_is_counted(graph):
+    """Two INSTANCES sharing a name nested by one thread: names cannot
+    express an order against themselves, so no edge/cycle is minted — but
+    the blind spot is surfaced in stats so an audit knows the class needs
+    per-instance names (the informer stores carry per-resource names for
+    exactly this reason)."""
+    s1 = SentinelLock("shared-name", graph)
+    s2 = SentinelLock("shared-name", graph)
+    with s1:
+        with s2:
+            pass
+    assert graph.edges() == {}
+    assert graph.cycles() == []
+    assert graph.stats()["same_name_nestings"] == 1
+
+
+def test_informer_stores_get_per_resource_lock_names():
+    from tpujob.kube.informers import SharedInformer
+    from tpujob.kube.memserver import InMemoryAPIServer
+
+    prev = lockgraph.enable(True)
+    try:
+        server = InMemoryAPIServer()
+        pods = SharedInformer(server, "pods")
+        jobs = SharedInformer(server, "tpujobs")
+        assert pods.store._lock.name == "informer-store-pods"
+        assert jobs.store._lock.name == "informer-store-tpujobs"
+    finally:
+        lockgraph.enable(prev)
+
+
+def test_audit_contextmanager_enables_resets_and_restores():
+    prev = lockgraph.enable(False)
+    try:
+        with lockgraph.audit() as graph:
+            assert graph is lockgraph.GRAPH
+            assert lockgraph.enabled()
+            lock = lockgraph.new_lock("audited")
+            with lock:
+                pass
+            assert graph.stats()["acquisitions"] == 1
+        assert not lockgraph.enabled()
+    finally:
+        lockgraph.enable(prev)
+
+
+def test_rlock_reentrancy_records_one_acquisition_no_self_edge(graph):
+    outer = SentinelRLock("mem", graph)
+    other = SentinelLock("other", graph)
+    with outer:
+        with outer:  # reentrant: not an order, not a second acquisition
+            with other:
+                pass
+    assert graph.stats()["acquisitions"] == 2  # mem once, other once
+    assert graph.edges() == {("mem", "other"): 1}
+    assert graph.cycles() == []
+
+
+def test_self_deadlock_on_nonreentrant_lock_reported(graph):
+    lock = SentinelLock("solo", graph)
+    assert lock.acquire()
+    # the re-acquire would wedge forever; the bounded-timeout probe records
+    # the self-deadlock before giving up
+    assert lock.acquire(True, 0.01) is False
+    lock.release()
+    assert graph.cycles() == [["solo"]]
+
+
+# ---------------------------------------------------------------------------
+# long holds + stats + reset
+# ---------------------------------------------------------------------------
+
+
+def test_long_hold_recorded_and_stats(graph):
+    lock = SentinelLock("slowpoke", graph)
+    with lock:
+        time.sleep(0.06)  # past the fixture's 50ms threshold
+    with lock:
+        pass  # fast hold: not recorded
+    holds = graph.long_holds()
+    assert len(holds) == 1 and holds[0][0] == "slowpoke"
+    stats = graph.stats()
+    assert stats["long_holds"] == 1
+    assert stats["max_hold_s"] >= 0.05
+    assert stats["acquisitions"] == 2
+
+    graph.reset()
+    assert graph.edges() == {} and graph.long_holds() == []
+    assert graph.stats()["acquisitions"] == 0
+
+
+def test_release_across_reset_is_harmless(graph):
+    lock = SentinelLock("survivor", graph)
+    lock.acquire()
+    graph.reset()
+    lock.release()  # per-thread stack survived the reset; no crash
+    assert graph.stats()["acquisitions"] == 0
+
+
+# ---------------------------------------------------------------------------
+# overhead sanity (absolute bound, deliberately generous — the <5% bench
+# claim is measured via `bench_controller --lock-sentinel`, not a CI race)
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_overhead_sane(graph):
+    lock = SentinelLock("hot", graph)
+    t0 = time.perf_counter()
+    for _ in range(20_000):
+        with lock:
+            pass
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 2.0, f"20k sentinel acquire/release took {elapsed:.3f}s"

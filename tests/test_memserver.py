@@ -7,6 +7,7 @@ from tpujob.kube.errors import (
     AlreadyExistsError,
     ConflictError,
     GoneError,
+    InvalidError,
     NotFoundError,
 )
 from tpujob.kube.memserver import (
@@ -307,6 +308,22 @@ def test_list_page_filters_and_unpaged_fallback():
     assert out["continue"] == ""  # fits in one page
     scoped = s.list_page("pods", namespace="other", limit=5)
     assert [o["metadata"]["name"] for o in scoped["items"]] == ["c"]
+
+
+def test_list_page_continue_token_is_resource_scoped():
+    """A token minted for one resource is rejected on another (a real
+    apiserver 400s it) — honoring it would hand pods back under a
+    ServiceList and mask the client bug in every in-memory test."""
+    s = InMemoryAPIServer()
+    for i in range(6):
+        s.create("pods", pod(f"p{i}"))
+    s.create("services", {"metadata": {"name": "svc"}})
+    page = s.list_page("pods", limit=2)
+    with pytest.raises(InvalidError):
+        s.list_page("services", limit=2, continue_token=page["continue"])
+    # the snapshot survives the rejected call: the pods walk continues
+    rest = s.list_page("pods", limit=2, continue_token=page["continue"])
+    assert len(rest["items"]) == 2
 
 
 def test_list_page_continue_token_expires_on_compaction():

@@ -1,0 +1,20 @@
+"""Static-analysis and runtime concurrency sentinels for the operator.
+
+Two tiers, one discipline (the invariants four of six PRs re-fixed by hand,
+now mechanically enforced):
+
+- :mod:`tpujob.analysis.engine` + :mod:`tpujob.analysis.rules` — *tpulint*,
+  the dependency-free AST rule engine behind ``make lint``: thread-publish
+  ordering (TPL001), transport-stack verb completeness (TPL002), guarded-by
+  lock discipline (TPL003), monotonic-clock duration math (TPL004),
+  swallowed exceptions (TPL005), plus the legacy syntax/import/whitespace
+  checks (TPL000/TPL100/TPL101).
+- :mod:`tpujob.analysis.lockgraph` — an opt-in runtime lock-order sentinel:
+  instrumented locks record per-thread acquisition edges into a global
+  graph; cycles (potential deadlocks) and long holds surface in the chaos
+  soaks and ``bench_controller --lock-sentinel``.
+
+This package stays import-light on purpose: the kube/controller modules
+import :mod:`tpujob.analysis.lockgraph` at module load, so nothing here may
+pull in the engine (which parses the whole repo) as a side effect.
+"""

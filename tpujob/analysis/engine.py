@@ -1,0 +1,372 @@
+"""tpulint: the repo's dependency-free, plugin-based AST rule engine.
+
+``scripts/lint.py`` (and therefore ``make lint`` / ``make ci`` / ``make
+test``) is a thin CLI over this module.  The engine:
+
+- discovers rule plugins in :mod:`tpujob.analysis.rules` (every module's
+  ``RULES`` list), each a :class:`Rule` with a stable ``TPLxxx`` id;
+- parses every repo source exactly once into a :class:`FileContext`
+  (AST + lines + ``# noqa`` map) shared by all per-file rules, plus a
+  :class:`Project` handle for cross-module rules (TPL002 reads five
+  transport layers at once);
+- suppresses findings via ``# noqa`` on the finding's line — bare ``noqa``
+  kills everything, ``# noqa: TPL003`` (or a rule's declared alias, e.g.
+  ``F401`` for TPL100) kills just that rule;
+- subtracts a committed baseline (``.tpulint-baseline.json`` at the repo
+  root) so *documented* pre-existing debt/false positives don't block CI;
+  ``--write-baseline`` (``make lint-baseline``) regenerates it.
+
+Baseline fingerprints are line-CONTENT addressed (rule id + path + hash of
+the stripped source line + occurrence index), so unrelated edits shifting
+line numbers don't invalidate them, while editing the flagged line itself
+does — the finding then resurfaces for a fresh decision.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import hashlib
+import importlib
+import json
+import pkgutil
+import re
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+SCAN_DIRS = ("tpujob", "e2e", "tests", "scripts")
+TOP_FILES = ("bench.py", "bench_models.py", "bench_controller.py", "soak.py",
+             "__graft_entry__.py")
+BASELINE_NAME = ".tpulint-baseline.json"
+
+_NOQA_RE = re.compile(
+    r"#\s*noqa(?P<codes>:\s*[A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*)?",
+    re.IGNORECASE,
+)
+_CODE_RE = re.compile(r"[A-Z]+[0-9]+", re.IGNORECASE)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+class FileContext:
+    """One parsed source file shared by every per-file rule."""
+
+    def __init__(self, root: Path, path: Path):
+        self.path = path
+        self.rel = path.relative_to(root).as_posix()
+        self.source = path.read_text()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source)  # SyntaxError propagates (TPL000)
+        self._noqa: Optional[Dict[int, Optional[frozenset]]] = None
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    def line(self, lineno: int) -> str:
+        return self.lines[lineno - 1] if 0 < lineno <= len(self.lines) else ""
+
+    @property
+    def noqa(self) -> Dict[int, Optional[frozenset]]:
+        """lineno -> None (bare noqa: everything) or the suppressed codes."""
+        if self._noqa is None:
+            out: Dict[int, Optional[frozenset]] = {}
+            for i, text in enumerate(self.lines, 1):
+                if "noqa" not in text.lower():
+                    continue  # cheap prefilter, case-folded like the regex
+                m = _NOQA_RE.search(text)
+                if not m:
+                    continue
+                codes = m.group("codes")
+                if codes is None:
+                    out[i] = None
+                else:
+                    out[i] = frozenset(
+                        c.upper() for c in _CODE_RE.findall(codes))
+            self._noqa = out
+        return self._noqa
+
+    def suppressed(self, rule_id: str, lineno: int,
+                   aliases: Sequence[str] = ()) -> bool:
+        codes = self.noqa.get(lineno, ...)
+        if codes is ...:
+            return False
+        if codes is None:
+            return True  # bare noqa
+        wanted = {rule_id.upper(), *(a.upper() for a in aliases)}
+        return bool(wanted & codes)
+
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            parents: Dict[ast.AST, ast.AST] = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    parents[child] = node
+            self._parents = parents
+        return self._parents
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``self._lock`` / ``threading.Thread`` as a dotted string, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_sources(root: Path) -> Iterator[Path]:
+    for d in SCAN_DIRS:
+        base = root / d
+        if base.is_dir():
+            yield from sorted(base.rglob("*.py"))
+    for f in TOP_FILES:
+        p = root / f
+        if p.exists():
+            yield p
+
+
+class Project:
+    """Every parsed source of one tree; the cross-module rules' handle."""
+
+    def __init__(self, root: Path, files: Optional[Iterable[Path]] = None):
+        self.root = Path(root)
+        self.syntax_errors: List[Finding] = []
+        self._contexts: Dict[str, FileContext] = {}
+        for path in (list(files) if files is not None
+                     else iter_sources(self.root)):
+            rel = path.relative_to(self.root).as_posix()
+            try:
+                self._contexts[rel] = FileContext(self.root, path)
+            except SyntaxError as e:
+                self.syntax_errors.append(Finding(
+                    "TPL000", rel, e.lineno or 1,
+                    f"syntax error: {e.msg}"))
+
+    def contexts(self) -> List[FileContext]:
+        return [self._contexts[k] for k in sorted(self._contexts)]
+
+    def context(self, rel: str) -> Optional[FileContext]:
+        return self._contexts.get(rel)
+
+
+class Rule:
+    """One lint rule.  Subclasses set the metadata and override one hook.
+
+    ``scope`` restricts per-file checks to paths starting with any of the
+    given repo-relative prefixes (empty = everywhere).  ``noqa_aliases``
+    are foreign codes accepted in ``# noqa:`` lines (e.g. ``F401``).
+    """
+
+    id: str = ""
+    name: str = ""
+    rationale: str = ""
+    scope: Tuple[str, ...] = ()
+    noqa_aliases: Tuple[str, ...] = ()
+
+    def applies(self, ctx: FileContext) -> bool:
+        return not self.scope or any(ctx.rel.startswith(p) for p in self.scope)
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+def load_rules() -> List[Rule]:
+    """Discover every plugin in tpujob.analysis.rules (modules' RULES lists),
+    sorted by rule id."""
+    from tpujob.analysis import rules as rules_pkg
+
+    out: List[Rule] = []
+    for mod_info in pkgutil.iter_modules(rules_pkg.__path__):
+        mod = importlib.import_module(
+            f"{rules_pkg.__name__}.{mod_info.name}")
+        out.extend(getattr(mod, "RULES", ()))
+    out.sort(key=lambda r: r.id)
+    ids = [r.id for r in out]
+    assert len(ids) == len(set(ids)), f"duplicate rule ids: {ids}"
+    return out
+
+
+def run_rules(project: Project,
+              rules: Optional[Sequence[Rule]] = None,
+              select: Optional[Iterable[str]] = None) -> List[Finding]:
+    """All unsuppressed findings (noqa applied, baseline NOT applied)."""
+    rules = list(rules) if rules is not None else load_rules()
+    if select is not None:
+        wanted = {s.upper() for s in select}
+        rules = [r for r in rules if r.id in wanted]
+    findings: List[Finding] = []
+    if select is None or "TPL000" in {s.upper() for s in (select or ())}:
+        findings.extend(project.syntax_errors)
+    by_alias = {r.id: r.noqa_aliases for r in rules}
+    for rule in rules:
+        for ctx in project.contexts():
+            if not rule.applies(ctx):
+                continue
+            findings.extend(rule.check_file(ctx))
+        findings.extend(rule.check_project(project))
+    out: List[Finding] = []
+    for f in findings:
+        ctx = project.context(f.path)
+        if ctx is not None and ctx.suppressed(
+                f.rule, f.line, by_alias.get(f.rule, ())):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def _fingerprints(project: Project, findings: Sequence[Finding]) -> List[str]:
+    """Line-content fingerprints, one per finding, order-aligned."""
+    occ: Dict[Tuple[str, str, str], int] = {}
+    out: List[str] = []
+    for f in findings:
+        ctx = project.context(f.path)
+        text = ctx.line(f.line).strip() if ctx is not None else ""
+        digest = hashlib.sha1(text.encode()).hexdigest()[:12]
+        key = (f.rule, f.path, digest)
+        n = occ.get(key, 0)
+        occ[key] = n + 1
+        out.append(f"{f.rule}|{f.path}|{digest}|{n}")
+    return out
+
+
+def load_baseline(path: Path) -> Dict[str, Dict[str, Any]]:
+    if not path.exists():
+        return {}
+    doc = json.loads(path.read_text())
+    return {e["fingerprint"]: e for e in doc.get("findings", [])}
+
+
+def write_baseline(path: Path, project: Project,
+                   findings: Sequence[Finding]) -> int:
+    entries = [
+        {"fingerprint": fp, "rule": f.rule, "path": f.path,
+         "message": f.message,
+         "line_at_capture": f.line}
+        for f, fp in zip(findings, _fingerprints(project, findings))
+    ]
+    doc = {
+        "_comment": (
+            "tpulint baseline: DOCUMENTED pre-existing findings only (see "
+            "docs/analysis/README.md). Regenerate with `make lint-baseline`; "
+            "fingerprints are line-content addressed so they survive line "
+            "shifts but expire when the flagged line is edited."),
+        "findings": entries,
+    }
+    path.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    return len(entries)
+
+
+def apply_baseline(project: Project, findings: Sequence[Finding],
+                   baseline: Dict[str, Dict[str, Any]]
+                   ) -> Tuple[List[Finding], int, List[str]]:
+    """(kept findings, baselined count, stale fingerprints)."""
+    fps = _fingerprints(project, findings)
+    kept: List[Finding] = []
+    used = set()
+    for f, fp in zip(findings, fps):
+        if fp in baseline:
+            used.add(fp)
+        else:
+            kept.append(f)
+    stale = sorted(set(baseline) - used)
+    return kept, len(used), stale
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpulint", description=__doc__.partition("\n")[0])
+    p.add_argument("--root", default=str(REPO_ROOT),
+                   help="tree to scan (default: the repo root)")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from current findings and exit")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the committed baseline (report everything)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = load_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.name}")
+            if r.rationale:
+                print(f"        {r.rationale}")
+        return 0
+    root = Path(args.root).resolve()
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    if args.write_baseline and select is not None:
+        # a selected run produces no findings for the unselected rules, so
+        # rewriting the baseline from it would silently drop their entries
+        print("tpulint: --write-baseline cannot be combined with --select "
+              "(it would truncate the unselected rules' baseline entries)",
+              file=sys.stderr)
+        return 2
+    project = Project(root)
+    findings = run_rules(project, rules, select)
+    baseline_path = root / BASELINE_NAME
+
+    if args.write_baseline:
+        n = write_baseline(baseline_path, project, findings)
+        print(f"tpulint: baseline written with {n} finding(s) "
+              f"-> {baseline_path.name}")
+        return 0
+
+    baselined = 0
+    stale: List[str] = []
+    if not args.no_baseline:
+        findings, baselined, stale = apply_baseline(
+            project, findings, load_baseline(baseline_path))
+        if select is not None:
+            stale = []  # unselected rules' findings are absent by construction
+    for f in findings:
+        print(f.render())
+    for fp in stale:
+        # a stale entry is an ERROR, not a note: left in place, it would
+        # silently suppress a future finding whose line content happens to
+        # match the dead fingerprint (a reintroduced regression)
+        print(f"tpulint: stale baseline entry (finding fixed? run `make "
+              f"lint-baseline` to prune): {fp}")
+    if findings or stale:
+        print(f"\ntpulint: {len(findings)} problem(s), "
+              f"{len(stale)} stale baseline entr(y/ies)"
+              + (f" ({baselined} baselined)" if baselined else ""))
+        return 1
+    suffix = f" ({baselined} baselined)" if baselined else ""
+    print(f"tpulint: clean{suffix}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

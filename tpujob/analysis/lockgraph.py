@@ -1,0 +1,343 @@
+"""Runtime lock-order sentinel: the dynamic tier of the analysis package.
+
+TPL003 proves an *annotated* attribute is only touched under its lock; it
+cannot see the ORDER different locks are taken in across threads — the
+classic AB/BA deadlock needs a runtime witness.  This module provides one:
+
+- :func:`new_lock` / :func:`new_rlock` are drop-in factories the concurrency
+  hot spots (memserver, informer stores, recorder, rate limiter, workqueue
+  proxy) use instead of ``threading.Lock()``/``RLock()``.  **Disabled**
+  (the default) they return the plain stdlib primitives — zero overhead,
+  byte-for-byte the pre-sentinel behavior.  **Enabled** (the
+  ``TPUJOB_LOCK_SENTINEL=1`` env flag, or :func:`enable` from a harness)
+  they return instrumented wrappers that record, per thread, which locks
+  were held when each lock was acquired.
+- every ``(held -> acquired)`` pair becomes an edge in the process-global
+  :data:`GRAPH`.  A cycle in that graph is a potential deadlock: two
+  threads that ever interleave the cyclic orders wedge forever.
+- holds longer than ``TPUJOB_LOCK_HOLD_WARN_S`` (default 100 ms) are kept
+  in a bounded ring — the "who stalled the API server" ledger.
+
+The chaos soaks (``e2e/chaos.py``) enable the sentinel for the duration of
+every run and assert a cycle-free graph afterwards, so each soak doubles as
+a race/deadlock audit; ``bench_controller --lock-sentinel`` does the same
+for the throughput benches.
+
+Locks are named per call site (usually per class); edges connect *names*,
+so two instances of the same class share a node — lock-order discipline is
+a property of the code, not of object identity.  Reentrant acquisition of
+an :func:`new_rlock` lock by its owner is not an edge (it cannot deadlock);
+re-acquiring a non-reentrant :func:`new_lock` lock on the same instance is
+reported as an immediate self-deadlock *before* the thread wedges.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+_ENV_FLAG = "TPUJOB_LOCK_SENTINEL"
+
+
+def _hold_warn_s() -> float:
+    """The long-hold threshold; a malformed env value (e.g. "100ms") falls
+    back to the default — a debug tuning knob must never be able to crash
+    the operator at import time."""
+    raw = os.environ.get("TPUJOB_LOCK_HOLD_WARN_S", "")
+    try:
+        return float(raw) if raw else 0.1
+    except ValueError:
+        return 0.1
+
+
+HOLD_WARN_S = _hold_warn_s()
+
+_enabled = os.environ.get(_ENV_FLAG, "") not in ("", "0", "false", "no")
+
+
+def enabled() -> bool:
+    """Whether factories currently mint instrumented locks."""
+    return _enabled
+
+
+def enable(on: bool = True) -> bool:
+    """Flip the sentinel for locks created FROM NOW ON; returns the previous
+    state so a harness can restore it.  Already-created locks keep whatever
+    flavor they were born with (a plain Lock cannot be retrofitted)."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(on)
+    return prev
+
+
+class LockGraph:
+    """Process-global acquisition-order graph fed by the sentinel locks."""
+
+    def __init__(self, long_hold_s: float = HOLD_WARN_S):
+        # the graph's own mutex is a PLAIN lock and never instrumented:
+        # instrumenting it would recurse into itself
+        self._mu = threading.Lock()
+        self.long_hold_s = long_hold_s
+        # (held name, acquired name) -> occurrence count
+        self._edges: Dict[Tuple[str, str], int] = {}
+        self._acquisitions: Dict[str, int] = {}  # per lock name
+        self._long_holds: "deque[Tuple[str, float]]" = deque(maxlen=256)
+        self._self_deadlocks: List[str] = []
+        # cross-INSTANCE nesting of two locks sharing one name: names
+        # cannot express an order against themselves, so such pairs are a
+        # blind spot of the cycle check — surfaced in stats() so an audit
+        # knows the class needs per-instance names (like the per-resource
+        # informer stores) before its AB/BA orders become checkable
+        self._same_name_nestings: Dict[str, int] = {}
+        self._tls = threading.local()
+
+    # -- per-thread hold stack ----------------------------------------------
+
+    def _stack(self) -> List[Tuple[str, int, float]]:
+        """This thread's held locks: (name, instance id, acquire stamp)."""
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    def holds_instance(self, instance_id: int) -> bool:
+        return any(i == instance_id for _, i, _ in self._stack())
+
+    def note_self_deadlock(self, name: str) -> None:
+        """A non-reentrant lock re-acquired by its holder: report before the
+        thread wedges (the acquire below will block forever regardless)."""
+        with self._mu:
+            self._self_deadlocks.append(name)
+
+    def note_acquired(self, name: str, instance_id: int) -> None:
+        stack = self._stack()
+        with self._mu:
+            for held_name, held_id, _ in stack:
+                if held_name != name:
+                    edge = (held_name, name)
+                    self._edges[edge] = self._edges.get(edge, 0) + 1
+                elif held_id != instance_id:
+                    # same name, different instance: unorderable by name —
+                    # count the blind spot instead of minting a false cycle
+                    self._same_name_nestings[name] = (
+                        self._same_name_nestings.get(name, 0) + 1)
+            self._acquisitions[name] = self._acquisitions.get(name, 0) + 1
+        stack.append((name, instance_id, time.monotonic()))
+
+    def note_released(self, name: str, instance_id: int) -> None:
+        stack = self._stack()
+        for idx in range(len(stack) - 1, -1, -1):
+            if stack[idx][1] == instance_id:
+                _, _, t0 = stack.pop(idx)
+                held = time.monotonic() - t0
+                if held >= self.long_hold_s:
+                    with self._mu:
+                        self._long_holds.append((name, held))
+                return
+        # release without a recorded acquire (lock created pre-reset or
+        # acquired on another thread): nothing to unwind
+
+    # -- introspection -------------------------------------------------------
+
+    def edges(self) -> Dict[Tuple[str, str], int]:
+        with self._mu:
+            return dict(self._edges)
+
+    def long_holds(self) -> List[Tuple[str, float]]:
+        with self._mu:
+            return list(self._long_holds)
+
+    def cycles(self) -> List[List[str]]:
+        """Every lock-order cycle as a sorted node list: the strongly
+        connected components of the edge graph with more than one node,
+        plus any recorded same-instance self-deadlocks.  Deterministic
+        (nodes visited in sorted order)."""
+        with self._mu:
+            adj: Dict[str, List[str]] = {}
+            for (a, b) in self._edges:
+                adj.setdefault(a, []).append(b)
+                adj.setdefault(b, [])
+            self_dead = sorted(set(self._self_deadlocks))
+        for outs in adj.values():
+            outs.sort()
+
+        # Tarjan SCC, iterative (the graph is tiny but recursion-free keeps
+        # it safe to call from instrumented code paths)
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        for root in sorted(adj):
+            if root in index:
+                continue
+            work: List[Tuple[str, int]] = [(root, 0)]
+            while work:
+                node, child_i = work[-1]
+                if child_i == 0:
+                    index[node] = low[node] = counter[0]
+                    counter[0] += 1
+                    stack.append(node)
+                    on_stack.add(node)
+                advanced = False
+                for i in range(child_i, len(adj[node])):
+                    nxt = adj[node][i]
+                    if nxt not in index:
+                        work[-1] = (node, i + 1)
+                        work.append((nxt, 0))
+                        advanced = True
+                        break
+                    if nxt in on_stack:
+                        low[node] = min(low[node], index[nxt])
+                if advanced:
+                    continue
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        top = stack.pop()
+                        on_stack.discard(top)
+                        comp.append(top)
+                        if top == node:
+                            break
+                    if len(comp) > 1:
+                        sccs.append(sorted(comp))
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+        sccs.extend([name] for name in self_dead)
+        return sorted(sccs)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._mu:
+            return {
+                "locks": len(self._acquisitions),
+                "acquisitions": sum(self._acquisitions.values()),
+                "edges": len(self._edges),
+                "long_holds": len(self._long_holds),
+                "max_hold_s": round(
+                    max((h for _, h in self._long_holds), default=0.0), 6),
+                "same_name_nestings": sum(self._same_name_nestings.values()),
+            }
+
+    def reset(self) -> None:
+        """Drop every recorded edge/hold (per-thread stacks survive so a
+        lock held ACROSS the reset still releases cleanly)."""
+        with self._mu:
+            self._edges.clear()
+            self._acquisitions.clear()
+            self._long_holds.clear()
+            self._self_deadlocks.clear()
+            self._same_name_nestings.clear()
+
+
+GRAPH = LockGraph()
+
+
+class SentinelLock:
+    """Instrumented ``threading.Lock`` recording acquisition-order edges."""
+
+    __slots__ = ("name", "_lock", "graph")
+
+    def __init__(self, name: str, graph: Optional[LockGraph] = None):
+        self.name = name
+        self._lock = threading.Lock()
+        self.graph = graph if graph is not None else GRAPH
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        graph = self.graph
+        if blocking and graph.holds_instance(id(self)):
+            # would wedge this thread forever: make the deadlock visible
+            # in the graph before the acquire below blocks
+            graph.note_self_deadlock(self.name)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            graph.note_acquired(self.name, id(self))
+        return ok
+
+    def release(self) -> None:
+        self.graph.note_released(self.name, id(self))
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.release()
+
+
+class SentinelRLock:
+    """Instrumented ``threading.RLock``: only the OUTERMOST acquire/release
+    of each thread touches the graph — reentrant nesting is not an order."""
+
+    __slots__ = ("name", "_lock", "_tls", "graph")
+
+    def __init__(self, name: str, graph: Optional[LockGraph] = None):
+        self.name = name
+        self._lock = threading.RLock()
+        self._tls = threading.local()
+        self.graph = graph if graph is not None else GRAPH
+
+    def _depth(self) -> int:
+        return getattr(self._tls, "depth", 0)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            depth = self._depth()
+            self._tls.depth = depth + 1
+            if depth == 0:
+                self.graph.note_acquired(self.name, id(self))
+        return ok
+
+    def release(self) -> None:
+        depth = self._depth() - 1
+        self._tls.depth = depth
+        if depth == 0:
+            self.graph.note_released(self.name, id(self))
+        self._lock.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.release()
+
+
+def new_lock(name: str) -> "threading.Lock | SentinelLock":
+    """A mutex for ``name``: plain ``threading.Lock`` when the sentinel is
+    off (zero overhead), an edge-recording :class:`SentinelLock` when on."""
+    if _enabled:
+        return SentinelLock(name)
+    return threading.Lock()
+
+
+def new_rlock(name: str) -> "threading.RLock | SentinelRLock":
+    """Reentrant variant of :func:`new_lock`."""
+    if _enabled:
+        return SentinelRLock(name)
+    return threading.RLock()
+
+
+@contextlib.contextmanager
+def audit() -> Iterator[LockGraph]:
+    """One scoped deadlock audit: enable the sentinel, reset the global
+    graph, yield it, and restore the previous enable state on exit — the
+    shared shell of every soak mode and ``bench_controller
+    --lock-sentinel``.  The caller decides what to do with the graph
+    (assert cycle-free, attach stats to a report)."""
+    prev = enable(True)
+    GRAPH.reset()
+    try:
+        yield GRAPH
+    finally:
+        enable(prev)

@@ -1,0 +1,6 @@
+"""tpulint rule plugins.
+
+Every module in this package that defines a ``RULES`` list is auto-loaded
+by :func:`tpujob.analysis.engine.load_rules`.  Adding a rule = dropping a
+module here with a ``Rule`` subclass; no registry edits.
+"""

@@ -1,0 +1,56 @@
+"""TPL004: wall-clock arithmetic where the monotonic clock is required.
+
+``time.time()`` jumps — NTP steps, VM migration, leap smearing.  Any
+duration or deadline computed from it can fire early, late, or never;
+``time.monotonic()`` is the duration clock (the repo already uses it in
+~27 places).  This rule flags ``time.time()`` appearing as an operand of
+arithmetic (``BinOp``) or a comparison — the shapes deadlines are built
+from — across the control-plane packages.
+
+NOT flagged: bare ``time.time()`` reads stored or formatted as wall-clock
+*timestamps* (trace span starts, RFC3339 lease times, flight-recorder
+entries) — timestamps are the one legitimate wall-clock use.
+
+Known-legitimate arithmetic — comparing wall-clock NOW against a
+*persisted wall-clock timestamp* (job ``startTime`` vs
+``activeDeadlineSeconds``, ``completionTime`` + TTL): monotonic cannot
+measure against a wall timestamp another process wrote, so those sites are
+carried in the committed baseline with this rationale (see
+docs/analysis/README.md) rather than silenced inline.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Tuple
+
+from tpujob.analysis.engine import FileContext, Finding, Rule, dotted_name
+
+
+class WallClockDurationRule(Rule):
+    id = "TPL004"
+    name = "wall-clock-for-durations"
+    rationale = ("time.time() arithmetic makes deadlines NTP-step "
+                 "sensitive; durations belong on time.monotonic()")
+    scope = ("tpujob/controller/", "tpujob/kube/", "tpujob/server/",
+             "tpujob/obs/")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        parents = ctx.parents()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or node.args or node.keywords:
+                continue
+            if dotted_name(node.func) != "time.time":
+                continue
+            parent = parents.get(node)
+            if isinstance(parent, (ast.BinOp, ast.Compare, ast.UnaryOp)):
+                out.append(Finding(
+                    self.id, ctx.rel, node.lineno,
+                    "time.time() used in arithmetic/comparison — use "
+                    "time.monotonic() for durations and deadlines "
+                    "(wall-vs-persisted-timestamp math belongs in the "
+                    "baseline with a rationale)"))
+        return out
+
+
+RULES: Tuple[Rule, ...] = (WallClockDurationRule(),)

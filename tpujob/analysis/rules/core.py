@@ -1,0 +1,83 @@
+"""The legacy ``scripts/lint.py`` checks, ported as engine rules.
+
+TPL100 unused-import (the bug class the round-1 advisor actually found) and
+TPL101 whitespace hygiene.  Syntax errors are engine-level (TPL000): a file
+that does not parse produces no AST for ANY rule, so the engine reports it
+while building the project.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Tuple
+
+from tpujob.analysis.engine import FileContext, Finding, Rule
+
+
+class UnusedImportRule(Rule):
+    id = "TPL100"
+    name = "unused-import"
+    rationale = ("an import nobody references is dead weight and hides "
+                 "real dependency drift; __init__.py re-export surfaces "
+                 "are exempt")
+    noqa_aliases = ("F401",)  # ruff/flake8 spelling, used across the repo
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.path.name == "__init__.py":
+            return []  # re-export surface
+        imported = {}  # local name -> (lineno, shown name)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.partition(".")[0]
+                    imported[local] = (node.lineno, a.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue  # compiler directive, not a binding
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    imported[local] = (node.lineno, a.name)
+
+        used = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                base = node
+                while isinstance(base, ast.Attribute):
+                    base = base.value
+                if isinstance(base, ast.Name):
+                    used.add(base.id)
+        # names referenced in __all__ strings or docstring doctests count
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                used.update(w for w in imported if w in node.value.split())
+
+        out: List[Finding] = []
+        for local, (lineno, shown) in sorted(
+                imported.items(), key=lambda kv: kv[1][0]):
+            if local in used:
+                continue
+            out.append(Finding(self.id, ctx.rel, lineno,
+                               f"unused import {shown!r}"))
+        return out
+
+
+class WhitespaceRule(Rule):
+    id = "TPL101"
+    name = "whitespace"
+    rationale = "tabs and trailing whitespace churn diffs and reviews"
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for i, line in enumerate(ctx.lines, 1):
+            if "\t" in line:
+                out.append(Finding(self.id, ctx.rel, i, "tab character"))
+            if line != line.rstrip():
+                out.append(Finding(self.id, ctx.rel, i,
+                                   "trailing whitespace"))
+        return out
+
+
+RULES: Tuple[Rule, ...] = (UnusedImportRule(), WhitespaceRule())

@@ -1,0 +1,101 @@
+"""TPL005: swallowed broad exceptions.
+
+A bare ``except:`` or ``except Exception/BaseException:`` whose body
+neither re-raises, logs, nor even touches the bound exception is a
+diagnostic black hole: the failure it ate surfaces later as an unrelated
+symptom (a silently dead events pipeline, a watch that never heals).  The
+repo's own history funded this rule — PR 2 counted the EventRecorder's
+swallowed create failure, PR 3 formalized the "observers are best-effort"
+contract with log.exception at every sink.
+
+A handler passes when its body (nested defs excluded) contains any of:
+
+- a ``raise`` (re-raise or translate);
+- a logging-ish call — attribute call named ``debug/info/warning/warn/
+  error/exception/critical/fatal/log/print_exc``;
+- a reference to the bound exception name (``except Exception as e`` with
+  ``e`` consumed — stashed on a ledger, appended to an error list, ...).
+
+Intentional swallows — the observer contract (sinks/formatters/teardown
+paths that must NEVER raise into the reconcile or logging path) — carry an
+explicit inline waiver: ``# noqa: TPL005`` on the ``except`` line, next to
+the comment explaining the contract.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Tuple
+
+from tpujob.analysis.engine import FileContext, Finding, Rule
+
+_BROAD = {"Exception", "BaseException"}
+_LOGGING_ATTRS = {"debug", "info", "warning", "warn", "error", "exception",
+                  "critical", "fatal", "log", "print_exc"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in _BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _BROAD:
+            return True
+    return False
+
+
+def _body_nodes(handler: ast.ExceptHandler):
+    stack: List[ast.AST] = list(handler.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _handled(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name
+    for node in _body_nodes(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _LOGGING_ATTRS):
+            return True
+        if bound and isinstance(node, ast.Name) and node.id == bound:
+            return True
+    return False
+
+
+class SwallowedExceptionRule(Rule):
+    id = "TPL005"
+    name = "swallowed-exception"
+    rationale = ("a broad except that neither logs, re-raises, nor uses the "
+                 "exception hides the failure until it resurfaces as an "
+                 "unrelated symptom; intentional observer-contract swallows "
+                 "carry an inline `# noqa: TPL005` waiver")
+    scope = ("tpujob/", "e2e/")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _handled(node):
+                continue
+            out.append(Finding(
+                self.id, ctx.rel, node.lineno,
+                "broad except swallows the exception (no raise/log/use); "
+                "log it, narrow it, or waive the observer contract with "
+                "`# noqa: TPL005`"))
+        return out
+
+
+RULES: Tuple[Rule, ...] = (SwallowedExceptionRule(),)

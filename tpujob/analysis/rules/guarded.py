@@ -1,0 +1,150 @@
+"""TPL003: guarded-by discipline for lock-protected attributes.
+
+Shared mutable state in this codebase is documented at its birth site::
+
+    self._events = deque(maxlen=tail)  # guarded by self._lock
+
+This rule makes the comment enforceable: within the declaring class, every
+read/write of an annotated attribute must sit lexically inside a
+``with self._lock:`` block naming the SAME lock.  This is the bug class of
+PR 2's backoff-map rebind race and PR 3's timeline-seq fix — shared state
+touched outside its lock, found by review instead of tooling.
+
+Escapes (all greppable, all reviewed):
+
+- ``__init__`` / ``__new__`` bodies are exempt: construction
+  happens-before any concurrent access;
+- a method named ``*_locked`` asserts "caller holds the lock"
+  (``_emit_bookmarks_locked`` convention);
+- a method whose ``def`` line carries ``# caller holds self._lock``
+  asserts the same for helpers that predate the naming convention;
+- ``# noqa: TPL003`` on the access line for individually-justified
+  benign races (e.g. a double-checked fast-path read).
+
+Lexical scoping is deliberate: a nested function defined inside a ``with``
+block does NOT inherit the lock (it runs later, on whatever thread calls
+it), so the checker resets held-locks when descending into nested defs.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tpujob.analysis.engine import FileContext, Finding, Rule, dotted_name
+
+_GUARDED_RE = re.compile(r"#\s*guarded by\s+(self\.[A-Za-z_][A-Za-z0-9_.]*)")
+_CALLER_HOLDS_RE = re.compile(
+    r"#\s*caller holds\s+(self\.[A-Za-z_][A-Za-z0-9_.]*)")
+
+
+def _annotations(cls: ast.ClassDef, ctx: FileContext) -> Dict[str, str]:
+    """attr name -> lock expr, from ``self.X = ...  # guarded by self.L``
+    comments anchored at real assignment nodes (docstring text can't
+    accidentally annotate)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        m = _GUARDED_RE.search(ctx.line(node.lineno))
+        if not m:
+            continue
+        for t in targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out[t.attr] = m.group(1)
+    return out
+
+
+def _caller_holds(func: ast.AST, ctx: FileContext) -> Set[str]:
+    """Lock exprs a ``# caller holds self.X`` waiver on the def line (or
+    the line above it) grants to the whole method body."""
+    out: Set[str] = set()
+    for lineno in (func.lineno, func.lineno - 1):
+        m = _CALLER_HOLDS_RE.search(ctx.line(lineno))
+        if m:
+            out.add(m.group(1))
+    return out
+
+
+class _MethodCheck:
+    def __init__(self, rel: str, annotated: Dict[str, str],
+                 assumed_held: Set[str]):
+        self.rel = rel
+        self.annotated = annotated
+        self.assumed = assumed_held
+        self.findings: List[Finding] = []
+
+    def run(self, func: ast.AST) -> None:
+        for stmt in getattr(func, "body", []):
+            self._walk(stmt, set(self.assumed))
+
+    def _walk(self, node: ast.AST, held: Set[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a nested function runs LATER: it does not inherit the lock
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, set(self.assumed))
+            return
+        if isinstance(node, ast.With):
+            for item in node.items:
+                expr = dotted_name(item.context_expr)
+                if expr is not None and expr in self.annotated.values():
+                    held = held | {expr}
+            for child in node.body:
+                self._walk(child, held)
+            for item in node.items:  # the lock exprs themselves
+                self._walk(item.context_expr, held)
+            return
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in self.annotated):
+            lock = self.annotated[node.attr]
+            if lock not in held:
+                access = ("write" if isinstance(node.ctx, (ast.Store, ast.Del))
+                          else "read")
+                self.findings.append(Finding(
+                    "TPL003", self.rel, node.lineno,
+                    f"{access} of self.{node.attr} outside `with {lock}:` "
+                    f"(annotated '# guarded by {lock}')"))
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held)
+
+
+class GuardedByRule(Rule):
+    id = "TPL003"
+    name = "guarded-by"
+    rationale = ("shared state touched outside its documented lock — the "
+                 "PR 2 backoff-map rebind and PR 3 timeline-seq race class")
+    scope = ("tpujob/",)
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for cls in [n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            annotated = _annotations(cls, ctx)
+            if not annotated:
+                continue
+            for func in cls.body:
+                if not isinstance(func, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if func.name in ("__init__", "__new__"):
+                    continue  # construction happens-before sharing
+                if func.name.endswith("_locked"):
+                    continue  # caller-holds naming convention
+                assumed = _caller_holds(func, ctx)
+                check = _MethodCheck(ctx.rel, annotated, assumed)
+                check.run(func)
+                out.extend(check.findings)
+        return out
+
+
+RULES: Tuple[Rule, ...] = (GuardedByRule(),)

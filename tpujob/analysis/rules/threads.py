@@ -1,0 +1,129 @@
+"""TPL001: a ``threading.Thread`` must not be published before ``start()``.
+
+The bug class fixed twice already (PR 4's ``LeaderElector.leading_thread``,
+PR 5's informer run loop): assigning a freshly constructed Thread to an
+attribute makes it visible to other threads — a concurrent ``stop()`` /
+``hard_kill()`` can then ``join()`` a created-but-unstarted Thread, which
+raises ``RuntimeError``.  The required shape is::
+
+    t = threading.Thread(...)
+    t.start()
+    self._thread = t   # published only once join() is legal
+
+Flagged, inside one function scope:
+
+- ``self.attr = Thread(...)`` followed (lexically) by ``<attr>.start()``
+  — the start-here pattern with the publish on the wrong side;
+- ``self.attr = t`` where local ``t`` holds a Thread that has not yet seen
+  ``t.start()``.
+
+A Thread assigned to an attribute and never started in the same scope is
+NOT flagged (construct-here/start-elsewhere is a different contract).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from tpujob.analysis.engine import FileContext, Finding, Rule, dotted_name
+
+
+def _is_thread_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    # exactly Thread / threading.Thread — ThreadPoolExecutor etc. are not
+    # joinable thread handles
+    return name is not None and (name == "Thread" or name.endswith(".Thread"))
+
+
+class _ScopeScan:
+    """Lexical single-pass over one function body (nested defs get their
+    own scan — a closure runs later, ordering guarantees do not cross)."""
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.findings: List[Finding] = []
+        # local name -> started?  (only names bound to a Thread call)
+        self.locals: Dict[str, bool] = {}
+        # published attr -> publish lineno, pending confirmation by .start()
+        self.pending_attr: Dict[str, int] = {}
+
+    def scan(self, body: List[ast.stmt]) -> None:
+        events: List[ast.AST] = []
+        for stmt in body:
+            events.extend(self._walk_no_nested_defs(stmt))
+        # lexical order: publish-vs-start is a statement-ordering property
+        events.sort(key=lambda n: (n.lineno, n.col_offset))
+        for node in events:
+            self._visit(node)
+
+    @staticmethod
+    def _walk_no_nested_defs(root: ast.stmt) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        stack: List[ast.AST] = [root]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # nested scope: runs later, gets its own scan
+            if isinstance(node, (ast.Assign, ast.Call)):
+                out.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def _visit(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name) and _is_thread_call(node.value):
+                self.locals[target.id] = False  # born unstarted
+            elif isinstance(target, ast.Attribute):
+                attr = dotted_name(target)
+                if attr is None:
+                    return
+                if _is_thread_call(node.value):
+                    # publish of a just-constructed thread: a finding iff a
+                    # later .start() in this scope proves start-here intent
+                    self.pending_attr[attr] = node.lineno
+                elif (isinstance(node.value, ast.Name)
+                      and self.locals.get(node.value.id) is False):
+                    self.findings.append(Finding(
+                        "TPL001", self.rel, node.lineno,
+                        f"thread published to {attr} before start(): "
+                        f"local {node.value.id!r} is not started yet "
+                        "(start first, then publish)"))
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "start":
+                owner = dotted_name(func.value)
+                if owner is None:
+                    return
+                if owner in self.locals:
+                    self.locals[owner] = True
+                elif owner in self.pending_attr:
+                    self.findings.append(Finding(
+                        "TPL001", self.rel, self.pending_attr.pop(owner),
+                        f"thread published to {owner} before start(): "
+                        f"{owner}.start() happens after the attribute "
+                        "assignment (start a local first, then publish)"))
+
+
+class ThreadPublishRule(Rule):
+    id = "TPL001"
+    name = "thread-publish-before-start"
+    rationale = ("a published-but-unstarted Thread lets a concurrent "
+                 "stop()/hard_kill() join() it -> RuntimeError (fixed in "
+                 "PR 4's elector and again in PR 5's informer loop)")
+    scope = ("tpujob/", "e2e/")
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan = _ScopeScan(ctx.rel)
+                scan.scan(node.body)
+                out.extend(scan.findings)
+        return out
+
+
+RULES: Tuple[Rule, ...] = (ThreadPublishRule(),)

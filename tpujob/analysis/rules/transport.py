@@ -1,0 +1,187 @@
+"""TPL002: transport-stack verb completeness (cross-module analysis).
+
+Every API verb on the transport protocol must be handled by EVERY layer of
+the transport stack — the bug class that needed late fixes twice
+(``patch_status`` missing wrapper coverage in PR 5, ``list_page`` needing
+late KillSwitch/RateLimited coverage in PR 6).  A verb added to one layer
+and missing from another silently changes semantics: a severed transport
+that still serves it, a rate limiter that doesn't charge it, a fence that
+doesn't reject it, a chaos schedule that never faults it.
+
+The verb UNIVERSE is computed, not hardcoded: the union of every layer's
+handled verbs plus every ``self.server.<verb>()`` call the typed clients
+(``tpujob/kube/client.py``) make, filtered by the verb grammar
+``(create|get|list|update|patch|delete|watch)(_suffix)*``.  Adding a new
+verb anywhere grows the universe and flags every other layer until it is
+handled (or exempted here, with a rationale).
+
+Layers and how "handled" is read off their AST:
+
+- ``InMemoryAPIServer`` / ``KubeApiTransport`` / ``KillSwitchTransport`` /
+  ``FencedTransport`` / ``TracingTransport`` / ``FaultInjectingAPIServer``
+  — an explicitly defined method (``__getattr__`` passthrough does NOT
+  count: KillSwitch must sever it, Fenced must classify it, Tracing must
+  span it, chaos must schedule it);
+- ``RateLimitedTransport`` — membership in its ``_LIMITED`` frozenset;
+- chaos ``MUTATING_VERBS`` — the tuple must equal the universe minus the
+  read verbs (``READ_VERBS`` below is the rule's read/mutate
+  classification: a brand-new verb must be added either there, with
+  review, or to ``MUTATING_VERBS``).
+
+Documented exemptions: ``watch`` opens a stream — client-go exempts
+long-running requests from rate limiting, and the REST transports span
+watch traffic inside the stream instead of around the open.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from tpujob.analysis.engine import FileContext, Finding, Project, Rule
+
+VERB_RE = re.compile(r"^(create|get|list|update|patch|delete|watch)(_[a-z0-9]+)*$")
+
+# the rule's read/mutate classification; a new verb missing from both this
+# set and chaos MUTATING_VERBS is reported until a human classifies it
+READ_VERBS: FrozenSet[str] = frozenset({"get", "list", "list_page", "watch"})
+
+# (module path, class name, extraction kind, exempt verbs)
+LAYERS: Tuple[Tuple[str, str, str, FrozenSet[str]], ...] = (
+    ("tpujob/kube/memserver.py", "InMemoryAPIServer", "methods", frozenset()),
+    ("tpujob/kube/kubetransport.py", "KubeApiTransport", "methods", frozenset()),
+    ("tpujob/kube/fencing.py", "KillSwitchTransport", "methods", frozenset()),
+    ("tpujob/kube/fencing.py", "FencedTransport", "methods", frozenset()),
+    # watches stream outside the token bucket (client-go exempts
+    # long-running requests) and outside the per-call api span
+    ("tpujob/kube/ratelimit.py", "RateLimitedTransport", "limited", frozenset({"watch"})),
+    ("tpujob/obs/trace.py", "TracingTransport", "methods", frozenset({"watch"})),
+    ("tpujob/kube/chaos.py", "FaultInjectingAPIServer", "methods", frozenset()),
+)
+CLIENT_MODULE = "tpujob/kube/client.py"
+CHAOS_MODULE = "tpujob/kube/chaos.py"
+
+
+def _find_class(ctx: FileContext, name: str) -> Optional[ast.ClassDef]:
+    for node in ctx.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def _verb_methods(cls: ast.ClassDef) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if VERB_RE.match(node.name):
+                out[node.name] = node.lineno
+    return out
+
+
+def _limited_set(cls: ast.ClassDef) -> Tuple[Set[str], int]:
+    """The string constants of the class's ``_LIMITED`` assignment."""
+    for node in cls.body:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target] if isinstance(node, ast.AnnAssign)
+                   else [])
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "_LIMITED":
+                verbs = {c.value for c in ast.walk(node)
+                         if isinstance(c, ast.Constant)
+                         and isinstance(c.value, str)}
+                return verbs, node.lineno
+    return set(), cls.lineno
+
+
+def _module_tuple(ctx: FileContext, name: str) -> Tuple[Set[str], int]:
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    verbs = {c.value for c in ast.walk(node.value)
+                             if isinstance(c, ast.Constant)
+                             and isinstance(c.value, str)}
+                    return verbs, node.lineno
+    return set(), 1
+
+
+def _client_verbs(ctx: FileContext) -> Set[str]:
+    """Every ``self.server.<verb>(...)`` / ``<x>.server.<verb>(...)`` call
+    the typed clients make — the protocol as actually consumed."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Attribute)
+                and func.value.attr == "server"
+                and VERB_RE.match(func.attr)):
+            out.add(func.attr)
+    return out
+
+
+class TransportCompletenessRule(Rule):
+    id = "TPL002"
+    name = "transport-stack-completeness"
+    rationale = ("a verb handled by some wrapper layers but not others "
+                 "silently bypasses severing/fencing/rate limiting/tracing/"
+                 "chaos (PR 5 patch_status, PR 6 list_page)")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        layers: List[Tuple[str, str, Set[str], FrozenSet[str], int]] = []
+        missing_modules = 0
+        for rel, cls_name, kind, exempt in LAYERS:
+            ctx = project.context(rel)
+            if ctx is None:
+                missing_modules += 1
+                continue
+            cls = _find_class(ctx, cls_name)
+            if cls is None:
+                yield Finding(self.id, rel, 1,
+                              f"transport layer class {cls_name} not found")
+                continue
+            if kind == "limited":
+                verbs, line = _limited_set(cls)
+            else:
+                methods = _verb_methods(cls)
+                verbs, line = set(methods), cls.lineno
+            layers.append((rel, cls_name, verbs, exempt, line))
+        if missing_modules == len(LAYERS):
+            return  # not this tree (fixture dirs, partial checkouts)
+
+        universe: Set[str] = set()
+        for _, _, verbs, _, _ in layers:
+            universe |= verbs
+        client_ctx = project.context(CLIENT_MODULE)
+        if client_ctx is not None:
+            universe |= _client_verbs(client_ctx)
+        chaos_ctx = project.context(CHAOS_MODULE)
+        mutating: Set[str] = set()
+        mutating_line = 1
+        if chaos_ctx is not None:
+            mutating, mutating_line = _module_tuple(chaos_ctx, "MUTATING_VERBS")
+            universe |= mutating
+
+        for rel, cls_name, verbs, exempt, line in layers:
+            for verb in sorted(universe - verbs - exempt):
+                yield Finding(
+                    self.id, rel, line,
+                    f"{cls_name} does not handle transport verb {verb!r} "
+                    f"(universe: {', '.join(sorted(universe))})")
+
+        if chaos_ctx is not None:
+            expected_mutating = universe - READ_VERBS
+            for verb in sorted(expected_mutating - mutating):
+                yield Finding(
+                    self.id, CHAOS_MODULE, mutating_line,
+                    f"MUTATING_VERBS is missing {verb!r} (every non-read "
+                    "verb must be faultable; if it IS a read, add it to "
+                    "READ_VERBS in this rule with review)")
+            for verb in sorted(mutating & READ_VERBS):
+                yield Finding(
+                    self.id, CHAOS_MODULE, mutating_line,
+                    f"MUTATING_VERBS contains read verb {verb!r}")
+
+
+RULES: Tuple[Rule, ...] = (TransportCompletenessRule(),)

@@ -14,6 +14,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from tpujob.analysis import lockgraph
 from tpujob.api import constants as c
 from tpujob.api.types import TPUJob
 from tpujob.kube.client import (
@@ -54,8 +55,8 @@ class _DedupWarner:
     def __init__(self, interval: float = 300.0, max_entries: int = 4096):
         self._interval = interval
         self._max = max_entries
-        self._lock = threading.Lock()
-        self._last: Dict[Tuple, float] = {}
+        self._lock = lockgraph.new_lock("dedup-warner")
+        self._last: Dict[Tuple, float] = {}  # guarded by self._lock
 
     def warning(self, logger: logging.Logger, key: Tuple, msg: str, *args) -> None:
         now = time.monotonic()
@@ -161,11 +162,11 @@ class _InstrumentedQueue:
 
     def __init__(self, inner):
         self._inner = inner
-        self._due: Dict[str, float] = {}
+        self._due: Dict[str, float] = {}  # guarded by self._lock
         # keys with a coalescing add_after in flight (scheduled, not yet
         # dequeued): further event adds for them are absorbed
-        self._coalescing: set = set()
-        self._lock = threading.Lock()
+        self._coalescing: set = set()  # guarded by self._lock
+        self._lock = lockgraph.new_lock("instrumented-queue")
 
     def _stamp(self, key: str, delay: float) -> None:
         due = time.monotonic() + delay
@@ -277,8 +278,8 @@ class JobController:
         # sync closes the measurement (process start -> caches synced ->
         # first sync)
         self._run_started_mono: Optional[float] = None
-        self._first_sync_recorded = False
-        self._cold_start_lock = threading.Lock()
+        self._first_sync_recorded = False  # guarded by self._cold_start_lock
+        self._cold_start_lock = lockgraph.new_lock("cold-start")
 
         self.job_informer = self.factory.informer(RESOURCE_TPUJOBS)
         self.pod_informer = self.factory.informer(RESOURCE_PODS)
@@ -615,7 +616,10 @@ class JobController:
 
     def _note_first_sync(self) -> None:
         """Close the cold-start measurement on the first completed sync."""
-        if self._first_sync_recorded or self._run_started_mono is None:
+        # benign double-checked fast path: a stale False re-checks under
+        # the lock below; a stale True can only occur after the first sync
+        # already recorded, when skipping is the correct outcome
+        if self._first_sync_recorded or self._run_started_mono is None:  # noqa: TPL003
             return
         with self._cold_start_lock:
             if self._first_sync_recorded:
@@ -652,7 +656,9 @@ class JobController:
         state, never a half-filled cache that would double-create pods.
         """
         self._run_started_mono = time.monotonic()
-        self._first_sync_recorded = False
+        # pre-worker reset: no worker thread exists yet, so the write
+        # happens-before any concurrent _note_first_sync
+        self._first_sync_recorded = False  # noqa: TPL003
         self.factory.start(stop_event)
         if not self.factory.wait_for_cache_sync(self.config.cache_sync_timeout_s):
             raise RuntimeError("informer caches failed to sync")

@@ -91,7 +91,8 @@ class TextFieldsFormatter(logging.Formatter):
             for k, v in fields.items():
                 try:
                     rendered.append(f"{k}={v}")
-                except Exception:  # hostile __str__ must not kill the line
+                except Exception:  # noqa: TPL005 - logging contract: a
+                    # hostile __str__ must not kill the log line
                     rendered.append(f"{k}=<unrepresentable {type(v).__name__}>")
             out += " (" + " ".join(rendered) + ")"
         return out
@@ -103,7 +104,8 @@ def _json_safe(value: Any) -> str:
     crash turns one diagnostic into a logging-handler error cascade."""
     try:
         return repr(value)
-    except Exception:  # even a hostile __repr__ must not kill the line
+    except Exception:  # noqa: TPL005 - logging contract: even a hostile
+        # __repr__ must not kill the log line
         return f"<unrepresentable {type(value).__name__}>"
 
 

@@ -19,11 +19,11 @@ directly to force specific error paths without monkeypatching.
 from __future__ import annotations
 
 import random
-import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from tpujob.analysis import lockgraph
 from tpujob.kube.errors import ApiError, ConflictError, GoneError, ServerTimeoutError
 from tpujob.kube.memserver import InMemoryAPIServer
 from tpujob.server import metrics
@@ -189,12 +189,12 @@ class FaultInjectingAPIServer:
     ):
         self.inner = inner if inner is not None else InMemoryAPIServer()
         self.schedule = FaultSchedule(seed, config)
-        self._lock = threading.Lock()
-        self._verb_counts: Dict[str, int] = {}
-        self._mutations = 0
+        self._lock = lockgraph.new_lock("chaos-injector")
+        self._verb_counts: Dict[str, int] = {}  # guarded by self._lock
+        self._mutations = 0  # guarded by self._lock
         # (global fault index, verb, call index, kind) — the injected-fault
         # log a soak report surfaces next to the invariant results
-        self.injected: List[Tuple[int, str, int, str]] = []
+        self.injected: List[Tuple[int, str, int, str]] = []  # guarded by self._lock
 
     # -- delegated surface ---------------------------------------------------
 

@@ -14,6 +14,7 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Deque, List, Optional, Tuple
 
+from tpujob.analysis import lockgraph
 from tpujob.api import constants as c
 from tpujob.api.types import TPUJob
 from tpujob.kube.client import ClientSet
@@ -137,9 +138,9 @@ class EventRecorder:
                  component: str = "tpujob-operator", tail: int = 1000):
         self.clients = clients
         self.component = component
-        self._lock = threading.Lock()
-        self._seq = 0
-        self._events: Deque[Event] = deque(maxlen=tail)
+        self._lock = lockgraph.new_lock("event-recorder")
+        self._seq = 0  # guarded by self._lock
+        self._events: Deque[Event] = deque(maxlen=tail)  # guarded by self._lock
         # observers notified of every recorded event (e.g. the controller's
         # flight recorder folding events into per-job timelines); must never
         # raise into the reconcile path
@@ -177,14 +178,14 @@ class EventRecorder:
         for sink in self.sinks:
             try:
                 sink(ev)
-            except Exception:
-                pass  # observers are best-effort, never fail reconcile
+            except Exception:  # noqa: TPL005 - observer contract: sinks are
+                pass  # best-effort and must never fail reconcile
         if self.clients is not None:
             try:
                 self.clients.events.create(ev)
-            except Exception:
-                # best-effort, never fail reconcile — but a silent swallow
-                # hides a broken events pipeline; count it
+            except Exception:  # noqa: TPL005 - observer contract: the write
+                # is best-effort and must never fail reconcile — but a
+                # silent swallow hides a broken events pipeline; count it
                 metrics.events_dropped.inc()
 
 
@@ -275,7 +276,7 @@ class FakePodControl(PodControl):
         self.create_limit: Optional[int] = None
         # create_pods runs creates concurrently on the slow-start pool, so
         # the limit check-then-append must be atomic
-        self._lock = threading.Lock()
+        self._lock = lockgraph.new_lock("fake-pod-control")
 
     def create_pod(self, namespace, pod, controller_object):
         pod.metadata.namespace = namespace

@@ -212,5 +212,13 @@ class FencedTransport:
              label_selector: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
         return self._inner.list(resource, namespace, label_selector)
 
+    def list_page(self, resource: str, namespace: Optional[str] = None,
+                  label_selector: Optional[Dict[str, str]] = None,
+                  limit: int = 0,
+                  continue_token: Optional[str] = None) -> Dict[str, Any]:
+        return self._inner.list_page(
+            resource, namespace, label_selector,
+            limit=limit, continue_token=continue_token)
+
     def watch(self, *args, **kwargs):
         return self._inner.watch(*args, **kwargs)

@@ -228,9 +228,12 @@ class APIServerHTTP:
         return f"http://{host}:{port}"
 
     def start(self) -> "APIServerHTTP":
-        self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True, name="tpujob-apiserver")
-        self._thread.start()
+        # start before publish: a concurrent stop() must never see (and
+        # join) a created-but-unstarted Thread (TPL001)
+        server = threading.Thread(target=self.httpd.serve_forever,
+                                  daemon=True, name="tpujob-apiserver")
+        server.start()
+        self._thread = server
         return self
 
     def stop(self) -> None:

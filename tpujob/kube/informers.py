@@ -10,8 +10,10 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from tpujob.analysis import lockgraph
 from tpujob.api import constants as c
 from tpujob.kube.errors import GoneError
 from tpujob.kube.memserver import (
@@ -72,23 +74,27 @@ class Store:
     results.
     """
 
-    def __init__(self, indexers: Optional[Dict[str, IndexFunc]] = None):
-        self._lock = threading.RLock()
-        self._objects: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    def __init__(self, indexers: Optional[Dict[str, IndexFunc]] = None,
+                 name: str = "informer-store"):
+        # per-resource lock name (see SharedInformer): distinct resources'
+        # stores get distinct lock-graph nodes, so a cross-store AB/BA
+        # order is representable instead of a same-name blind spot
+        self._lock = lockgraph.new_rlock(name)
+        self._objects: Dict[Tuple[str, str], Dict[str, Any]] = {}  # guarded by self._lock
         self._indexers = dict(DEFAULT_INDEXERS if indexers is None else indexers)
         # index name -> index key -> {store key -> obj}; the inner dict gives
         # O(1) removal while preserving insertion order for stable listings
-        self._indices: Dict[str, Dict[str, Dict[Tuple[str, str], Dict[str, Any]]]] = {
+        self._indices: Dict[str, Dict[str, Dict[Tuple[str, str], Dict[str, Any]]]] = {  # guarded by self._lock
             name: {} for name in self._indexers
         }
 
-    def _index_insert(self, key: Tuple[str, str], obj: Dict[str, Any]) -> None:
+    def _index_insert(self, key: Tuple[str, str], obj: Dict[str, Any]) -> None:  # caller holds self._lock
         for name, fn in self._indexers.items():
             index = self._indices[name]
             for ikey in fn(obj):
                 index.setdefault(ikey, {})[key] = obj
 
-    def _index_remove(self, key: Tuple[str, str], obj: Dict[str, Any]) -> None:
+    def _index_remove(self, key: Tuple[str, str], obj: Dict[str, Any]) -> None:  # caller holds self._lock
         for name, fn in self._indexers.items():
             index = self._indices[name]
             for ikey in fn(obj):
@@ -188,7 +194,7 @@ class SharedInformer:
         # request BOOKMARK events so a quiet stream's resume point advances
         # without data traffic; only honored with supports_bookmarks
         self.bookmarks = bookmarks
-        self.store = Store()
+        self.store = Store(name=f"informer-store-{resource}")
         self._add_handlers: List[Handler] = []
         self._update_handlers: List[UpdateHandler] = []
         self._delete_handlers: List[Handler] = []
@@ -527,7 +533,15 @@ class InformerFactory:
         return sum(i.sync_once() for i in self._informers.values())
 
     def wait_for_cache_sync(self, timeout: float = 10.0) -> bool:
-        return all(i.wait_for_cache_sync(timeout) for i in self._informers.values())
+        """One SHARED deadline across all informers: the sequential waits
+        below consume a single budget, so a wedged cold start surfaces
+        after ``timeout`` seconds total — not timeout x informer-count,
+        which would multiply the crash-only restart latency the
+        ``--cache-sync-timeout`` flag promises."""
+        deadline = time.monotonic() + timeout
+        return all(
+            i.wait_for_cache_sync(max(0.0, deadline - time.monotonic()))
+            for i in self._informers.values())
 
     def stop(self) -> None:
         for informer in self._informers.values():

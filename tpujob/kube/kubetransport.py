@@ -33,6 +33,7 @@ import urllib.parse
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from tpujob.analysis import lockgraph
 from tpujob.api import constants as c
 from tpujob.kube.errors import (
     ApiError,
@@ -231,8 +232,11 @@ class _RestWatch:
             self._conn.close()
             raise _status_error(resp.status, body)
         self._resp = resp
-        self._thread = threading.Thread(target=self._pump, daemon=True)
-        self._thread.start()
+        # start before publish: a concurrent stop() must never see (and
+        # join) a created-but-unstarted Thread (TPL001)
+        pump = threading.Thread(target=self._pump, daemon=True)
+        pump.start()
+        self._thread = pump
 
     def _pump(self) -> None:
         try:
@@ -269,8 +273,8 @@ class _RestWatch:
             self._q.put(None)
             try:
                 self._conn.close()
-            except Exception:
-                pass
+            except Exception:  # noqa: TPL005 - teardown: closing an
+                pass  # already-dead socket is best-effort
 
     def poll(self, timeout: float = 0.0) -> Optional[WatchEvent]:
         try:
@@ -283,8 +287,8 @@ class _RestWatch:
         self.closed = True
         try:
             self._conn.close()  # unblocks the reader
-        except Exception:
-            pass
+        except Exception:  # noqa: TPL005 - teardown: closing an
+            pass  # already-dead socket is best-effort
 
 
 class KubeApiTransport:
@@ -322,10 +326,10 @@ class KubeApiTransport:
         # -inf when no token was preloaded: the first request then reads the
         # file immediately instead of going out unauthenticated for the
         # first refresh interval
-        self._token_read_at = (
+        self._token_read_at = (  # guarded by self._token_lock
             time.monotonic() if self.config.token else -float("inf")
         )
-        self._token_lock = threading.Lock()
+        self._token_lock = lockgraph.new_lock("kube-token-refresh")
 
     # -- connection plumbing -------------------------------------------------
 
@@ -385,8 +389,8 @@ class KubeApiTransport:
         if conn is not None:
             try:
                 conn.close()
-            except Exception:
-                pass
+            except Exception:  # noqa: TPL005 - teardown: the connection is
+                pass  # being dropped precisely because it is broken
             self._local.conn = None
 
     def _request(
@@ -705,5 +709,5 @@ class KubeApiTransport:
     def healthy(self) -> bool:
         try:
             return self._request("GET", "/readyz", raw=True).decode().strip() == "ok"
-        except Exception:
-            return False
+        except Exception:  # noqa: TPL005 - a health probe DEFINES any
+            return False  # failure as "not healthy"; nothing to propagate

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import copy
 import queue
-import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -23,6 +22,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from collections import deque
 
+from tpujob.analysis import lockgraph
 from tpujob.kube.errors import (
     AlreadyExistsError,
     ConflictError,
@@ -146,41 +146,42 @@ class InMemoryAPIServer:
 
     def __init__(self, enable_gc: bool = True, history_size: int = 4096,
                  watch_queue_size: int = 10000, bookmark_every: int = 0):
-        self._lock = threading.RLock()
+        self._lock = lockgraph.new_rlock("memserver")
         self._watch_queue_size = watch_queue_size
-        self._stores: Dict[str, _Store] = {}
+        self._stores: Dict[str, _Store] = {}  # guarded by self._lock
         # (resource | None=all, namespace | None=all, watch)
-        self._watches: List[Tuple[Optional[str], Optional[str], Watch]] = []
-        self._rv = 0
+        self._watches: List[Tuple[Optional[str], Optional[str], Watch]] = []  # guarded by self._lock
+        self._rv = 0  # guarded by self._lock
         # bounded event history for resume-from-resourceVersion watches
         # (etcd's compacted revision window); (rv, resource, namespace, ev)
-        self._history: "deque[Tuple[int, str, str, WatchEvent]]" = deque(
+        self._history: "deque[Tuple[int, str, str, WatchEvent]]" = deque(  # guarded by self._lock
             maxlen=history_size
         )
         # compaction-pressure ledger: explicit compact() calls plus events
         # evicted by the history bound (each advances the oldest servable
         # resume/continue point); mirrored to history_compactions_total
-        self.history_compactions = 0
+        self.history_compactions = 0  # guarded by self._lock
         # every N committed events, fan a BOOKMARK out to every
         # bookmark-enabled watch so quiet streams' resume points keep up
         # with the global RV (0 = only explicit emit_bookmarks() calls)
         self._bookmark_every = bookmark_every
-        self._events_since_bookmark = 0
-        # paged-LIST snapshots: snapshot id -> (pinned rv, matching objects);
+        self._events_since_bookmark = 0  # guarded by self._lock
+        # paged-LIST snapshots: snapshot id -> (pinned rv, resource,
+        # matching objects);
         # objects are references to committed (immutable) dicts, so a
         # snapshot costs one list of pointers, not a deep copy of the world
-        self._list_snapshots: Dict[str, Tuple[int, List[Dict[str, Any]]]] = {}
+        self._list_snapshots: Dict[str, Tuple[int, str, List[Dict[str, Any]]]] = {}  # guarded by self._lock
         self._enable_gc = enable_gc
         # hooks: callables invoked (event_type, resource, obj_dict) after commit
         self.hooks: List[Callable[[str, str, Dict[str, Any]], None]] = []
         # pod log store: (ns, pod_name) -> text, fed by the simulated kubelet
-        self._pod_logs: Dict[Tuple[str, str], str] = {}
+        self._pod_logs: Dict[Tuple[str, str], str] = {}  # guarded by self._lock
         # server-side fencing (opt-in): (lease namespace, lease name) the
         # tokens are validated against; ledgers make the handover race
         # observable in tests
-        self._fence_lease: Optional[Tuple[str, str]] = None
-        self.fence_checked = 0  # token-carrying mutations validated
-        self.fence_rejections: List[Tuple[str, str, str]] = []  # (verb, resource, token)
+        self._fence_lease: Optional[Tuple[str, str]] = None  # guarded by self._lock
+        self.fence_checked = 0  # guarded by self._lock
+        self.fence_rejections: List[Tuple[str, str, str]] = []  # guarded by self._lock; (verb, resource, token)
 
     # -- write fencing (server-side validation) -----------------------------
 
@@ -195,7 +196,7 @@ class InMemoryAPIServer:
         with self._lock:
             self._fence_lease = (namespace or "default", name)
 
-    def _fence_check(self, verb: str, resource: str) -> None:
+    def _fence_check(self, verb: str, resource: str) -> None:  # caller holds self._lock
         if self._fence_lease is None or resource == "leases":
             return  # lease writes ARE the election; never fence them
         from tpujob.kube.fencing import current_call_token
@@ -228,10 +229,10 @@ class InMemoryAPIServer:
 
     # -- internals ----------------------------------------------------------
 
-    def _store(self, resource: str) -> _Store:
+    def _store(self, resource: str) -> _Store:  # caller holds self._lock
         return self._stores.setdefault(resource, _Store())
 
-    def _next_rv(self) -> str:
+    def _next_rv(self) -> str:  # caller holds self._lock
         self._rv += 1
         return str(self._rv)
 
@@ -242,7 +243,7 @@ class InMemoryAPIServer:
             raise InvalidError("metadata.name is required")
         return (meta.get("namespace") or "default", name)
 
-    def _broadcast(self, ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
+    def _broadcast(self, ev_type: str, resource: str, obj: Dict[str, Any]) -> None:  # caller holds self._lock
         """Fan one committed object out to history, every subscriber and every
         hook as ONE shared snapshot.
 
@@ -299,7 +300,7 @@ class InMemoryAPIServer:
             self._history.clear()
             self._history.extend(kept)
             horizon = self._history[0][0]
-            for snap_id, (rv, _) in list(self._list_snapshots.items()):
+            for snap_id, (rv, _res, _) in list(self._list_snapshots.items()):
                 if rv < horizon - 1:
                     del self._list_snapshots[snap_id]
 
@@ -450,14 +451,14 @@ class InMemoryAPIServer:
             snap_id = uuid.uuid4().hex
             while len(self._list_snapshots) >= self.MAX_LIST_SNAPSHOTS:
                 self._list_snapshots.pop(next(iter(self._list_snapshots)))
-            self._list_snapshots[snap_id] = (rv, snapshot)
+            self._list_snapshots[snap_id] = (rv, resource, snapshot)
             return {
                 "items": [copy.deepcopy(o) for o in snapshot[:limit]],
                 "continue": f"{snap_id}:{limit}",
                 "resourceVersion": str(rv),
             }
 
-    def _continue_page(self, resource: str, limit: int, token: str) -> Dict[str, Any]:
+    def _continue_page(self, resource: str, limit: int, token: str) -> Dict[str, Any]:  # caller holds self._lock
         snap_id, _, off_s = token.partition(":")
         try:
             offset = int(off_s)
@@ -467,7 +468,14 @@ class InMemoryAPIServer:
         if entry is None:
             raise GoneError(
                 f"continue token {token!r} expired (snapshot compacted away)")
-        rv, snapshot = entry
+        rv, snap_resource, snapshot = entry
+        if snap_resource != resource:
+            # a real apiserver 400s a token minted for another resource;
+            # honoring it here would hand pods back under a ServiceList
+            # and mask the client bug in every in-memory test
+            raise InvalidError(
+                f"continue token {token!r} was issued for {snap_resource!r}, "
+                f"not {resource!r}")
         if self._history and rv < self._history[0][0] - 1:
             # the pinned revision rolled out of the bounded history window:
             # a real apiserver's etcd compacted it away
@@ -613,7 +621,7 @@ class InMemoryAPIServer:
             if self._enable_gc:
                 self._gc_dependents((obj.get("metadata") or {}).get("uid"))
 
-    def _gc_dependents(self, owner_uid: Optional[str]) -> None:
+    def _gc_dependents(self, owner_uid: Optional[str]) -> None:  # caller holds self._lock
         """Cascade-delete objects controller-owned by `owner_uid` (k8s GC)."""
         if not owner_uid:
             return

@@ -8,8 +8,9 @@ every API verb through a token bucket; watches stream outside the bucket
 """
 from __future__ import annotations
 
-import threading
 import time
+
+from tpujob.analysis import lockgraph
 
 
 class TokenBucket:
@@ -20,9 +21,9 @@ class TokenBucket:
             raise ValueError(f"qps must be > 0, got {qps}")
         self.qps = qps
         self.burst = max(1, burst)
-        self._tokens = float(self.burst)
-        self._last = time.monotonic()
-        self._lock = threading.Lock()
+        self._tokens = float(self.burst)  # guarded by self._lock
+        self._last = time.monotonic()  # guarded by self._lock
+        self._lock = lockgraph.new_lock("token-bucket")
 
     def acquire(self) -> float:
         """Take one token, sleeping until available; returns seconds waited."""

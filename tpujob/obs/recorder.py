@@ -19,11 +19,11 @@ rotates history, it never grows the process.
 from __future__ import annotations
 
 import itertools
-import threading
 import time
 from collections import OrderedDict, deque
 from typing import Any, Deque, Dict, List, Optional
 
+from tpujob.analysis import lockgraph
 from tpujob.obs.trace import TRACER, Span
 
 
@@ -43,20 +43,20 @@ class FlightRecorder:
         self.ring_size = ring_size
         self.max_jobs = max_jobs
         self.max_traces = max_traces
-        self._lock = threading.Lock()
-        self._seq = itertools.count(1)
+        self._lock = lockgraph.new_lock("flight-recorder")
+        self._seq = itertools.count(1)  # guarded by self._lock
         # job key -> ring of timeline entries (LRU-bounded across jobs)
-        self._jobs: "OrderedDict[str, Deque[Dict[str, Any]]]" = OrderedDict()
+        self._jobs: "OrderedDict[str, Deque[Dict[str, Any]]]" = OrderedDict()  # guarded by self._lock
         # job key -> {condition type -> status} as last observed
-        self._conditions: Dict[str, Dict[str, str]] = {}
+        self._conditions: Dict[str, Dict[str, str]] = {}  # guarded by self._lock
         # corr id -> {job, spans} for recent syncs
-        self._traces: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._traces: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()  # guarded by self._lock
 
     # ------------------------------------------------------------------
     # recording
     # ------------------------------------------------------------------
 
-    def _ring(self, job_key: str) -> Deque[Dict[str, Any]]:
+    def _ring(self, job_key: str) -> Deque[Dict[str, Any]]:  # caller holds self._lock
         ring = self._jobs.get(job_key)
         if ring is None:
             ring = deque(maxlen=self.ring_size)

@@ -32,6 +32,8 @@ import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
+from tpujob.analysis import lockgraph
+
 # the active trace (set by the root span for the duration of one sync) and
 # the innermost open span (the parent for any span opened underneath)
 _current_trace: "contextvars.ContextVar[Optional[_Trace]]" = contextvars.ContextVar(
@@ -90,8 +92,11 @@ class _Trace:
 
     def __init__(self, trace_id: str):
         self.trace_id = trace_id
-        self.spans: List[Span] = []
+        self.spans: List[Span] = []  # guarded by self._lock
         self.closed = False
+        # deliberately a PLAIN lock, not a lockgraph sentinel: one _Trace is
+        # born per sync, and per-instance sentinel bookkeeping on the span
+        # hot path would violate the <5% tracing-overhead budget
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
 
@@ -240,9 +245,15 @@ class Tracer:
 
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
-        self._lock = threading.Lock()
-        self._roots_started = 0
-        self._roots_closed = 0
+        # NOTE: the process-wide TRACER singleton is constructed at import,
+        # so a runtime lockgraph.audit()/enable() can never retrofit this
+        # lock — only the TPUJOB_LOCK_SENTINEL env flag (read before any
+        # import) puts the "tracer" node on the graph.  Acceptable: the
+        # lock guards two counters and is never held across another
+        # acquisition.
+        self._lock = lockgraph.new_lock("tracer")
+        self._roots_started = 0  # guarded by self._lock
+        self._roots_closed = 0  # guarded by self._lock
 
     def _note_root(self, started: bool) -> None:
         with self._lock:
@@ -399,8 +410,8 @@ class KeyedTokenBucket:
         self.capacity = float(capacity)
         self.refill_per_s = refill_per_s
         self.max_keys = max_keys
-        self._lock = threading.Lock()
-        self._buckets: "OrderedDict[Any, Tuple[float, float]]" = OrderedDict()
+        self._lock = lockgraph.new_lock("keyed-token-bucket")
+        self._buckets: "OrderedDict[Any, Tuple[float, float]]" = OrderedDict()  # guarded by self._lock
 
     def allow(self, key: Any) -> bool:
         now = time.monotonic()

@@ -10,6 +10,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from tpujob.analysis import lockgraph
 from tpujob.runtime import SHUTDOWN  # type: ignore  # circular-safe: defined first
 
 
@@ -17,16 +18,19 @@ class PyWorkQueue:
     def __init__(self, base_delay: float = 0.005, max_delay: float = 1000.0):
         self._base = base_delay
         self._max = max_delay
+        # the Condition's underlying mutex stays a plain Lock: Condition
+        # internals re-enter acquire/release on wait(), which would skew
+        # the lockgraph sentinel's hold accounting
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._queue: List[str] = []
-        self._queued: Set[str] = set()
-        self._processing: Set[str] = set()
-        self._dirty: Set[str] = set()
-        self._delayed: List[Tuple[float, int, str]] = []  # (when, seq, key)
-        self._seq = 0
-        self._failures: Dict[str, int] = {}
-        self._shutting_down = False
+        self._queue: List[str] = []  # guarded by self._cv
+        self._queued: Set[str] = set()  # guarded by self._cv
+        self._processing: Set[str] = set()  # guarded by self._cv
+        self._dirty: Set[str] = set()  # guarded by self._cv
+        self._delayed: List[Tuple[float, int, str]] = []  # guarded by self._cv; (when, seq, key)
+        self._seq = 0  # guarded by self._cv
+        self._failures: Dict[str, int] = {}  # guarded by self._cv
+        self._shutting_down = False  # guarded by self._cv
 
     def _add_locked(self, key: str) -> None:
         if key in self._processing:
@@ -125,8 +129,8 @@ class PyWorkQueue:
 class PyExpectations:
     def __init__(self, ttl: float = 300.0):
         self._ttl = ttl
-        self._lock = threading.Lock()
-        self._entries: Dict[str, Tuple[int, int, float]] = {}  # adds, dels, created
+        self._lock = lockgraph.new_lock("expectations")
+        self._entries: Dict[str, Tuple[int, int, float]] = {}  # guarded by self._lock; (adds, dels, created)
 
     def expect(self, key: str, adds: int = 0, dels: int = 0) -> None:
         """Accumulates onto a live entry (RaiseExpectations semantics):

@@ -190,11 +190,14 @@ class OperatorApp:
         if self.elector is not None:
             self.elector.on_started_leading = started_leading
             self.elector.on_stopped_leading = lost_leadership
-            self._elector_thread = threading.Thread(
+            # start before publish: a shutdown racing construction must
+            # never join a created-but-unstarted Thread (TPL001)
+            elector_thread = threading.Thread(
                 target=self.elector.run, args=(self.stop_event,), daemon=True,
                 name="leader-elector",
             )
-            self._elector_thread.start()
+            elector_thread.start()
+            self._elector_thread = elector_thread
         else:
             start_controller()
 

@@ -142,7 +142,10 @@ class LeaderElector:
                 self.server.create(RESOURCE_LEASES, record)
                 self._generation = 0
                 return True
-            except Exception:
+            except Exception as e:
+                # losing the create race (409) or a transient transport
+                # error: normal contention, but never swallow it unseen
+                log.debug("lease create did not win: %s", e)
                 return False
         spec = current.get("spec") or {}
         holder = spec.get("holderIdentity")
@@ -192,7 +195,10 @@ class LeaderElector:
         with it the monotonic generation the fencing tokens depend on."""
         try:
             current = self.server.get(RESOURCE_LEASES, self.namespace, self.lock_name)
-        except Exception:
+        except Exception as e:
+            # best effort: a failed release degrades to the lease expiring
+            log.warning("lease read for release failed (standby must wait "
+                        "it out): %s", e)
             return
         spec = current.get("spec") or {}
         if spec.get("holderIdentity") != self.identity:
@@ -256,9 +262,12 @@ class LeaderElector:
             # publication visible before anyone reads it
             self.leading_thread = t
         while not stop_event.is_set():
-            deadline = time.time() + self.renew_deadline
+            # the renew deadline is a DURATION: it must ride the monotonic
+            # clock — an NTP step during the window would otherwise expire
+            # a healthy renewal loop early (or stretch it past the lease)
+            deadline = time.monotonic() + self.renew_deadline
             renewed = False
-            while time.time() < deadline and not stop_event.is_set():
+            while time.monotonic() < deadline and not stop_event.is_set():
                 if self._try_acquire_or_renew():
                     renewed = True
                     break

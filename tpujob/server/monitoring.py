@@ -77,10 +77,13 @@ class MonitoringServer:
         return self.httpd.server_address[1]
 
     def start(self) -> "MonitoringServer":
-        self._thread = threading.Thread(
+        # start before publish: a concurrent stop() must never see (and
+        # join) a created-but-unstarted Thread (TPL001)
+        server = threading.Thread(
             target=self.httpd.serve_forever, daemon=True, name="tpujob-monitoring"
         )
-        self._thread.start()
+        server.start()
+        self._thread = server
         return self
 
     def stop(self) -> None:
