@@ -11,7 +11,7 @@ SHELL := /bin/bash
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke write-path-smoke read-path-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
+.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke shard-smoke write-path-smoke read-path-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
@@ -54,6 +54,13 @@ trace-smoke:
 failover-smoke:
 	$(PY) scripts/failover_smoke.py
 
+# sharded-control-plane smoke (~5 s): 2 controllers split the job shards,
+# one is hard-killed — the survivor must absorb its shards within one lease
+# term with no double-sync (exactly one holder per shard-lease generation),
+# and every stale shard token must be rejected server-side
+shard-smoke:
+	$(PY) scripts/shard_smoke.py
+
 # write-path smoke (~10 s): the churn bench's optimized run (no-op status
 # suppression + event coalescing + merge-patch writes) must beat the naive
 # control by >= 2x on API write calls, with trace completeness intact
@@ -69,7 +76,7 @@ read-path-smoke:
 
 # the tier-1 command from ROADMAP.md, verbatim (modulo $$-escaping for
 # make), so local and CI invocations agree on what "the tests pass" means
-test: lint trace-smoke failover-smoke write-path-smoke read-path-smoke
+test: lint trace-smoke failover-smoke shard-smoke write-path-smoke read-path-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # the operator/controller/kube/api tests only — the model-path suites
@@ -86,11 +93,13 @@ e2e:
 	scripts/run-cleanpodpolicy-all.sh
 	scripts/run-preemption.sh
 
-# chaos soak: the full job matrix under 5 seeded fault schedules (25 jobs;
-# API faults + watch kills + compaction + preemption storms), asserting the
+# chaos soak: the full job matrix under 5 seeded fault schedules (API
+# faults + watch kills + compaction + preemption storms), asserting the
 # system invariants after every convergence (docs/failure-handling).
-# --crash adds the controller-lifecycle tier per seed: hard-kill + cold
-# restart schedules and warm-standby failover with write-fencing probes.
+# --crash adds the controller-lifecycle tiers per seed: hard-kill + cold
+# restart schedules, warm-standby failover with write-fencing probes, and
+# the sharded-control-plane membership storm (3 controllers, member
+# kill/flap/rejoin, exactly-one-owner-per-generation asserted).
 soak:
 	$(PY) soak.py --seeds 1,2,3,4,5 --crash
 
@@ -116,6 +125,7 @@ bench-controller:
 	$(PY) bench_controller.py --jobs 50 --workers 8 --mode scan --serial
 	$(PY) bench_controller.py --jobs 10 --workers 8 --churn 4
 	$(PY) bench_controller.py --jobs 10 --workers 8 --churn 4 --no-suppress --no-coalesce
+	$(PY) bench_controller.py --jobs 24 --workers 4 --controllers 4 --threadiness 2
 
 # read path at scale: 100k-object cold-start/relist curve — the paged +
 # bookmark run vs the unpaged/bookmark-less control, asserting the >= 5x

@@ -35,6 +35,13 @@ With tracing on, the run also asserts trace completeness: every completed
 sync yielded exactly one CLOSED root span carrying a queue-latency child,
 and every pod-creating sync carries API-call child spans.
 
+Scale-out mode (``--controllers N``, N > 0): the bring-up workload runs on
+a SHARDED controller fleet at 1, 2, 4, ..., N instances (consistent-hash
+job shards, per-shard fencing leases — the full ``--shards`` production
+wiring), emitting the jobs/sec-vs-N scale-out curve as one JSON line with
+the N-vs-1 speedup.  Each instance keeps the same per-instance worker
+count, so the curve isolates horizontal scale-out from thread scaling.
+
 Read-path mode (``--objects N``, N > 0): a six-figure-object cold-start /
 relist benchmark instead of the reconcile-throughput run.  Pre-loads N
 noise pods, cold-starts the controller (paged informer LISTs + watch
@@ -72,16 +79,32 @@ from tpujob.obs.trace import TRACER
 class LatencyServer(InMemoryAPIServer):
     """In-memory apiserver whose creates cost a simulated network round trip
     (slept before the lock, so concurrent creates overlap it like real
-    in-flight requests)."""
+    in-flight requests).  ``mutate_latency`` extends the model to status
+    writes for the scale-out bench, where per-call apiserver RTT is the
+    resource more controller instances actually parallelize."""
 
-    def __init__(self, create_latency: float = 0.0, **kwargs):
+    def __init__(self, create_latency: float = 0.0,
+                 mutate_latency: float = 0.0, **kwargs):
         super().__init__(**kwargs)
         self.create_latency = create_latency
+        self.mutate_latency = mutate_latency
 
     def create(self, resource, obj):
         if self.create_latency > 0:
             time.sleep(self.create_latency)
         return super().create(resource, obj)
+
+    def update_status(self, resource, obj):
+        if self.mutate_latency > 0:
+            time.sleep(self.mutate_latency)
+        return super().update_status(resource, obj)
+
+    def patch_status(self, resource, namespace, name, patch,
+                     resource_version=None):
+        if self.mutate_latency > 0:
+            time.sleep(self.mutate_latency)
+        return super().patch_status(resource, namespace, name, patch,
+                                    resource_version=resource_version)
 
 
 class CountingTransport:
@@ -675,6 +698,131 @@ def run_read_bench(objects: int, paging: bool = True, bookmarks: bool = True,
     }
 
 
+def _scaleout_counts(max_controllers: int) -> List[int]:
+    """The scale-out curve's sample points: powers of two up to N, plus N."""
+    counts = {1, max_controllers}
+    n = 2
+    while n < max_controllers:
+        counts.add(n)
+        n *= 2
+    return sorted(counts)
+
+
+def run_scaleout_bench(jobs: int, workers: int, max_controllers: int,
+                       shard_count: int = 16, threadiness: int = 2,
+                       create_latency: float = 0.002,
+                       background_pods: int = 200,
+                       timeout: float = 120.0) -> Dict:
+    """Sharded-control-plane scale-out curve: jobs/sec vs controller count.
+
+    For each point, a fresh in-memory cluster gets ``n`` operator instances
+    joined into one shard fleet (consistent-hash job shards, rendezvous
+    assignment, per-shard fencing — the full production wiring via
+    ``OperatorApp --shards``); the bench then creates J jobs and measures
+    the wall time until every job carries the Running condition, exactly
+    like the single-controller throughput run.  Each instance runs
+    ``threadiness`` workers, so the curve isolates the scale-OUT effect:
+    the same per-instance capacity, more instances.  Tracing is off — the
+    flight recorder is per-instance and the trace-completeness assertion is
+    a single-controller invariant.
+    """
+    from tpujob.server.app import OperatorApp
+    from tpujob.server.options import ServerOption
+
+    def one_point(n: int) -> Dict:
+        server = LatencyServer(create_latency=create_latency,
+                               mutate_latency=create_latency)
+        for i in range(background_pods):
+            server.create(RESOURCE_PODS, {
+                "metadata": {"name": f"noise-{i:05d}", "namespace": "default",
+                             "labels": {"app": "unrelated"}},
+                "spec": {"containers": [{"name": "app", "image": "noise"}]},
+                "status": {"phase": "Running"},
+            })
+        install_kubelet(server)
+        apps = []
+        try:
+            for _ in range(n):
+                opt = ServerOption(
+                    monitoring_port=0, enable_leader_election=False,
+                    shard_count=shard_count,
+                    leader_election_namespace="default",
+                    lease_duration_s=0.6, renew_deadline_s=0.3,
+                    retry_period_s=0.05,
+                    threadiness=threadiness, resync_period_s=0,
+                    enable_tracing=False,
+                )
+                app = OperatorApp(opt, transport=server)
+                # serial creates: each instance pays its creates on its OWN
+                # worker threads.  The in-process slow-start pool is a
+                # process-global singleton, which in this bench would be
+                # shared by every "instance" — a real deployment runs one
+                # process per member, each with its own pool, so sharing it
+                # would understate scale-out exactly at the point of
+                # measurement.  Serial-everywhere keeps all curve points on
+                # identical per-instance concurrency.
+                use_serial_creates(app.controller)
+                app.run(block=False)
+                apps.append(app)
+
+            def full_coverage() -> bool:
+                owned: Dict[int, int] = {}
+                for a in apps:
+                    for s in a.coordinator.owned_shards():
+                        owned[s] = owned.get(s, 0) + 1
+                return (len(owned) == shard_count
+                        and all(c == 1 for c in owned.values()))
+
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not full_coverage():
+                time.sleep(0.02)
+            if not full_coverage():
+                raise TimeoutError(
+                    f"{n}-controller fleet never reached full disjoint "
+                    "shard coverage")
+
+            names = [f"scale-{i:04d}" for i in range(jobs)]
+            t0 = time.perf_counter()
+            for name in names:
+                server.create(RESOURCE_TPUJOBS, job_dict(name, workers))
+            pending = set(names)
+            deadline = time.monotonic() + timeout
+            while pending and time.monotonic() < deadline:
+                pending = {
+                    name for name in pending
+                    if not _is_running(server.get(RESOURCE_TPUJOBS, "default", name))}
+                if pending:
+                    time.sleep(0.005)
+            elapsed = time.perf_counter() - t0
+            if pending:
+                raise TimeoutError(
+                    f"{len(pending)}/{jobs} jobs not Running after "
+                    f"{timeout:.0f}s with {n} controller(s)")
+            return {
+                "controllers": n,
+                "elapsed_s": round(elapsed, 4),
+                "jobs_per_sec": round(jobs / elapsed, 2),
+                "rebalances": sum(a.coordinator.rebalances for a in apps),
+            }
+        finally:
+            for app in apps:
+                app.shutdown()
+
+    curve = [one_point(n) for n in _scaleout_counts(max_controllers)]
+    return {
+        "metric": "controller_scaleout",
+        "jobs": jobs,
+        "workers": workers,
+        "shards": shard_count,
+        "threadiness_per_controller": threadiness,
+        "create_latency_s": create_latency,
+        "background_pods": background_pods,
+        "curve": curve,
+        "speedup": round(curve[-1]["jobs_per_sec"] / curve[0]["jobs_per_sec"], 3)
+        if curve[0]["jobs_per_sec"] else 0.0,
+    }
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--jobs", type=int, default=50, help="J: number of TPUJobs")
@@ -727,6 +875,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(smaller = more natural compaction pressure)")
     p.add_argument("--read-churn", type=int, default=5, dest="read_churn",
                    help="read-path mode: churn/compaction/kill rounds")
+    p.add_argument("--controllers", type=int, default=0,
+                   help="scale-out mode: run the bring-up workload on a "
+                        "sharded fleet at 1, 2, 4, ..., N controllers and "
+                        "emit the jobs/sec-vs-N curve as one JSON line "
+                        "(0 disables)")
+    p.add_argument("--shards", type=int, default=16,
+                   help="scale-out mode: virtual job shards the fleet "
+                        "splits (must exceed the largest controller count)")
     p.add_argument("--lock-sentinel", action="store_true",
                    help="run under the runtime lock-order sentinel "
                         "(tpujob.analysis.lockgraph): every lock the run "
@@ -758,6 +914,19 @@ def _run_cli(args, lock_graph) -> int:
             return 1
         return 0
 
+    if args.controllers > 0:
+        try:
+            result = run_scaleout_bench(
+                args.jobs, args.workers, args.controllers,
+                shard_count=args.shards, threadiness=args.threadiness,
+                create_latency=args.create_latency,
+                background_pods=args.background_pods, timeout=args.timeout)
+        except (TimeoutError, AssertionError, ValueError) as e:
+            print(f"FAIL: {e}", file=sys.stderr)
+            return 1
+        rc = _lock_verdict(result)
+        print(json.dumps(result))
+        return rc
     if args.objects > 0:
         try:
             result = run_read_bench(

@@ -38,7 +38,18 @@ itself, not just its transport:
   leader**, validated both client-side and by the memserver's server-side
   token check).
 
-Runnable:  python -m e2e.chaos --seed 7 [--mode api|crash|failover]
+The shard tier (the sharded-control-plane PR) scales the fleet out:
+
+- ``run_shard_soak`` — N controllers shard the job set by consistent hash
+  of job UID (one fencing lease per shard) under a seeded membership storm
+  of hard kills, graceful flaps and rejoins.  Invariants: every job synced
+  by exactly one owner per shard-lease generation, zero writes accepted
+  from a deposed shard owner (server-side per-shard token check), no shard
+  orphaned after membership settles, full convergence.
+- ``run_shard_smoke`` — the fast 2-member slice: kill one, the survivor
+  must absorb its shards within one lease term with no double-sync.
+
+Runnable:  python -m e2e.chaos --seed 7 [--mode api|crash|failover|shard]
 (or the full seeded matrix via the repo-root ``soak.py`` / ``make soak``)
 """
 from __future__ import annotations
@@ -697,7 +708,7 @@ def _run_soak_inner(
 
 
 def _soak_opt(opt_overrides: Optional[Dict[str, Any]] = None,
-              leader_election: bool = False) -> ServerOption:
+              leader_election: bool = False, shards: int = 0) -> ServerOption:
     """ServerOption for a soak controller: short leases so a crashed
     leader's stale lease expires within the run, soak-tightened backoffs.
     The lease namespace is pinned to 'default' — the namespace the failover
@@ -709,6 +720,9 @@ def _soak_opt(opt_overrides: Optional[Dict[str, Any]] = None,
         leader_election_namespace="default",
         lease_duration_s=0.6, renew_deadline_s=0.3, retry_period_s=0.05,
     )
+    if shards > 0:
+        opt.shard_count = shards
+        opt.shard_drain_timeout_s = 2.0
     for k, v in {**SOAK_OPT_OVERRIDES, **(opt_overrides or {})}.items():
         if not hasattr(opt, k):
             raise TypeError(f"unknown ServerOption override {k!r}")
@@ -717,14 +731,39 @@ def _soak_opt(opt_overrides: Optional[Dict[str, Any]] = None,
 
 
 def _start_app(transport, opt_overrides: Optional[Dict[str, Any]] = None,
-               leader_election: bool = False) -> OperatorApp:
+               leader_election: bool = False, shards: int = 0) -> OperatorApp:
     """Cold-start one operator instance.  Without leader election the
     controller starts synchronously (run() returns only after the
     wait-for-cache-sync barrier); with it, the elector thread acquires in
-    the background and the controller cold-starts on acquisition."""
-    app = OperatorApp(_soak_opt(opt_overrides, leader_election), transport=transport)
+    the background and the controller cold-starts on acquisition.  With
+    ``shards`` > 0 the instance joins the sharded fleet: the controller
+    starts synchronously and the shard coordinator acquires in the
+    background."""
+    app = OperatorApp(_soak_opt(opt_overrides, leader_election, shards),
+                      transport=transport)
     app.run(block=False)
     return app
+
+
+def _fence_probe(op) -> str:
+    """One fencing probe's verdict: 'rejected' | 'accepted' | 'inconclusive'.
+    Chaos can fault any single call before it reaches the fence check, so
+    retry through transient injected faults.  A 404/409 from the REAL store
+    is proof the call got PAST the fence (the chaos layer never mints those
+    two for the probe verbs' targets) — e.g. an unfenced delete of an
+    absent probe pod answers NotFound, which must count as a breach, not
+    as chaos noise."""
+    for _ in range(12):
+        try:
+            op()
+        except FencedError:
+            return "rejected"
+        except (NotFoundError, AlreadyExistsError):
+            return "accepted"  # reached storage: fencing failed
+        except Exception:  # noqa: TPL005 - injected chaos fault,
+            continue  # not a fencing verdict: retry the probe
+        return "accepted"
+    return "inconclusive"
 
 
 def _wait_for(predicate, timeout: float, interval: float = 0.02) -> bool:
@@ -947,27 +986,6 @@ def _run_failover_soak_inner(
         zombies = [a for a in apps if a is not current]
         probe_pod = {"metadata": {"name": f"{prefix}-zombie-pod",
                                   "namespace": "default"}}
-
-        def probe(op) -> str:
-            """One probe's verdict: 'rejected' | 'accepted' | 'inconclusive'.
-            Chaos can fault any single call before it reaches the fence
-            check, so retry through transient injected faults.  A 404/409
-            from the REAL store is proof the call got PAST the fence (the
-            chaos layer never mints those two for the probe verbs' targets)
-            — e.g. an unfenced delete of the absent zombie pod answers
-            NotFound, which must count as a breach, not as chaos noise."""
-            for _ in range(12):
-                try:
-                    op()
-                except FencedError:
-                    return "rejected"
-                except (NotFoundError, AlreadyExistsError):
-                    return "accepted"  # reached storage: fencing failed
-                except Exception:  # noqa: TPL005 - injected chaos fault,
-                    continue  # not a fencing verdict: retry the probe
-                return "accepted"
-            return "inconclusive"
-
         fence_inconclusive = 0
         from tpujob.kube.fencing import FencedTransport
 
@@ -985,7 +1003,7 @@ def _run_failover_soak_inner(
                         "pods", "default", f"{prefix}-zombie-pod"),
                 ):
                     fence_probes += 1
-                    verdict = probe(op)
+                    verdict = _fence_probe(op)
                     if verdict == "rejected":
                         fence_rejected += 1
                     elif verdict == "inconclusive":
@@ -1046,17 +1064,482 @@ def _run_failover_soak_inner(
     return report
 
 
+# ---------------------------------------------------------------------------
+# sharded control plane: member kill/join/rebalance storms (PR 8)
+# ---------------------------------------------------------------------------
+
+SHARD_SOAK_SHARDS = 8
+SHARD_SOAK_CONTROLLERS = 3
+
+
+def _shard_ledger_problems(inner: InMemoryAPIServer) -> List[str]:
+    """Invariant 8a/8b over the server's accepted-write ledger: every
+    (shard lease, generation) ownership term saw exactly ONE holder write
+    (no instant with two members syncing one shard), and every job —
+    ledgered by its namespace-qualified key — was only ever written under
+    ONE shard lease (job → shard never moves)."""
+    problems: List[str] = []
+    owners: Dict[Tuple[str, int], set] = {}
+    job_leases: Dict[str, set] = {}
+    for _verb, resource, name, lease, holder, gen in list(inner.fence_accepts):
+        owners.setdefault((lease, gen), set()).add(holder)
+        if resource == RESOURCE_TPUJOBS and name:
+            job_leases.setdefault(name, set()).add(lease)
+    multi = {k: sorted(v) for k, v in owners.items() if len(v) > 1}
+    if multi:
+        problems.append(
+            "shard fencing: multiple holders accepted under one "
+            f"(lease, generation) term: {multi}")
+    moved = {n: sorted(ls) for n, ls in job_leases.items() if len(ls) > 1}
+    if moved:
+        problems.append(
+            f"sharding: jobs written under more than one shard lease: {moved}")
+    return problems
+
+
+def _shard_coverage_problems(inner: InMemoryAPIServer, live: List[OperatorApp],
+                             shard_count: int) -> List[str]:
+    """Invariant 9: after membership settles, no shard is orphaned — every
+    shard lease is held, unexpired, by a live member, and the live members'
+    owned sets PARTITION the shard space (disjoint and complete)."""
+    from tpujob.server.leader_election import parse_lease_time
+    from tpujob.server.sharding import shard_lease_name
+
+    problems: List[str] = []
+    live_ids = {a.coordinator.identity for a in live}
+    now = time.time()
+    for s in range(shard_count):
+        try:
+            lease = inner.get("leases", "default", shard_lease_name(s))
+        except NotFoundError:
+            problems.append(f"shard {s}: no lease object (never owned)")
+            continue
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        renew = parse_lease_time(spec.get("renewTime"))
+        duration = float(spec.get("leaseDurationSeconds") or 0)
+        if not holder or holder not in live_ids:
+            problems.append(f"shard {s}: holder {holder!r} is not a live member")
+        elif renew is not None and now - renew > duration:
+            problems.append(
+                f"shard {s}: lease expired (orphaned past lease_duration)")
+    owned_union: Dict[int, List[str]] = {}
+    for a in live:
+        for s in a.coordinator.owned_shards():
+            owned_union.setdefault(s, []).append(a.coordinator.identity)
+    dup = {s: v for s, v in owned_union.items() if len(v) > 1}
+    if dup:
+        problems.append(f"sharding: shards owned by two live members: {dup}")
+    missing = sorted(set(range(shard_count)) - set(owned_union))
+    if missing:
+        problems.append(f"sharding: shards owned by no live member: {missing}")
+    return problems
+
+
+def _check_shard_invariants(admin: ClientSet, live: List[OperatorApp],
+                            cases: List[JobCase], tracker: StatusTracker,
+                            chaos: Optional[FaultInjectingAPIServer],
+                            inner: InMemoryAPIServer,
+                            shard_count: int) -> List[str]:
+    # the standard invariant set runs once against the cluster plus the
+    # first live controller's ledgers; the other members contribute their
+    # OWN controller-local ledgers (expectations trivially satisfied for
+    # shards they never owned)
+    problems = check_invariants(admin, live[0].controller, cases, tracker, chaos)
+    for app in live[1:]:
+        ctrl = app.controller
+        if ctrl._restart_deltas:
+            problems.append(
+                f"{app.coordinator.identity}: restart-delta ledger not "
+                f"drained: {ctrl._restart_deltas}")
+        for case in cases:
+            for rtype in case.job.spec.tpu_replica_specs:
+                for kind in ("pods", "services"):
+                    key = expectation_key(
+                        f"default/{case.job.metadata.name}", rtype, kind)
+                    if not ctrl.expectations.satisfied(key):
+                        problems.append(
+                            f"{app.coordinator.identity}: expectation {key} "
+                            "unsatisfied")
+    problems += _shard_ledger_problems(inner)
+    problems += _shard_coverage_problems(inner, live, shard_count)
+    return problems
+
+
+def _settle_shard_invariants(admin: ClientSet, live: List[OperatorApp],
+                             cases: List[JobCase], tracker: StatusTracker,
+                             chaos: Optional[FaultInjectingAPIServer],
+                             inner: InMemoryAPIServer, shard_count: int,
+                             deadline: float) -> List[str]:
+    """The shard tier's quiescence loop (see :func:`_settle_invariants`):
+    hold the combined invariant set across two spaced observations."""
+    stable = 0
+    while time.monotonic() < deadline and stable < 2:
+        problems = _check_shard_invariants(
+            admin, live, cases, tracker, chaos, inner, shard_count)
+        stable = stable + 1 if not problems else 0
+        if stable < 2:
+            time.sleep(0.1)
+    return _check_shard_invariants(
+        admin, live, cases, tracker, chaos, inner, shard_count)
+
+
+def _probe_stale_shard_tokens(chaos, prefix: str, stale_tokens) -> Dict[str, int]:
+    """Replay the paused-process race per shard: write through a FRESH
+    transport carrying a dead member's shard token.  The local check passes
+    (the token is simply handed over), so every rejection here is the
+    SERVER-side per-shard generation check firing."""
+    from tpujob.kube.fencing import FencedTransport
+
+    probe_pod = {"metadata": {"name": f"{prefix}-shard-zombie",
+                              "namespace": "default"}}
+    probes = rejected = inconclusive = 0
+    for token in stale_tokens:
+        zt = FencedTransport(chaos, fence=lambda t=token: t)
+        for op in (
+            lambda t=zt: t.create("pods", dict(probe_pod)),
+            lambda t=zt: t.delete("pods", "default", f"{prefix}-shard-zombie"),
+        ):
+            probes += 1
+            verdict = _fence_probe(op)
+            if verdict == "rejected":
+                rejected += 1
+            elif verdict == "inconclusive":
+                inconclusive += 1
+    return {"probes": probes, "rejected": rejected,
+            "inconclusive": inconclusive}
+
+
+def run_shard_soak(
+    seed: int,
+    config: Optional[ChaosConfig] = None,
+    cases: Optional[List[JobCase]] = None,
+    controllers: int = SHARD_SOAK_CONTROLLERS,
+    shard_count: int = SHARD_SOAK_SHARDS,
+    member_events: int = 3,
+    storm_kills: int = 4,
+    timeout: float = 90.0,
+    opt_overrides: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Sharded-control-plane soak: a fleet of N controllers under a seeded
+    membership storm (hard kills, graceful flaps, rejoins) on top of the
+    API fault schedule and the kubelet preemption storm.
+
+    Invariants, on top of the standard set:
+
+    8. every job was synced by exactly one owner per shard-lease
+       generation (the server's accepted-write ledger shows ONE holder per
+       (lease, generation) term, and one shard lease per job ever);
+    7'. zero writes accepted from a deposed shard owner — resurrected
+       stale shard tokens are rejected by the per-shard server-side check;
+    9. after membership settles, no shard is orphaned: every shard lease
+       is held unexpired by a live member, and the live members' ownership
+       partitions the shard space;
+    and the whole matrix converges despite the rebalance churn.
+
+    Runs under the lock-order sentinel (see :func:`run_soak`).
+    """
+    with lockgraph.audit():
+        report = _run_shard_soak_inner(seed, config, cases, controllers,
+                                       shard_count, member_events,
+                                       storm_kills, timeout, opt_overrides)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_shard_soak_inner(
+    seed: int,
+    config: Optional[ChaosConfig],
+    cases: Optional[List[JobCase]],
+    controllers: int,
+    shard_count: int,
+    member_events: int,
+    storm_kills: int,
+    timeout: float,
+    opt_overrides: Optional[Dict[str, Any]],
+) -> Dict[str, Any]:
+    prefix, cases, inner, chaos, admin, tracker, scripts = _soak_harness(
+        seed, "h", config, cases, fence=True)
+    rng = random.Random(f"{seed}:shard-storm")
+    started = time.monotonic()
+    trace_started0, trace_closed0 = TRACER.counters()
+
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts)
+    apps = [_start_app(chaos, opt_overrides, shards=shard_count)
+            for _ in range(controllers)]
+    live = list(apps)
+    stopped: set = set()  # apps already hard-killed or shut down
+
+    def _full_coverage() -> bool:
+        owned: Dict[int, int] = {}
+        for a in live:
+            for s in a.coordinator.owned_shards():
+                owned[s] = owned.get(s, 0) + 1
+        return (len(owned) == shard_count
+                and all(n == 1 for n in owned.values()))
+
+    if not _wait_for(_full_coverage, 15):
+        raise AssertionError(
+            f"seed {seed}: fleet never reached full disjoint shard coverage")
+    kubelet.start()
+    storm = PreemptionStorm(admin, seed, kills=storm_kills,
+                            prefix=prefix).start()
+    stale_tokens: List[Any] = []
+    membership_log: List[Dict[str, str]] = []
+    try:
+        for case in cases:
+            admin.tpujobs.create(case.job)
+        # seeded membership storm; the first event is always a hard kill so
+        # every run exercises the lease-expiry takeover + stale-token path
+        actions = ["kill"] + [rng.choice(("kill", "flap"))
+                              for _ in range(max(0, member_events - 1))]
+        for action in actions:
+            time.sleep(rng.uniform(0.3, 0.9))
+            if action == "kill":
+                # kill a member that OWNS something: identities are random,
+                # so rendezvous can leave one member shardless, and a
+                # shardless victim would contribute no stale tokens to the
+                # zombie probes (probes==0 would fail the rejection gate)
+                pool = [a for a in live if a.coordinator.owned_shards()] or live
+            else:
+                pool = live
+            victim = pool[rng.randrange(len(pool))]
+            if action == "kill":
+                # capture the victim's live shard tokens BEFORE the kill:
+                # these are the stale beliefs the zombie probes resurrect
+                stale_tokens.extend(
+                    t for t in (victim.coordinator.token_for_shard(s)
+                                for s in victim.coordinator.owned_shards())
+                    if t is not None)
+                victim.hard_kill()
+            else:
+                # flap: graceful leave (drain-before-release handoff) with a
+                # rejoin inside the same lease term — membership churns twice
+                # before the first change's rebalance can even settle
+                victim.shutdown()
+            stopped.add(id(victim))
+            live.remove(victim)
+            membership_log.append(
+                {"action": action, "member": victim.coordinator.identity})
+            if action == "kill":
+                time.sleep(rng.uniform(0.05, 0.3))  # headless window
+            replacement = _start_app(chaos, opt_overrides, shards=shard_count)
+            live.append(replacement)
+            apps.append(replacement)
+
+        deadline = started + timeout
+        _converge_or_fail(admin, cases, deadline, seed,
+                          f" within {timeout}s across {len(actions)} "
+                          "membership event(s)")
+        storm.stop()
+        shards_effective = live[0].coordinator.num_shards
+        problems = _settle_shard_invariants(
+            admin, live, cases, tracker, chaos, inner, shards_effective,
+            deadline)
+
+        fence = _probe_stale_shard_tokens(chaos, prefix, stale_tokens)
+        accepted = fence["probes"] - fence["rejected"] - fence["inconclusive"]
+        if accepted:
+            problems.append(
+                f"shard fencing: {accepted} of {fence['probes']} deposed-"
+                "owner writes were ACCEPTED")
+        if fence["probes"] and fence["rejected"] == 0:
+            problems.append(
+                "shard fencing: no stale-token probe produced a rejection "
+                f"verdict ({fence['inconclusive']} of {fence['probes']} "
+                "inconclusive under chaos)")
+        if any(p.metadata.name == f"{prefix}-shard-zombie"
+               for p in admin.pods.list()):
+            problems.append(
+                "shard fencing: zombie probe pod was committed to the server")
+        if problems:
+            raise AssertionError(
+                f"seed {seed}: shard invariants violated:\n  "
+                + "\n  ".join(problems))
+        report = {
+            "mode": "shard",
+            "seed": seed,
+            "jobs": len(cases),
+            "controllers": controllers,
+            "shards": shards_effective,
+            "membership_events": membership_log,
+            "members_total": len(apps),
+            "rebalances": sum(a.coordinator.rebalances for a in apps),
+            "duration_s": round(time.monotonic() - started, 3),
+            "api_faults": len(chaos.injected),
+            "storm_strikes": storm.struck,
+            "fence": {
+                **fence,
+                "server_checked": inner.fence_checked,
+                "server_rejections": len(inner.fence_rejections),
+                "accepted_writes": len(inner.fence_accepts),
+            },
+            "invariants": "ok",
+        }
+    finally:
+        storm.stop()
+        kubelet.stop()
+        for a in apps:
+            if id(a) in stopped:
+                continue
+            if a in live:
+                a.shutdown()
+            else:
+                a.hard_kill()
+    # per-job timelines are spread across member incarnations by design;
+    # only the process-wide root-span ledger must balance (crash-soak rule)
+    trace_problems, trace_stats = check_trace_ledger(trace_started0,
+                                                     trace_closed0)
+    if trace_problems:
+        raise AssertionError(
+            f"seed {seed}: trace ledger violated across the shard storm:\n  "
+            + "\n  ".join(trace_problems))
+    report["trace"] = trace_stats
+    return report
+
+
+def run_shard_smoke(
+    seed: int = 23,
+    shard_count: int = SHARD_SOAK_SHARDS,
+    lease_duration: float = 1.0,
+    absorb_slack: float = 1.0,
+    timeout: float = 30.0,
+) -> Dict[str, Any]:
+    """The fast single-seed slice of the shard acceptance gate (``make
+    shard-smoke``): 2 controllers split the shard space, one is hard-killed
+    mid-run, and the survivor must absorb every shard within one lease term
+    (+ scheduling slack) with no double-sync — asserted over the server's
+    accepted-write ledger — and every resurrected stale shard token must be
+    rejected server-side.  No API faults: a failure points straight at the
+    membership/handoff machinery.
+
+    Runs under the lock-order sentinel (see :func:`run_soak`).
+    """
+    with lockgraph.audit():
+        report = _run_shard_smoke_inner(seed, shard_count, lease_duration,
+                                        absorb_slack, timeout)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_shard_smoke_inner(
+    seed: int,
+    shard_count: int,
+    lease_duration: float,
+    absorb_slack: float,
+    timeout: float,
+) -> Dict[str, Any]:
+    no_faults = ChaosConfig(
+        error_rate=0.0, timeout_rate=0.0, conflict_rate=0.0, latency_rate=0.0,
+        kill_watch_every=0, compact_every=0, duplicate_event_rate=0.0,
+    )
+    # reduced matrix: the master+worker TTL case (cleanup/GC crosses the
+    # handoff) and the ExitCode restart case (controller-owned restarts
+    # must respect the inherited crash-loop damper)
+    cases = matrix(f"m{seed}")[:2]
+    prefix, cases, inner, chaos, admin, tracker, scripts = _soak_harness(
+        seed, "m", no_faults, cases, fence=True)
+    rng = random.Random(f"{seed}:shard-smoke")
+    started = time.monotonic()
+    overrides = {"lease_duration_s": lease_duration}
+
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts)
+    apps = [_start_app(chaos, overrides, shards=shard_count)
+            for _ in range(2)]
+    live = list(apps)
+
+    def _full_coverage() -> bool:
+        owned: Dict[int, int] = {}
+        for a in live:
+            for s in a.coordinator.owned_shards():
+                owned[s] = owned.get(s, 0) + 1
+        return (len(owned) == shard_count
+                and all(n == 1 for n in owned.values()))
+
+    if not _wait_for(_full_coverage, 15):
+        raise AssertionError(
+            f"seed {seed}: 2-member fleet never split the shard space")
+    kubelet.start()
+    storm = PreemptionStorm(admin, seed, kills=2, prefix=prefix).start()
+    try:
+        for case in cases:
+            admin.tpujobs.create(case.job)
+        time.sleep(rng.uniform(0.3, 0.8))
+        # only a shard-owning member yields stale tokens for the probe
+        # gate (random identities can rendezvous one member to zero shards)
+        candidates = [a for a in apps if a.coordinator.owned_shards()] or apps
+        victim = candidates[rng.randrange(len(candidates))]
+        survivor = apps[1 - apps.index(victim)]
+        stale_tokens = [t for t in (victim.coordinator.token_for_shard(s)
+                                    for s in victim.coordinator.owned_shards())
+                        if t is not None]
+        kill_at = time.monotonic()
+        victim.hard_kill()
+        live.remove(victim)
+        if not _wait_for(
+                lambda: len(survivor.coordinator.owned_shards()) == shard_count,
+                lease_duration + absorb_slack + 5):
+            raise AssertionError(
+                f"seed {seed}: survivor never absorbed the killed member's "
+                f"shards (owns {survivor.coordinator.owned_shards()})")
+        absorb_s = time.monotonic() - kill_at
+        if absorb_s > lease_duration + absorb_slack:
+            raise AssertionError(
+                f"seed {seed}: shard absorption took {absorb_s:.2f}s, over "
+                f"one lease term ({lease_duration}s) + slack {absorb_slack}s")
+
+        deadline = started + timeout
+        _converge_or_fail(admin, cases, deadline, seed,
+                          f" within {timeout}s after the member kill")
+        storm.stop()
+        problems = _settle_shard_invariants(
+            admin, live, cases, tracker, chaos, inner,
+            survivor.coordinator.num_shards, deadline)
+        fence = _probe_stale_shard_tokens(chaos, prefix, stale_tokens)
+        if fence["rejected"] != fence["probes"] or not fence["probes"]:
+            problems.append(
+                f"shard fencing: {fence['rejected']}/{fence['probes']} "
+                "stale-token probes rejected (want all, and at least one)")
+        if problems:
+            raise AssertionError(
+                f"seed {seed}: shard smoke invariants violated:\n  "
+                + "\n  ".join(problems))
+        return {
+            "mode": "shard-smoke",
+            "seed": seed,
+            "jobs": len(cases),
+            "shards": shard_count,
+            "lease_duration_s": lease_duration,
+            "absorb_s": round(absorb_s, 3),
+            "rebalances": sum(a.coordinator.rebalances for a in apps),
+            "duration_s": round(time.monotonic() - started, 3),
+            "fence": {
+                **fence,
+                "server_rejections": len(inner.fence_rejections),
+                "accepted_writes": len(inner.fence_accepts),
+            },
+            "invariants": "ok",
+        }
+    finally:
+        storm.stop()
+        kubelet.stop()
+        for a in apps:
+            if a in live:
+                a.shutdown()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     import json
 
     parser = argparse.ArgumentParser(description="one seeded chaos soak run")
     parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--mode", choices=("api", "crash", "failover"),
+    parser.add_argument("--mode", choices=("api", "crash", "failover", "shard"),
                         default="api",
                         help="api = transport faults only; crash = + seeded "
                              "controller kills; failover = warm-standby "
-                             "leader kill + fencing probes")
+                             "leader kill + fencing probes; shard = N-member "
+                             "sharded fleet under a membership storm")
     parser.add_argument("--storm-kills", type=int, default=6)
     parser.add_argument("--timeout", type=float, default=60.0)
     parser.add_argument("--verbose", action="store_true")
@@ -1071,6 +1554,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.mode == "failover":
         report = run_failover_soak(args.seed, storm_kills=args.storm_kills,
                                    timeout=args.timeout)
+    elif args.mode == "shard":
+        report = run_shard_soak(args.seed, storm_kills=args.storm_kills,
+                                timeout=args.timeout)
     else:
         report = run_soak(args.seed, storm_kills=args.storm_kills,
                           timeout=args.timeout)

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Shard smoke: kill one of two sharded controllers; the survivor absorbs.
+
+The fast single-seed slice of the sharded-control-plane acceptance gate
+(``make shard-smoke``, wired as a ``make test`` prerequisite; budget ~10 s):
+
+- two operator instances join the shard fleet (consistent-hash job shards,
+  one fencing lease per shard, rendezvous assignment) over an in-memory API
+  server with server-side per-shard fence validation;
+- a reduced two-job matrix runs while one member is hard-killed WITHOUT
+  releasing its member or shard leases;
+- the survivor must absorb every one of the dead member's shards within
+  ONE lease term (+ scheduling slack);
+- the server's accepted-write ledger must show exactly one holder per
+  (shard lease, generation) term — no instant with two members syncing one
+  job — and every resurrected stale shard token must be rejected by the
+  server-side per-shard generation check.
+
+No API-transport faults here — the full fault mix plus membership storms
+run in ``make soak`` (shard tier); this smoke isolates the
+membership/handoff machinery so a failure points straight at it.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from e2e.chaos import run_shard_smoke
+
+
+def main() -> int:
+    logging.disable(logging.CRITICAL)  # the kill makes ERROR lines pure noise
+    report = run_shard_smoke(seed=23)
+    fence = report["fence"]
+    assert report["invariants"] == "ok"
+    assert fence["rejected"] == fence["probes"] > 0, fence
+    assert fence["server_rejections"] > 0, fence
+    print(f"shard-smoke: OK (jobs={report['jobs']} shards={report['shards']} "
+          f"absorb={report['absorb_s']}s of {report['lease_duration_s']}s "
+          f"lease term, rebalances={report['rebalances']}, "
+          f"fence_rejected={fence['rejected']}/{fence['probes']} "
+          f"in {report['duration_s']}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,11 +8,15 @@ history compaction, duplicate events, and a kubelet-level preemption storm.
 Every run must converge and hold the system invariants; the same seed
 reproduces the same fault schedule byte for byte.
 
-``--crash`` adds the controller-lifecycle tier per seed: a seeded schedule
-of controller hard-kills + cold restarts (``run_crash_soak``) and a
+``--crash`` adds the controller-lifecycle tiers per seed: a seeded schedule
+of controller hard-kills + cold restarts (``run_crash_soak``), a
 two-candidate warm-standby failover with write-fencing probes
-(``run_failover_soak``) — the crash-only acceptance gate: all invariants
-hold across every kill, and zero writes are accepted from a fenced leader.
+(``run_failover_soak``), and the sharded-control-plane storm
+(``run_shard_soak``: 3 controllers sharding the job set under member
+kill/flap/rejoin churn) — the crash-only acceptance gate: all invariants
+hold across every kill, zero writes are accepted from a fenced leader or a
+deposed shard owner, and every job is synced by exactly one owner per
+shard-lease generation.
 
 Usage:
     python soak.py                      # default 5 seeds x 5 jobs = 25 jobs
@@ -31,7 +35,7 @@ import sys
 import time
 from typing import List, Optional
 
-from e2e.chaos import run_crash_soak, run_failover_soak, run_soak
+from e2e.chaos import run_crash_soak, run_failover_soak, run_shard_soak, run_soak
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -64,6 +68,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         runs.append(("crash", lambda seed: run_crash_soak(
             seed, storm_kills=args.storm_kills, timeout=args.timeout)))
         runs.append(("failover", lambda seed: run_failover_soak(
+            seed, storm_kills=args.storm_kills, timeout=args.timeout)))
+        runs.append(("shard", lambda seed: run_shard_soak(
             seed, storm_kills=args.storm_kills, timeout=args.timeout)))
 
     failures = 0

@@ -8,6 +8,7 @@ name/label/expectation-key conventions shared by reconciler and tests.
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import time
@@ -281,6 +282,18 @@ class JobController:
         self._first_sync_recorded = False  # guarded by self._cold_start_lock
         self._cold_start_lock = lockgraph.new_lock("cold-start")
 
+        # sharded control plane (PR 8): when a sharder (ShardCoordinator
+        # surface: shard_of_uid / is_active / sync_shard_context) is set,
+        # this controller is one fleet member — it enqueues and syncs only
+        # the job shards its coordinator currently owns, and each sync runs
+        # under the shard's fencing context.  None = the single-controller
+        # world, zero behavior change.
+        self.sharder = None
+        self._inflight_lock = lockgraph.new_lock("shard-inflight")
+        # keys currently mid-sync per shard: the drain barrier the handoff
+        # protocol waits on before a shard lease may be released
+        self._inflight_by_shard: Dict[int, set] = {}  # guarded by self._inflight_lock
+
         self.job_informer = self.factory.informer(RESOURCE_TPUJOBS)
         self.pod_informer = self.factory.informer(RESOURCE_PODS)
         self.service_informer = self.factory.informer(RESOURCE_SERVICES)
@@ -301,7 +314,110 @@ class JobController:
         meta = obj.get("metadata") or {}
         return f"{meta.get('namespace') or 'default'}/{meta.get('name')}"
 
+    # ------------------------------------------------------------------
+    # sharding (PR 8): ownership resolution, sync context, drain barrier
+    # ------------------------------------------------------------------
+
+    def set_sharder(self, sharder) -> None:
+        """Attach the shard coordinator BEFORE run(): every enqueue and
+        dequeue from then on is filtered to the shards it owns."""
+        self.sharder = sharder
+
+    def _shard_of_obj(self, obj: Optional[Dict[str, Any]]) -> Optional[int]:
+        """The shard a job object lives in (consistent hash of its UID), or
+        None when unsharded / the object carries no UID."""
+        if self.sharder is None or obj is None:
+            return None
+        uid = (obj.get("metadata") or {}).get("uid") or ""
+        return self.sharder.shard_of_uid(uid) if uid else None
+
+    def _shard_of_key(self, key: str) -> Optional[int]:
+        if self.sharder is None:
+            return None
+        ns, _, name = key.partition("/")
+        return self._shard_of_obj(self.job_informer.store.get(ns or "default", name))
+
+    def _owns_key(self, key: str) -> bool:
+        """Does this member currently sync ``key``?  Unsharded = always.
+        A key whose job is gone from the cache resolves to True everywhere:
+        the sync is a cheap cache-miss no-op, and dropping it could strand
+        a deletion cleanup."""
+        if self.sharder is None:
+            return True
+        shard = self._shard_of_key(key)
+        if shard is None:
+            return True
+        return self.sharder.is_active(shard)
+
+    def _shard_call_context(self, shard: Optional[int]):
+        """Bind the in-flight work to its shard so every mutating API call
+        underneath carries the shard's fencing token (the PR-4 call-token
+        pattern, per shard)."""
+        if self.sharder is None:
+            return contextlib.nullcontext()
+        return self.sharder.sync_shard_context(shard)
+
+    def _shard_inflight_add(self, shard: Optional[int], key: str) -> None:
+        if shard is None:
+            return
+        with self._inflight_lock:
+            self._inflight_by_shard.setdefault(shard, set()).add(key)
+
+    def _shard_inflight_remove(self, shard: Optional[int], key: str) -> None:
+        if shard is None:
+            return
+        with self._inflight_lock:
+            keys = self._inflight_by_shard.get(shard)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    self._inflight_by_shard.pop(shard, None)
+
+    def drain_shard(self, shard: int, timeout: float = 5.0) -> bool:
+        """The handoff protocol's drain barrier: wait until no sync of the
+        shard's jobs is in flight.  The coordinator marks the shard
+        *draining* BEFORE calling this, so dequeues of its keys are being
+        dropped and the wait is bounded by the one in-flight sync per key
+        the workqueue allows.  Returns False on timeout (a wedged sync):
+        the caller must then let the shard lease expire instead of
+        releasing it."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._inflight_lock:
+                busy = bool(self._inflight_by_shard.get(shard))
+            if not busy:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+
+    def enqueue_shard(self, shard: int) -> int:
+        """Acquisition replay: enqueue every cached job of a just-acquired
+        shard.  Events for these jobs were filtered while another member
+        owned the shard; the shared informer cache (every member watches
+        everything) makes the replay complete without any API traffic."""
+        if self.sharder is None:
+            return 0
+        n = 0
+        for obj in self.job_informer.store.list():
+            if self._shard_of_obj(obj) == shard:
+                self.enqueue_job(self.job_key_of(obj))
+                n += 1
+        return n
+
+    def on_shard_acquired(self, shard: int) -> None:
+        """Hook the coordinator invokes right after a shard turned active
+        (post-activation half of acquisition; the pre-activation half is
+        the reconciler's ``prepare_shard``)."""
+        n = self.enqueue_shard(shard)
+        self.flight.record(
+            CONTROLLER_TIMELINE_KEY, "shard",
+            f"shard {shard} acquired: {n} cached job(s) enqueued",
+            {"shard": shard, "jobs": n})
+
     def enqueue_job(self, key: str) -> None:
+        if not self._owns_key(key):
+            return  # another member's shard: its owner syncs it
         self.queue.add(key)
 
     def enqueue_job_event(self, key: str) -> None:
@@ -311,6 +427,8 @@ class JobController:
         or an event-storm replay — costs a handful of syncs, not one per
         event.  Direct workflow enqueues (job creation, resync, deadline
         requeues) stay immediate via :meth:`enqueue_job`."""
+        if not self._owns_key(key):
+            return  # informer event filtering by owned shards
         self.queue.add_coalesced(key, self.config.settle_window_s)
 
     # ------------------------------------------------------------------
@@ -532,10 +650,31 @@ class JobController:
         if key is None:
             return True
         metrics.queue_depth.set(len(self.queue))
+        shard = self._shard_of_key(key)
+        # register in-flight BEFORE the ownership check: the coordinator
+        # marks a shard draining and THEN polls the in-flight set, so a
+        # check-then-register order would let a drain observe "no sync in
+        # flight" in the instant between our passing check and our
+        # registration — and release the lease under a sync that is about
+        # to write.  Registered first, either our check sees the drain (we
+        # drop below) or the drain sees us (it waits us out).
+        self._shard_inflight_add(shard, key)
+        if (self.sharder is not None and shard is not None
+                and not self.sharder.is_active(shard)):
+            # rebalanced away (or draining) between enqueue and dequeue:
+            # drop WITHOUT syncing.  The shard's new owner enqueues every
+            # cached job of the shard at acquisition, so nothing is lost —
+            # and syncing here would be exactly the two-owners window the
+            # handoff protocol exists to close.
+            self._shard_inflight_remove(shard, key)
+            self.queue.pop_due(key)
+            self.queue.forget(key)
+            self.queue.done(key)
+            return True
         due = self.queue.pop_due(key)
         start = time.monotonic()
         ctx = TRACER.sync_root("sync", job=key)
-        with ctx as root:
+        with self._shard_call_context(shard), ctx as root:
             try:
                 # a missing stamp means the key was dirty-requeued at done()
                 # while its stamp was being consumed (watch-event re-add
@@ -563,6 +702,12 @@ class JobController:
                 self.queue.add_rate_limited(key)
             finally:
                 metrics.reconcile_duration.observe(time.monotonic() - start)
+                # deregister BEFORE done(): done() is what makes a
+                # dirty-requeued key dequeueable again, and the next worker
+                # registers itself before syncing — remove-after-done would
+                # let our removal delete THAT worker's (set-shared) entry
+                # and blind the drain barrier to its in-flight sync
+                self._shard_inflight_remove(shard, key)
                 self.queue.done(key)
         try:
             if synced_ok:

@@ -144,7 +144,64 @@ class TPUJobController(JobController):
                 f"{seeded} replica type(s)",
                 {"stage": "damper_rebuild", "seeded": seeded})
 
-    def _rebuild_restart_backoff(self) -> int:
+    def prepare_shard(self, shard: int) -> None:
+        """Shard-acquisition hook (pre-activation): rebuild the crash-loop
+        damper for the shard's jobs from durable status BEFORE any worker
+        can sync them.  A rebalanced-in shard must not prompt-restart a
+        crash-looping job it just inherited — the previous owner's damper
+        died with its ownership, exactly as a cold-started controller's
+        damper dies with its process, and the cold-start rebuild only ran
+        for the shards owned back then."""
+        seeded = self._rebuild_restart_backoff(shard=shard)
+        if seeded:
+            from tpujob.obs.recorder import CONTROLLER_TIMELINE_KEY
+
+            self.flight.record(
+                CONTROLLER_TIMELINE_KEY, "shard",
+                f"shard {shard}: restart-backoff damper reconstructed from "
+                f"status for {seeded} replica type(s)",
+                {"shard": shard, "seeded": seeded})
+
+    def on_shard_acquired(self, shard: int) -> None:
+        """One combined post-activation pass over the inherited shard's
+        jobs (a rebalance storm acquires many shards back to back, and each
+        extra full-store scan rides the coordinator thread that also
+        heartbeats under a sub-second soak lease): enqueue the replay AND
+        re-arm the ActiveDeadlineSeconds requeues — the add_after the
+        previous owner scheduled at job creation died with it, and at the
+        production 12h resync a deadline could otherwise slip by hours
+        before the next event surfaces it."""
+        enqueued = 0
+        for obj in self.job_informer.store.list():
+            if self._shard_of_obj(obj) != shard:
+                continue
+            self.enqueue_job(self.job_key_of(obj))
+            enqueued += 1
+            try:
+                job = TPUJob.from_dict(obj)
+                set_defaults_tpujob(job)
+            except (TypeError, ValueError):
+                continue  # malformed CR: the enqueue replay's sync reports it
+            if st.is_finished(job.status):
+                continue
+            ads = job.spec.run_policy.active_deadline_seconds
+            if ads is None or ads < 0:
+                continue
+            started = _parse_time(job.status.start_time)
+            # wall-vs-persisted-timestamp math like _past_active_deadline
+            # (the two baselined TPL004 sites): status.startTime was written
+            # by another process's wall clock, so monotonic cannot compare
+            remaining = (float(ads) if started is None
+                         else max(0.0, started + float(ads) - time.time()))  # noqa: TPL004
+            self.queue.add_after(job.key, remaining)
+        from tpujob.obs.recorder import CONTROLLER_TIMELINE_KEY
+
+        self.flight.record(
+            CONTROLLER_TIMELINE_KEY, "shard",
+            f"shard {shard} acquired: {enqueued} cached job(s) enqueued",
+            {"shard": shard, "jobs": enqueued})
+
+    def _rebuild_restart_backoff(self, shard: Optional[int] = None) -> int:
         base = self.config.restart_backoff_seconds
         if base <= 0:
             return 0
@@ -152,6 +209,8 @@ class TPUJobController(JobController):
         now_mono, now_wall = time.monotonic(), time.time()
         seeded = 0
         for obj in self.job_informer.store.list():
+            if shard is not None and self._shard_of_obj(obj) != shard:
+                continue  # per-shard rebuild: only the acquired shard's jobs
             try:
                 job = TPUJob.from_dict(obj)
                 set_defaults_tpujob(job)
@@ -204,6 +263,13 @@ class TPUJobController(JobController):
 
     def _on_job_add(self, obj: Dict) -> None:
         key = self.job_key_of(obj)
+        shard = self._shard_of_obj(obj)
+        if (self.sharder is not None and shard is not None
+                and not self.sharder.is_active(shard)):
+            # another member's shard: its owner enqueues, schedules the
+            # deadline requeue, and reports malformation — doing any of it
+            # here would double the work (and the writes) fleet-wide
+            return
         try:
             job = TPUJob.from_dict(obj)
             set_defaults_tpujob(job)
@@ -213,8 +279,10 @@ class TPUJobController(JobController):
             job = None
         if errs:
             # malformed CR: write a Failed condition back instead of crashing
-            # (job.go:60-111 / informer.go:83-104 tolerance semantics)
-            self._fail_malformed(obj, errs)
+            # (job.go:60-111 / informer.go:83-104 tolerance semantics).  The
+            # shard context fences the write on this shard's lease.
+            with self._shard_call_context(shard):
+                self._fail_malformed(obj, errs)
             return
         metrics.jobs_created.inc()
         self.enqueue_job(key)

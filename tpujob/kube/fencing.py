@@ -39,13 +39,24 @@ from tpujob.server import metrics
 
 @dataclass(frozen=True)
 class FencingToken:
-    """One acquisition's identity: (holder, lease generation)."""
+    """One acquisition's identity: (holder, lease generation).
+
+    ``lease`` names the lease object the token claims.  The single-leader
+    token leaves it empty (a fence-validating server then checks the lease
+    it was configured with, the PR-4 contract); a **per-shard** token names
+    its shard lease (``tpujob-shard-<i>``), so one server validates every
+    shard's fencing independently — a deposed shard owner's stale
+    generation is rejected for exactly the shard it lost, while its other
+    shards (if any) keep writing.
+    """
 
     holder: str
     generation: int
+    lease: str = ""
 
     def __str__(self) -> str:
-        return f"{self.holder}@gen{self.generation}"
+        scope = f"{self.lease}:" if self.lease else ""
+        return f"{scope}{self.holder}@gen{self.generation}"
 
 
 # The token accompanying the current mutating call, if any.  Set by

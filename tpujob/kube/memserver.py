@@ -182,6 +182,14 @@ class InMemoryAPIServer:
         self._fence_lease: Optional[Tuple[str, str]] = None  # guarded by self._lock
         self.fence_checked = 0  # guarded by self._lock
         self.fence_rejections: List[Tuple[str, str, str]] = []  # guarded by self._lock; (verb, resource, token)
+        # accepted token-carrying writes: (verb, resource, "ns/name",
+        # lease name, holder, generation).  The empirical exactly-one-
+        # owner-per-generation ledger the shard soaks assert over; the
+        # object key is namespace-qualified so two same-named jobs in
+        # different namespaces can never be conflated.  Only populated
+        # while fence validation is enabled (test harnesses), so growth is
+        # bounded by one soak's write count.
+        self.fence_accepts: List[Tuple[str, str, str, str, str, int]] = []  # guarded by self._lock
 
     # -- write fencing (server-side validation) -----------------------------
 
@@ -196,7 +204,14 @@ class InMemoryAPIServer:
         with self._lock:
             self._fence_lease = (namespace or "default", name)
 
-    def _fence_check(self, verb: str, resource: str) -> None:  # caller holds self._lock
+    @staticmethod
+    def _fence_obj_key(obj: Dict[str, Any]) -> str:
+        """Namespace-qualified object key for the fence-accepts ledger."""
+        meta = obj.get("metadata") or {}
+        return f"{meta.get('namespace') or 'default'}/{meta.get('name')}"
+
+    def _fence_check(self, verb: str, resource: str,  # caller holds self._lock
+                     name: Optional[str] = None) -> None:
         if self._fence_lease is None or resource == "leases":
             return  # lease writes ARE the election; never fence them
         from tpujob.kube.fencing import current_call_token
@@ -205,8 +220,12 @@ class InMemoryAPIServer:
         if token is None:
             return
         self.fence_checked += 1
-        ns, name = self._fence_lease
-        lease = self._store("leases").objects.get((ns, name))
+        ns, default_lease = self._fence_lease
+        # a per-shard token names the shard lease it claims (PR 8); the
+        # single-leader token leaves it empty and validates against the
+        # configured lease, exactly the PR-4 contract
+        lease_name = getattr(token, "lease", "") or default_lease
+        lease = self._store("leases").objects.get((ns, lease_name))
         spec = (lease or {}).get("spec") or {}
         holder = spec.get("holderIdentity")
         generation = int(spec.get("leaseTransitions") or 0)
@@ -214,7 +233,11 @@ class InMemoryAPIServer:
             self.fence_rejections.append((verb, resource, str(token)))
             raise FencedError(
                 f"fencing: {verb} {resource} rejected: token {token} is stale "
-                f"(lease holder={holder!r} generation={generation})")
+                f"(lease {lease_name} holder={holder!r} "
+                f"generation={generation})")
+        self.fence_accepts.append(
+            (verb, resource, name or "", lease_name, token.holder,
+             token.generation))
 
     # -- pod logs (the read_namespaced_pod_log analog) -----------------------
 
@@ -372,7 +395,7 @@ class InMemoryAPIServer:
 
     def create(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
         with self._lock:
-            self._fence_check("create", resource)
+            self._fence_check("create", resource, name=self._fence_obj_key(obj))
             obj = copy.deepcopy(obj)
             key = self._key(obj)
             store = self._store(resource)
@@ -498,7 +521,7 @@ class InMemoryAPIServer:
 
     def update(self, resource: str, obj: Dict[str, Any]) -> Dict[str, Any]:
         with self._lock:
-            self._fence_check("update", resource)
+            self._fence_check("update", resource, name=self._fence_obj_key(obj))
             obj = copy.deepcopy(obj)
             key = self._key(obj)
             store = self._store(resource)
@@ -528,7 +551,8 @@ class InMemoryAPIServer:
         (e.g. reset the cumulative ``restarts`` counter).  No RV provided =
         unconditional write (the malformed-CR write-back path)."""
         with self._lock:
-            self._fence_check("update_status", resource)
+            self._fence_check("update_status", resource,
+                              name=self._fence_obj_key(obj))
             key = self._key(obj)
             current = self._store(resource).objects.get(key)
             if current is None:
@@ -566,7 +590,8 @@ class InMemoryAPIServer:
         that touches only derived fields no longer 409s against concurrent
         spec/metadata writers the way a full-object PUT does."""
         with self._lock:
-            self._fence_check("patch_status", resource)
+            self._fence_check("patch_status", resource,
+                              name=f"{namespace or 'default'}/{name}")
             key = (namespace or "default", name)
             current = self._store(resource).objects.get(key)
             if current is None:
@@ -591,7 +616,8 @@ class InMemoryAPIServer:
     def patch(self, resource: str, namespace: str, name: str, patch: Dict[str, Any]) -> Dict[str, Any]:
         """Strategic-merge-ish patch (recursive dict merge; lists replaced)."""
         with self._lock:
-            self._fence_check("patch", resource)
+            self._fence_check("patch", resource,
+                              name=f"{namespace or 'default'}/{name}")
             key = (namespace or "default", name)
             current = self._store(resource).objects.get(key)
             if current is None:
@@ -605,7 +631,8 @@ class InMemoryAPIServer:
 
     def delete(self, resource: str, namespace: str, name: str) -> None:
         with self._lock:
-            self._fence_check("delete", resource)
+            self._fence_check("delete", resource,
+                              name=f"{namespace or 'default'}/{name}")
             key = (namespace or "default", name)
             popped = self._store(resource).objects.pop(key, None)
             if popped is None:

@@ -88,10 +88,30 @@ class OperatorApp:
         # writing.  Both ride kill switches so hard_kill() can sever them
         # mid-sync, the way a SIGKILL severs a real process's sockets.
         self.elector: Optional[LeaderElector] = None
+        self.coordinator = None  # ShardCoordinator in sharded mode
         self._controller_kill_switch = KillSwitchTransport(self.transport)
         self._elector_kill_switch = KillSwitchTransport(self.transport)
         controller_transport = self._controller_kill_switch
-        if opt.enable_leader_election:
+        if opt.shard_count > 0:
+            # sharded control plane (--shards N): membership + per-shard
+            # fencing leases replace the single-leader election; every
+            # member runs its informers and syncs only the shards it owns,
+            # with each sync's writes fenced on that shard's lease
+            from tpujob.server.sharding import ShardCoordinator
+
+            self.coordinator = ShardCoordinator(
+                self._elector_kill_switch,
+                num_shards=opt.shard_count,
+                namespace=self.lease_namespace(),
+                lease_duration=opt.lease_duration_s,
+                retry_period=opt.retry_period_s,
+                drain_timeout=opt.shard_drain_timeout_s,
+            )
+            if opt.enable_fencing:
+                controller_transport = FencedTransport(
+                    self._controller_kill_switch,
+                    fence=self.coordinator.current_call_token)
+        elif opt.enable_leader_election:
             self.elector = LeaderElector(
                 self._elector_kill_switch,
                 lock_name=opt.leader_election_id,
@@ -128,10 +148,19 @@ class OperatorApp:
                 cache_sync_timeout_s=opt.cache_sync_timeout_s,
             ),
         )
+        if self.coordinator is not None:
+            # the coordinator's acquisition/handoff hooks are the
+            # controller's: damper rebuild pre-activation, enqueue replay
+            # post-activation, drain barrier pre-release
+            self.controller.set_sharder(self.coordinator)
+            self.coordinator.on_shard_prepare = self.controller.prepare_shard
+            self.coordinator.on_shard_acquired = self.controller.on_shard_acquired
+            self.coordinator.on_shard_drain = self.controller.drain_shard
         self.monitoring: Optional[MonitoringServer] = None
         self.stop_event = threading.Event()
         self.controller_threads: list = []
         self._elector_thread: Optional[threading.Thread] = None
+        self._coordinator_thread: Optional[threading.Thread] = None
         self._hard_killed = False
 
     def run(self, block: bool = True) -> None:
@@ -150,8 +179,10 @@ class OperatorApp:
                      self.monitoring.port)
 
         def start_controller():
-            log.info("leadership acquired; starting controller (threadiness=%d)",
-                     self.opt.threadiness)
+            log.info("starting controller (threadiness=%d%s)",
+                     self.opt.threadiness,
+                     f", shards={self.opt.shard_count}"
+                     if self.coordinator is not None else "")
             self.controller_threads = self.controller.run(
                 self.stop_event, threadiness=self.opt.threadiness)
 
@@ -187,7 +218,28 @@ class OperatorApp:
             log.error("leader election lost; exiting")
             self.stop_event.set()
 
-        if self.elector is not None:
+        if self.coordinator is not None:
+            # sharded fleet: the controller (informers + workers) starts
+            # unconditionally — the dequeue-time ownership check keeps
+            # unowned shards untouched — and the coordinator thread starts
+            # only AFTER the cache-sync barrier, so acquisition hooks
+            # (damper rebuild, enqueue replay) always read a synced cache
+            start_controller()
+            self.controller.flight.record(
+                CONTROLLER_TIMELINE_KEY, "shard",
+                f"{self.coordinator.identity} joined the shard fleet "
+                f"({self.coordinator.num_shards} shards)",
+                {"identity": self.coordinator.identity,
+                 "shards": self.coordinator.num_shards})
+            # start before publish: a shutdown racing construction must
+            # never join a created-but-unstarted Thread (TPL001)
+            coordinator_thread = threading.Thread(
+                target=self.coordinator.run, args=(self.stop_event,),
+                daemon=True, name="shard-coordinator",
+            )
+            coordinator_thread.start()
+            self._coordinator_thread = coordinator_thread
+        elif self.elector is not None:
             self.elector.on_started_leading = started_leading
             self.elector.on_stopped_leading = lost_leadership
             # start before publish: a shutdown racing construction must
@@ -242,6 +294,9 @@ class OperatorApp:
         # could read leading_thread/controller_threads before the upstream
         # thread published them and skip threads that are still starting.
         threads = []
+        if self._coordinator_thread is not None:
+            threads.append(self._coordinator_thread)
+            self._coordinator_thread.join(timeout=2)
         if self._elector_thread is not None:
             threads.append(self._elector_thread)
             self._elector_thread.join(timeout=2)
@@ -260,19 +315,23 @@ class OperatorApp:
         (zeroed holderIdentity) so a restarted or failed-over standby
         acquires immediately instead of waiting out ``lease_duration``."""
         drained = self._stop_threads()
-        if self.elector is not None and not self._hard_killed:
-            if drained:
-                # every thread is joined, so this cannot race an in-flight
-                # write OR the elector's own clean-stop release; idempotent
-                # once already released
+        if self._hard_killed:
+            return
+        if drained:
+            # every thread is joined, so this cannot race an in-flight
+            # write OR the elector's own clean-stop release; idempotent
+            # once already released
+            if self.elector is not None:
                 self.elector.release()
-            else:
-                # a worker outlived its join timeout (e.g. wedged in a slow
-                # API call): releasing now would invite a standby in while
-                # our write may still land — let the lease expire instead
-                log.warning(
-                    "threads still alive at shutdown; skipping early lease "
-                    "release (standby must wait out lease_duration)")
+            if self.coordinator is not None:
+                self.coordinator.release_all()
+        elif self.elector is not None or self.coordinator is not None:
+            # a worker outlived its join timeout (e.g. wedged in a slow
+            # API call): releasing now would invite a standby in while
+            # our write may still land — let the lease(s) expire instead
+            log.warning(
+                "threads still alive at shutdown; skipping early lease "
+                "release (standby must wait out lease_duration)")
 
     def hard_kill(self) -> None:
         """Crash simulation: stop every thread WITHOUT releasing the lease,

@@ -58,6 +58,135 @@ def parse_lease_time(value) -> Optional[float]:
     return dt.timestamp()
 
 
+def acquire_or_renew_lease(
+    server,
+    namespace: str,
+    name: str,
+    identity: str,
+    lease_duration: float,
+    renewing: bool = False,
+) -> Optional[int]:
+    """Try to take (or renew) the named lease for ``identity``.
+
+    Returns the lease generation (``leaseTransitions``) now held, or
+    ``None`` when another holder's unexpired lease stands (or the write
+    lost an optimistic-concurrency race).  ``renewing=True`` asserts the
+    caller believes it ALREADY holds this lease: its own record then
+    renews at a stable generation — a bump would fence the holder's own
+    in-flight writes.  Any fresh acquisition (expired/released lease, or
+    our own lease re-taken after a restart while not ``renewing``) bumps
+    the generation, so a paused twin can never mint the same token.
+
+    Transport errors propagate; callers own the retry cadence.  This is
+    the shared core of the single-leader elector and the per-shard leases
+    of the sharded control plane (``tpujob.server.sharding``).
+    """
+    now = time.time()
+    # typed coordination.k8s.io/v1 Lease wire format: MicroTime strings
+    # and integer seconds, so the record round-trips through a real
+    # apiserver (client-go resourcelock.LeaseLock semantics)
+    record = {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "holderIdentity": identity,
+            "leaseDurationSeconds": max(1, int(round(lease_duration))),
+            "acquireTime": rfc3339micro(now),
+            "renewTime": rfc3339micro(now),
+            "leaseTransitions": 0,
+        },
+    }
+    try:
+        current = server.get(RESOURCE_LEASES, namespace, name)
+    except NotFoundError:
+        try:
+            server.create(RESOURCE_LEASES, record)
+            return 0
+        except Exception as e:
+            # losing the create race (409) or a transient transport
+            # error: normal contention, but never swallow it unseen
+            log.debug("lease create did not win: %s", e)
+            return None
+    spec = current.get("spec") or {}
+    holder = spec.get("holderIdentity")
+    renew = parse_lease_time(spec.get("renewTime"))
+    # expiry uses our configured duration when renewing our own lock;
+    # for another holder, honor the duration they advertised
+    advertised = spec.get("leaseDurationSeconds")
+    duration = (
+        lease_duration
+        if holder == identity or advertised in (None, "")
+        else float(advertised)
+    )
+    # fail closed: a held lease whose renewTime we cannot parse is
+    # treated as live — stealing from a healthy leader (split-brain)
+    # is far worse than waiting for it to release or rewrite the lease
+    expired = renew is not None and now - renew > duration
+    if holder == identity or expired or not holder:
+        if holder == identity and renewing:
+            # our own renewal: the fencing generation must stay stable
+            # for the whole tenure or every renew would fence ourselves
+            record["spec"]["acquireTime"] = spec.get("acquireTime") or rfc3339micro(now)
+            record["spec"]["leaseTransitions"] = int(spec.get("leaseTransitions") or 0)
+        else:
+            # any FRESH acquisition bumps the generation — including a
+            # restarted process with a stable configured identity taking
+            # its dead predecessor's expired lease.  Keying on the
+            # holder string alone would mint the predecessor's exact
+            # token and a paused twin could write through the fence.
+            transitions = int(spec.get("leaseTransitions") or 0)
+            record["spec"]["leaseTransitions"] = transitions + 1
+        record["metadata"]["resourceVersion"] = (current.get("metadata") or {}).get(
+            "resourceVersion"
+        )
+        try:
+            server.update(RESOURCE_LEASES, record)
+            return int(record["spec"]["leaseTransitions"])
+        except (ConflictError, NotFoundError):
+            return None
+    return None
+
+
+def release_lease(server, namespace: str, name: str, identity: str) -> None:
+    """Graceful release: zero ``holderIdentity`` on our own lease so a
+    standby (or our own restart) acquires immediately instead of waiting
+    out the lease duration (client-go ReleaseOnCancel).  The lease object
+    itself survives — deleting it would reset ``leaseTransitions`` and
+    with it the monotonic generation the fencing tokens depend on."""
+    try:
+        current = server.get(RESOURCE_LEASES, namespace, name)
+    except Exception as e:
+        # best effort: a failed release degrades to the lease expiring
+        log.warning("lease read for release failed (standby must wait "
+                    "it out): %s", e)
+        return
+    spec = current.get("spec") or {}
+    if spec.get("holderIdentity") != identity:
+        return  # not ours: never clobber another holder's lease
+    record = {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "resourceVersion": (current.get("metadata") or {}).get("resourceVersion"),
+        },
+        "spec": {
+            "holderIdentity": "",
+            "leaseDurationSeconds": spec.get("leaseDurationSeconds"),
+            "acquireTime": spec.get("acquireTime"),
+            "renewTime": rfc3339micro(time.time()),
+            "leaseTransitions": int(spec.get("leaseTransitions") or 0),
+        },
+    }
+    try:
+        server.update(RESOURCE_LEASES, record)
+    except Exception as e:
+        # best effort: a failed release degrades to the lease expiring
+        log.warning("lease release failed (standby must wait it out): %s", e)
+
+
 class LeaderElector:
     def __init__(
         self,
@@ -119,111 +248,20 @@ class LeaderElector:
             return False
 
     def _try_acquire_or_renew_inner(self) -> bool:
-        now = time.time()
-        # typed coordination.k8s.io/v1 Lease wire format: MicroTime strings
-        # and integer seconds, so the record round-trips through a real
-        # apiserver (client-go resourcelock.LeaseLock semantics)
-        record = {
-            "apiVersion": "coordination.k8s.io/v1",
-            "kind": "Lease",
-            "metadata": {"name": self.lock_name, "namespace": self.namespace},
-            "spec": {
-                "holderIdentity": self.identity,
-                "leaseDurationSeconds": max(1, int(round(self.lease_duration))),
-                "acquireTime": rfc3339micro(now),
-                "renewTime": rfc3339micro(now),
-                "leaseTransitions": 0,
-            },
-        }
-        try:
-            current = self.server.get(RESOURCE_LEASES, self.namespace, self.lock_name)
-        except NotFoundError:
-            try:
-                self.server.create(RESOURCE_LEASES, record)
-                self._generation = 0
-                return True
-            except Exception as e:
-                # losing the create race (409) or a transient transport
-                # error: normal contention, but never swallow it unseen
-                log.debug("lease create did not win: %s", e)
-                return False
-        spec = current.get("spec") or {}
-        holder = spec.get("holderIdentity")
-        renew = parse_lease_time(spec.get("renewTime"))
-        # expiry uses our configured duration when renewing our own lock;
-        # for another holder, honor the duration they advertised
-        advertised = spec.get("leaseDurationSeconds")
-        duration = (
-            self.lease_duration
-            if holder == self.identity or advertised in (None, "")
-            else float(advertised)
-        )
-        # fail closed: a held lease whose renewTime we cannot parse is
-        # treated as live — stealing from a healthy leader (split-brain)
-        # is far worse than waiting for it to release or rewrite the lease
-        expired = renew is not None and now - renew > duration
-        if holder == self.identity or expired or not holder:
-            if holder == self.identity and self.is_leader:
-                # our own renewal: the fencing generation must stay stable
-                # for the whole tenure or every renew would fence ourselves
-                record["spec"]["acquireTime"] = spec.get("acquireTime") or rfc3339micro(now)
-                record["spec"]["leaseTransitions"] = int(spec.get("leaseTransitions") or 0)
-            else:
-                # any FRESH acquisition bumps the generation — including a
-                # restarted process with a stable configured identity taking
-                # its dead predecessor's expired lease.  Keying on the
-                # holder string alone would mint the predecessor's exact
-                # token and a paused twin could write through the fence.
-                transitions = int(spec.get("leaseTransitions") or 0)
-                record["spec"]["leaseTransitions"] = transitions + 1
-            record["metadata"]["resourceVersion"] = (current.get("metadata") or {}).get(
-                "resourceVersion"
-            )
-            try:
-                self.server.update(RESOURCE_LEASES, record)
-                self._generation = int(record["spec"]["leaseTransitions"])
-                return True
-            except (ConflictError, NotFoundError):
-                return False
-        return False
+        generation = acquire_or_renew_lease(
+            self.server, self.namespace, self.lock_name, self.identity,
+            self.lease_duration, renewing=self.is_leader)
+        if generation is None:
+            return False
+        self._generation = generation
+        return True
 
     def release(self) -> None:
-        """Graceful release: zero ``holderIdentity`` on our own lease so a
-        standby (or our own restart) acquires immediately instead of waiting
-        out ``lease_duration`` (client-go ReleaseOnCancel).  The lease object
-        itself survives — deleting it would reset ``leaseTransitions`` and
-        with it the monotonic generation the fencing tokens depend on."""
-        try:
-            current = self.server.get(RESOURCE_LEASES, self.namespace, self.lock_name)
-        except Exception as e:
-            # best effort: a failed release degrades to the lease expiring
-            log.warning("lease read for release failed (standby must wait "
-                        "it out): %s", e)
-            return
-        spec = current.get("spec") or {}
-        if spec.get("holderIdentity") != self.identity:
-            return  # not ours: never clobber another holder's lease
-        record = {
-            "apiVersion": "coordination.k8s.io/v1",
-            "kind": "Lease",
-            "metadata": {
-                "name": self.lock_name,
-                "namespace": self.namespace,
-                "resourceVersion": (current.get("metadata") or {}).get("resourceVersion"),
-            },
-            "spec": {
-                "holderIdentity": "",
-                "leaseDurationSeconds": spec.get("leaseDurationSeconds"),
-                "acquireTime": spec.get("acquireTime"),
-                "renewTime": rfc3339micro(time.time()),
-                "leaseTransitions": int(spec.get("leaseTransitions") or 0),
-            },
-        }
-        try:
-            self.server.update(RESOURCE_LEASES, record)
-        except Exception as e:
-            # best effort: a failed release degrades to the lease expiring
-            log.warning("lease release failed (standby must wait it out): %s", e)
+        """Graceful release (see :func:`release_lease`): zero
+        ``holderIdentity`` on our own lease so a standby — or our own
+        restart — acquires immediately instead of waiting out
+        ``lease_duration``."""
+        release_lease(self.server, self.namespace, self.lock_name, self.identity)
 
     # -- run loop ------------------------------------------------------------
 

@@ -209,6 +209,15 @@ class LabeledCounter(_LabeledFamily):
         return Counter(self.name, self.help, label_str=label_str)
 
 
+class LabeledGauge(_LabeledFamily):
+    def __init__(self, name: str, help_text: str, registry: "Registry",
+                 labelnames: Tuple[str, ...]):
+        super().__init__(name, help_text, registry, labelnames, "gauge")
+
+    def _make_child(self, label_str: str) -> Gauge:
+        return Gauge(self.name, self.help, label_str=label_str)
+
+
 class LabeledHistogram(_LabeledFamily):
     def __init__(self, name: str, help_text: str, registry: "Registry",
                  labelnames: Tuple[str, ...],
@@ -355,6 +364,30 @@ fenced_writes_rejected = Counter(
     "tpujob_operator_fenced_writes_rejected_total",
     "Mutating API calls rejected by write fencing (leadership lost locally, "
     "or a stale fencing token caught server-side)",
+    REGISTRY,
+)
+
+# Sharded-control-plane series (the shard PR): which shards this instance
+# owns, how often ownership churned, and what a drain-before-release handoff
+# costs.  Per-INSTANCE semantics: every member exports its own view, and a
+# healthy fleet's shard_ownership sums to exactly 1 per shard across members.
+shard_ownership = LabeledGauge(
+    "tpujob_operator_shard_ownership",
+    "Whether this instance currently owns the shard (1) or not (0); summed "
+    "across the fleet each shard must total exactly 1",
+    REGISTRY,
+    ("shard",),
+)
+shard_rebalances = Counter(
+    "tpujob_operator_shard_rebalances_total",
+    "Shard ownership transitions observed by this instance (acquisitions "
+    "plus releases and losses)",
+    REGISTRY,
+)
+shard_handoff_duration = Histogram(
+    "tpujob_operator_shard_handoff_duration_seconds",
+    "Duration of one drain-before-release shard handoff: draining marked "
+    "-> in-flight syncs finished -> shard lease released",
     REGISTRY,
 )
 

@@ -38,6 +38,16 @@ class ServerOption:
     # moment leadership is lost, so a deposed leader resuming mid-handover
     # cannot double-create pods.  Only meaningful with leader election on.
     enable_fencing: bool = True
+    # sharded control plane (> 0 enables): jobs hash into this many virtual
+    # shards, rendezvous-assigned across the live member fleet, one fencing
+    # lease per shard.  Replaces single-leader election — every member runs
+    # its informers and syncs only the shards it owns.  The whole fleet
+    # must agree on the count; the shardmaps/tpujob-shards object records
+    # it and members adopt the recorded value over this flag.
+    shard_count: int = 0
+    # how long a shard handoff waits for the shard's in-flight syncs before
+    # giving up on the graceful release and letting the lease expire
+    shard_drain_timeout_s: float = 5.0
     qps: float = 50.0
     burst: int = 100
     # crash-loop damper: decaying delay between a counted ExitCode restart
@@ -113,6 +123,16 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--no-fencing", dest="enable_fencing", action="store_false",
                         help="disable write fencing (a deposed leader's in-"
                              "flight writes are no longer rejected)")
+    parser.add_argument("--shards", type=int, default=0, dest="shard_count",
+                        help="enable the sharded control plane with this "
+                             "many virtual job shards (0 = single elected "
+                             "leader); run N replicas with the same value "
+                             "to scale the controller out")
+    parser.add_argument("--shard-drain-timeout", type=float, default=5.0,
+                        dest="shard_drain_timeout_s",
+                        help="seconds a shard handoff waits for in-flight "
+                             "syncs before skipping the graceful release "
+                             "(the lease then expires instead)")
     parser.add_argument("--lease-duration", type=float, default=15.0, dest="lease_duration_s")
     parser.add_argument("--renew-deadline", type=float, default=5.0, dest="renew_deadline_s")
     parser.add_argument("--retry-period", type=float, default=3.0, dest="retry_period_s")

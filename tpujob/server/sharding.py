@@ -1,0 +1,535 @@
+"""Sharded control plane: N controller instances, one fleet.
+
+The single elected leader (``leader_election.py``) syncs every job; at
+placement-scale job counts the controller itself is the bottleneck and the
+single point of failure.  This module shards the job set across a fleet:
+
+- **Job → shard**: consistent hash of the job UID over a fixed number of
+  virtual shards (``shard_of_uid``).  The mapping never moves — only the
+  shard → member assignment does — so rebalance cost is bounded by shards,
+  never by jobs.
+- **Shard → member**: rendezvous (highest-random-weight) hashing over the
+  live membership (``rendezvous_owner``).  Every member computes the same
+  assignment from the same membership view; adding a member moves only the
+  shards the newcomer wins (≈ 1/N of them, all TO the newcomer), removing
+  one moves only its own shards.
+- **Membership**: one heartbeat lease per member
+  (``tpujob-member-<identity>``); a member whose lease expires is treated
+  as dead and its shards rebalance to the survivors.
+- **Shard map**: one ``shardmaps/tpujob-shards`` object in the API server
+  records the fleet-wide shard count (the one number every member MUST
+  agree on — a mismatch would map one job to two different shards and
+  reopen the double-sync window; members adopt the map's count over their
+  local flag) plus a best-effort view of current assignments for
+  operators.
+- **Per-shard fencing**: one fencing lease per shard
+  (``tpujob-shard-<i>``), the PR-4 generation machinery applied per shard.
+  Every mutating call a member makes while syncing a job carries a
+  :class:`~tpujob.kube.fencing.FencingToken` naming that job's shard lease
+  at the generation the shard was acquired; the fence-validating server
+  rejects a deposed owner's stale generation server-side.
+- **Handoff protocol**: releasing a shard first marks it *draining* (the
+  controller drops its keys at dequeue), then waits for the shard's
+  in-flight syncs to finish (``on_shard_drain``), and only then zeroes the
+  shard lease.  A drain that times out skips the release and lets the
+  lease expire instead — in either case there is no instant at which two
+  members may sync the same job: the old owner stops syncing before the
+  new owner can acquire.  Acquisition mirrors it: the crash-loop damper is
+  rebuilt for the shard (``on_shard_prepare``) BEFORE the shard turns
+  active, then every cached job of the shard is enqueued
+  (``on_shard_acquired``) so events filtered while another member owned it
+  are reconstructed from the shared informer cache.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import hashlib
+import logging
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from tpujob.analysis import lockgraph
+from tpujob.kube.errors import AlreadyExistsError, ConflictError, NotFoundError
+from tpujob.kube.fencing import FencingToken
+from tpujob.server import metrics
+from tpujob.server.leader_election import (
+    RESOURCE_LEASES,
+    acquire_or_renew_lease,
+    parse_lease_time,
+    release_lease,
+    rfc3339micro,
+)
+
+log = logging.getLogger("tpujob.sharding")
+
+RESOURCE_SHARD_MAPS = "shardmaps"
+SHARD_MAP_NAME = "tpujob-shards"
+SHARD_LEASE_PREFIX = "tpujob-shard"
+MEMBER_LEASE_PREFIX = "tpujob-member"
+
+
+def stable_hash(value: str) -> int:
+    """Process-independent 64-bit hash.  Every member must map the same uid
+    to the same shard and score rendezvous candidates identically, and
+    Python's builtin ``hash()`` is salted per process."""
+    return int.from_bytes(hashlib.md5(value.encode("utf-8")).digest()[:8], "big")
+
+
+def shard_of_uid(uid: str, num_shards: int) -> int:
+    """The shard a job UID lives in — fixed for the job's whole life."""
+    return stable_hash(f"uid:{uid}") % num_shards
+
+
+def rendezvous_owner(shard: int, members: Sequence[str]) -> Optional[str]:
+    """Highest-random-weight owner of ``shard`` among ``members``.
+
+    Deterministic in the (unordered) membership set.  Adding a member
+    reassigns exactly the shards the newcomer wins — on average 1/N of
+    them — and never shuffles a shard between two surviving members;
+    removing one reassigns only the shards it owned."""
+    best: Optional[str] = None
+    best_w = -1
+    for m in members:
+        w = stable_hash(f"shard:{shard}:member:{m}")
+        if w > best_w or (w == best_w and (best is None or m < best)):
+            best, best_w = m, w
+    return best
+
+
+def shard_lease_name(shard: int) -> str:
+    return f"{SHARD_LEASE_PREFIX}-{shard}"
+
+
+def member_lease_name(identity: str) -> str:
+    return f"{MEMBER_LEASE_PREFIX}-{identity}"
+
+
+# The shard the in-flight sync (or informer-handler write) belongs to.  Set
+# by the controller strictly around the work for one job, so it propagates
+# through the transport stack — and through the slow-start batch pool,
+# which runs its tasks under copied contexts — down to FencedTransport's
+# token provider without plumbing (the PR-4 call-token pattern).
+_SYNC_SHARD: "contextvars.ContextVar[Optional[int]]" = contextvars.ContextVar(
+    "tpujob_sync_shard", default=None
+)
+
+
+def current_sync_shard() -> Optional[int]:
+    """The shard attached to the in-flight sync (None = no shard context)."""
+    return _SYNC_SHARD.get()
+
+
+@contextlib.contextmanager
+def sync_shard(shard: Optional[int]):
+    token = _SYNC_SHARD.set(shard)
+    try:
+        yield
+    finally:
+        _SYNC_SHARD.reset(token)
+
+
+class ShardCoordinator:
+    """One fleet member's shard lifecycle: heartbeat, rebalance, handoff.
+
+    Runs a single background loop (:meth:`run`, elector-style): heartbeat
+    the member lease, observe the live membership, renew owned shard
+    leases, hand off shards rendezvous hashing no longer assigns here, and
+    acquire newly-assigned shards once their previous owner released them
+    (or their lease expired).  The controller consults :meth:`is_active`
+    at enqueue and dequeue, and :meth:`current_call_token` fences every
+    mutating call on the owning shard's lease generation.
+    """
+
+    def __init__(
+        self,
+        server,  # ApiServer-interface transport (unfenced, like the elector's)
+        num_shards: int,
+        identity: Optional[str] = None,
+        namespace: str = "default",
+        lease_duration: float = 15.0,
+        retry_period: float = 3.0,
+        drain_timeout: float = 5.0,
+        on_shard_prepare: Optional[Callable[[int], None]] = None,
+        on_shard_acquired: Optional[Callable[[int], None]] = None,
+        on_shard_drain: Optional[Callable[[int, float], bool]] = None,
+    ):
+        self.server = server
+        self.num_shards = int(num_shards)
+        self.identity = identity or f"tpujob-member-{uuid.uuid4().hex[:8]}"
+        self.namespace = namespace or "default"
+        self.lease_duration = lease_duration
+        self.retry_period = retry_period
+        self.drain_timeout = drain_timeout
+        # acquisition hooks: prepare runs BEFORE the shard turns active (no
+        # worker can be syncing its jobs yet — the damper-rebuild window),
+        # acquired runs after (the enqueue replay); drain is the handoff
+        # barrier and must return True only when no in-flight sync remains
+        self.on_shard_prepare = on_shard_prepare
+        self.on_shard_acquired = on_shard_acquired
+        self.on_shard_drain = on_shard_drain
+        self._lock = lockgraph.new_lock("shard-coordinator")
+        # shard -> lease generation it was acquired at (the fencing half)
+        self._owned: Dict[int, int] = {}  # guarded by self._lock
+        # shards mid-handoff: still leased (in-flight syncs keep their valid
+        # tokens) but no longer active (no NEW sync may start)
+        self._draining: Set[int] = set()  # guarded by self._lock
+        # monotonic stamp of each shard's last successful lease renewal: a
+        # shard not renewed for a full lease_duration is treated as lost
+        # even if no rival was observed (our writes would be server-fenced
+        # the moment one takes it — stop issuing them at the source)
+        self._renewed: Dict[int, float] = {}  # guarded by self._lock
+        # last observed live membership (observability/tests)
+        self._members: List[str] = []  # guarded by self._lock
+        # this instance's own acquisition+release/loss count: the
+        # deterministic per-member view of the process-global
+        # shard_rebalances_total metric, which a multi-member test shares
+        self.rebalances = 0  # guarded by self._lock
+
+    # -- sharding surface consumed by the controller -------------------------
+
+    def shard_of_uid(self, uid: str) -> int:
+        return shard_of_uid(uid, self.num_shards)
+
+    def is_active(self, shard: int) -> bool:
+        """True iff this member currently owns ``shard`` and is not
+        draining it — the only state in which a sync of its jobs may
+        START here."""
+        with self._lock:
+            return shard in self._owned and shard not in self._draining
+
+    def owned_shards(self) -> List[int]:
+        with self._lock:
+            return sorted(self._owned)
+
+    def members(self) -> List[str]:
+        with self._lock:
+            return list(self._members)
+
+    def sync_shard_context(self, shard: Optional[int]):
+        """Context manager binding ``shard`` to the calls it encloses (see
+        :func:`sync_shard`); the controller wraps each sync in it."""
+        return sync_shard(shard)
+
+    def token_for_shard(self, shard: int) -> Optional[FencingToken]:
+        """The fencing token of this member's CURRENT tenure over ``shard``
+        (None when not held) — valid through a drain, dead after release."""
+        with self._lock:
+            generation = self._owned.get(shard)
+        if generation is None:
+            return None
+        return FencingToken(self.identity, generation,
+                            lease=shard_lease_name(shard))
+
+    def current_call_token(self) -> Optional[FencingToken]:
+        """The ``fence`` provider for :class:`FencedTransport`: the token of
+        the in-flight sync's shard, or None (= reject locally) when the
+        call has no shard context or the shard is no longer held."""
+        shard = current_sync_shard()
+        if shard is None:
+            return None
+        return self.token_for_shard(shard)
+
+    # -- membership ----------------------------------------------------------
+
+    def _heartbeat(self) -> None:
+        """Write our member lease (create-or-renew).  The lease name embeds
+        the identity, so there is no contention — only our own stale
+        record — and generations are irrelevant: membership only needs
+        liveness, the per-shard leases carry the fencing generations."""
+        now = time.time()
+        name = member_lease_name(self.identity)
+        record = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": max(1, int(round(self.lease_duration))),
+                "acquireTime": rfc3339micro(now),
+                "renewTime": rfc3339micro(now),
+                "leaseTransitions": 0,
+            },
+        }
+        try:
+            current = self.server.get(RESOURCE_LEASES, self.namespace, name)
+        except NotFoundError:
+            try:
+                self.server.create(RESOURCE_LEASES, record)
+                return
+            except AlreadyExistsError:
+                current = self.server.get(RESOURCE_LEASES, self.namespace, name)
+        spec = current.get("spec") or {}
+        record["spec"]["acquireTime"] = spec.get("acquireTime") or rfc3339micro(now)
+        record["metadata"]["resourceVersion"] = (
+            (current.get("metadata") or {}).get("resourceVersion"))
+        try:
+            self.server.update(RESOURCE_LEASES, record)
+        except (ConflictError, NotFoundError):
+            pass  # raced (only ever with our own writes); next tick renews
+
+    def _live_members(self) -> List[str]:
+        """Identities of every member whose heartbeat lease is unexpired.
+
+        Fail closed on an unparseable renewTime (treat the member as live,
+        the elector's rule): evicting a healthy member on garbage would
+        hand its shards to a rival while it still syncs them — exactly the
+        double-sync window this module exists to close."""
+        now = time.time()
+        out: List[str] = []
+        for lease in self.server.list(RESOURCE_LEASES, self.namespace):
+            name = (lease.get("metadata") or {}).get("name") or ""
+            if not name.startswith(f"{MEMBER_LEASE_PREFIX}-"):
+                continue
+            spec = lease.get("spec") or {}
+            holder = spec.get("holderIdentity")
+            if not holder:
+                continue  # gracefully departed
+            renew = parse_lease_time(spec.get("renewTime"))
+            duration = float(spec.get("leaseDurationSeconds")
+                             or self.lease_duration)
+            if renew is not None and now - renew > duration:
+                continue  # expired: the member is dead
+            out.append(holder)
+        return sorted(set(out))
+
+    # -- shard map -----------------------------------------------------------
+
+    def _ensure_shard_map(self) -> None:
+        """Create the fleet's shard-map object, or adopt its shard count.
+
+        The shard count is the one parameter every member MUST agree on:
+        a member running with a different ``--shards`` flag would hash the
+        same job into a different shard id and the exactly-one-owner
+        invariant would no longer cover it.  First member in wins; everyone
+        else adopts the map's count (logging loudly on mismatch) before
+        acquiring anything."""
+        record = {
+            "apiVersion": "tpujob.dev/v1",
+            "kind": "ShardMap",
+            "metadata": {"name": SHARD_MAP_NAME, "namespace": self.namespace},
+            "spec": {"shards": self.num_shards},
+            "status": {"assignments": {}},
+        }
+        try:
+            current = self.server.get(
+                RESOURCE_SHARD_MAPS, self.namespace, SHARD_MAP_NAME)
+        except NotFoundError:
+            try:
+                self.server.create(RESOURCE_SHARD_MAPS, record)
+                return
+            except AlreadyExistsError:
+                current = self.server.get(
+                    RESOURCE_SHARD_MAPS, self.namespace, SHARD_MAP_NAME)
+        declared = int(((current.get("spec") or {}).get("shards"))
+                       or self.num_shards)
+        if declared != self.num_shards:
+            log.error(
+                "shard map %s declares %d shards but this member was "
+                "configured with %d; adopting the map's count — a split "
+                "shard-count fleet would double-sync jobs",
+                SHARD_MAP_NAME, declared, self.num_shards)
+            self.num_shards = declared
+
+    def _update_shard_map(self, shard: int, holder: str, generation: int) -> None:
+        """Best-effort assignment record for operators (/debug + kubectl);
+        the per-shard leases stay the authoritative fencing state."""
+        entry = ({"holder": holder, "generation": generation}
+                 if holder else None)  # None deletes the key (merge patch)
+        try:
+            self.server.patch(
+                RESOURCE_SHARD_MAPS, self.namespace, SHARD_MAP_NAME,
+                {"status": {"assignments": {str(shard): entry}}})
+        except Exception as e:  # noqa: TPL005 - observability write only;
+            log.debug("shard map update failed (best effort): %s", e)
+
+    # -- rebalance / handoff -------------------------------------------------
+
+    def _tick(self) -> None:
+        # the starvation sweep runs FIRST and unconditionally: during a
+        # transport outage the heartbeat/membership calls below fail and
+        # skip the rest of the tick, and a deposed member that cannot
+        # reach the API server must still stop minting shard tokens once
+        # a full lease_duration passed without a successful renewal — a
+        # rival may already own its shards
+        now = time.monotonic()
+        with self._lock:
+            starved = [s for s, renewed in self._renewed.items()
+                       if s in self._owned
+                       and now - renewed > self.lease_duration]
+        for shard in starved:
+            self._lost(shard, "renewal starved past lease_duration")
+        self._heartbeat()
+        members = self._live_members()
+        with self._lock:
+            self._members = list(members)
+            owned_now = dict(self._owned)
+        if self.identity in members:
+            desired = {s for s in range(self.num_shards)
+                       if rendezvous_owner(s, members) == self.identity}
+        else:
+            # our own heartbeat is not visible (expired or unreadable):
+            # assume deposed and shed everything — the conservative side of
+            # the exactly-one-owner invariant
+            desired = set()
+        for shard in sorted(set(owned_now) & desired):
+            self._renew_shard(shard)
+        for shard in sorted(set(owned_now) - desired):
+            self._handoff(shard)
+        for shard in sorted(desired - set(owned_now)):
+            self._try_acquire(shard)
+
+    def _renew_shard(self, shard: int) -> None:
+        try:
+            generation = acquire_or_renew_lease(
+                self.server, self.namespace, shard_lease_name(shard),
+                self.identity, self.lease_duration, renewing=True)
+        except Exception as e:
+            # transient transport error: retry next tick; sustained
+            # failure is handled by _tick's unconditional starvation
+            # sweep (which also covers outages that fail the tick before
+            # this method ever runs)
+            log.warning("shard %d: lease renewal failed: %s", shard, e)
+            return
+        if generation is None:
+            self._lost(shard, "lease shows another holder")
+            return
+        with self._lock:
+            if shard in self._owned:
+                self._owned[shard] = generation
+                self._renewed[shard] = time.monotonic()
+
+    def _try_acquire(self, shard: int) -> None:
+        try:
+            generation = acquire_or_renew_lease(
+                self.server, self.namespace, shard_lease_name(shard),
+                self.identity, self.lease_duration, renewing=False)
+        except Exception as e:
+            log.warning("shard %d: acquisition attempt failed: %s", shard, e)
+            return
+        if generation is None:
+            return  # previous owner's lease still stands: wait it out
+        # prepare BEFORE activation: the crash-loop damper rebuild for the
+        # shard's jobs must not race a worker already syncing them — no
+        # worker can, because is_active is still False
+        if self.on_shard_prepare is not None:
+            try:
+                self.on_shard_prepare(shard)
+            except Exception:
+                log.exception("shard %d: prepare hook failed", shard)
+        with self._lock:
+            self._owned[shard] = generation
+            self._renewed[shard] = time.monotonic()
+            self.rebalances += 1
+        metrics.shard_rebalances.inc()
+        metrics.shard_ownership.labels(shard=str(shard)).set(1)
+        log.info("%s acquired shard %d (generation %d)",
+                 self.identity, shard, generation)
+        self._update_shard_map(shard, self.identity, generation)
+        if self.on_shard_acquired is not None:
+            try:
+                self.on_shard_acquired(shard)
+            except Exception:
+                log.exception("shard %d: acquired hook failed", shard)
+
+    def _handoff(self, shard: int) -> None:
+        """Drain-before-release: mark draining (no new sync starts), wait
+        out the in-flight syncs, then zero the shard lease so the next
+        owner acquires immediately.  A drain that times out (a wedged
+        sync may still write) skips the release — the lease expiring is
+        the safe fallback, exactly like the app-shutdown rule."""
+        started = time.monotonic()
+        with self._lock:
+            if shard not in self._owned:
+                return
+            self._draining.add(shard)
+        drained = True
+        if self.on_shard_drain is not None:
+            try:
+                drained = bool(self.on_shard_drain(shard, self.drain_timeout))
+            except Exception:
+                log.exception("shard %d: drain hook failed", shard)
+                drained = False
+        if drained:
+            release_lease(self.server, self.namespace,
+                          shard_lease_name(shard), self.identity)
+            self._update_shard_map(shard, "", 0)
+        else:
+            log.warning(
+                "shard %d: drain timed out; NOT releasing — an in-flight "
+                "write may still land, so the next owner must wait out the "
+                "lease", shard)
+        with self._lock:
+            self._owned.pop(shard, None)
+            self._draining.discard(shard)
+            self._renewed.pop(shard, None)
+            self.rebalances += 1
+        metrics.shard_rebalances.inc()
+        metrics.shard_ownership.labels(shard=str(shard)).set(0)
+        metrics.shard_handoff_duration.observe(time.monotonic() - started)
+        log.info("%s released shard %d (drained=%s, handoff %.3fs)",
+                 self.identity, shard, drained, time.monotonic() - started)
+
+    def _lost(self, shard: int, why: str) -> None:
+        """Deposed without a handoff (lease stolen after expiry, renewal
+        starved): drop ownership immediately.  No drain — the rival may
+        already be syncing; our in-flight writes die at the server-side
+        fence (stale generation), and new syncs never start because
+        is_active flipped."""
+        with self._lock:
+            if self._owned.pop(shard, None) is None:
+                return
+            self._draining.discard(shard)
+            self._renewed.pop(shard, None)
+            self.rebalances += 1
+        metrics.shard_rebalances.inc()
+        metrics.shard_ownership.labels(shard=str(shard)).set(0)
+        log.error("%s lost shard %d (%s); fence closed locally",
+                  self.identity, shard, why)
+
+    def release_all(self) -> None:
+        """Graceful departure: zero every owned shard lease plus the member
+        heartbeat, so survivors rebalance immediately instead of waiting
+        out lease_duration.  Callers must have drained the workers first
+        (OperatorApp.shutdown joins them before calling this) — there is
+        deliberately no in-loop release on stop, because the coordinator
+        thread cannot know whether a worker still has a write in flight."""
+        with self._lock:
+            owned = sorted(self._owned)
+            self._owned.clear()
+            self._draining.clear()
+            self._renewed.clear()
+            self.rebalances += len(owned)
+        for shard in owned:
+            release_lease(self.server, self.namespace,
+                          shard_lease_name(shard), self.identity)
+            metrics.shard_ownership.labels(shard=str(shard)).set(0)
+            metrics.shard_rebalances.inc()
+            self._update_shard_map(shard, "", 0)
+        release_lease(self.server, self.namespace,
+                      member_lease_name(self.identity), self.identity)
+
+    # -- run loop ------------------------------------------------------------
+
+    def run(self, stop_event: threading.Event) -> None:
+        """Blocks until stop: ensure the shard map, then tick forever."""
+        while not stop_event.is_set():
+            try:
+                self._ensure_shard_map()
+                break
+            except Exception as e:
+                # transport errors must NOT kill the coordinator: a dead
+                # coordinator thread with live workers is split-brain (the
+                # elector's rule, applied here)
+                log.warning("shard map bootstrap failed: %s", e)
+            if stop_event.wait(self.retry_period):
+                return
+        while not stop_event.is_set():
+            try:
+                self._tick()
+            except Exception as e:
+                log.warning("shard coordinator tick failed: %s", e)
+            if stop_event.wait(self.retry_period):
+                return
