@@ -11,7 +11,7 @@ SHELL := /bin/bash
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke shard-smoke write-path-smoke read-path-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
+.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
@@ -61,6 +61,13 @@ failover-smoke:
 shard-smoke:
 	$(PY) scripts/shard_smoke.py
 
+# elastic-resize smoke (~5 s): scale a live job 2 -> 4 -> 2 workers — staged
+# join (republish only when all Running) + staged drain (checkpoint barrier,
+# highest-index deletes); surviving pods keep their UIDs with zero restarts
+# and the job trains to Succeeded (docs/failure-handling, "elastic resize")
+resize-smoke:
+	$(PY) scripts/resize_smoke.py
+
 # write-path smoke (~10 s): the churn bench's optimized run (no-op status
 # suppression + event coalescing + merge-patch writes) must beat the naive
 # control by >= 2x on API write calls, with trace completeness intact
@@ -76,7 +83,7 @@ read-path-smoke:
 
 # the tier-1 command from ROADMAP.md, verbatim (modulo $$-escaping for
 # make), so local and CI invocations agree on what "the tests pass" means
-test: lint trace-smoke failover-smoke shard-smoke write-path-smoke read-path-smoke
+test: lint trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # the operator/controller/kube/api tests only — the model-path suites
@@ -97,9 +104,11 @@ e2e:
 # faults + watch kills + compaction + preemption storms), asserting the
 # system invariants after every convergence (docs/failure-handling).
 # --crash adds the controller-lifecycle tiers per seed: hard-kill + cold
-# restart schedules, warm-standby failover with write-fencing probes, and
-# the sharded-control-plane membership storm (3 controllers, member
-# kill/flap/rejoin, exactly-one-owner-per-generation asserted).
+# restart schedules, warm-standby failover with write-fencing probes, the
+# sharded-control-plane membership storm (3 controllers, member
+# kill/flap/rejoin, exactly-one-owner-per-generation asserted), and the
+# elastic-resize storm (grow/shrink/flap spec.replicas over live jobs +
+# a controller kill; no progress lost past the last checkpoint).
 soak:
 	$(PY) soak.py --seeds 1,2,3,4,5 --crash
 

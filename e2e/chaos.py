@@ -49,7 +49,20 @@ The shard tier (the sharded-control-plane PR) scales the fleet out:
 - ``run_shard_smoke`` — the fast 2-member slice: kill one, the survivor
   must absorb its shards within one lease term with no double-sync.
 
-Runnable:  python -m e2e.chaos --seed 7 [--mode api|crash|failover|shard]
+The resize tier (the elastic-resize PR) flexes LIVE jobs:
+
+- ``run_resize_soak`` — seeded resize storms (grow/shrink/flap mid-resize
+  of ``spec.replicas``) over elastic jobs whose pods run the real
+  workload-side planner, on top of the API fault schedule, the preemption
+  storm and a controller hard-kill.  Invariants: no progress lost past the
+  last checkpoint, never a duplicate (job, rtype, index) pod at any
+  instant, every resize converges (world published, staging record
+  cleared) before the jobs train to Succeeded.
+- ``run_resize_smoke`` — the fast fault-free slice: scale one live job
+  2 -> 4 -> 2 workers with zero restarts of surviving pods (UIDs pinned),
+  the drain proceeding on the workload's checkpoint ack.
+
+Runnable:  python -m e2e.chaos --seed 7 [--mode api|crash|failover|shard|resize]
 (or the full seeded matrix via the repo-root ``soak.py`` / ``make soak``)
 """
 from __future__ import annotations
@@ -61,10 +74,12 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from e2e.cluster import E2ECluster
+from e2e.elastic import ElasticWorkload, LivePodTracker, ResizeStorm
 from e2e.kubelet import KubeletSim, PodScript
 from tpujob.analysis import lockgraph
 from tpujob.api import constants as c
 from tpujob.api.types import TPUJob
+from tpujob.api.validation import install_tpujob_admission
 from tpujob.controller.job_base import expectation_key
 from tpujob.kube.chaos import (
     FAULT_TIMEOUT_DROPPED,
@@ -535,6 +550,9 @@ def _soak_harness(
     # bookmark cadence on: quiet informer streams keep their resume points
     # near the head, so compaction faults force resumes, not world-relists
     inner = InMemoryAPIServer(bookmark_every=25)
+    # UPDATE admission like the real app wiring: the resize storm's spec
+    # patches are validated server-side (only Worker replicas may change)
+    install_tpujob_admission(inner)
     if fence:
         inner.enable_fence_validation("default", "tpujob-operator")
     chaos = FaultInjectingAPIServer(inner, seed=seed, config=config or SOAK_CHAOS)
@@ -1528,18 +1546,447 @@ def _run_shard_smoke_inner(
                 a.shutdown()
 
 
+# ---------------------------------------------------------------------------
+# elastic resize tier: seeded resize storms over live jobs (ROADMAP item 3)
+# ---------------------------------------------------------------------------
+
+RESIZE_SOAK_STEPS = 40
+
+
+def elastic_matrix(
+    prefix: str,
+    admin: ClientSet,
+    stop_event: threading.Event,
+    finish_gate: threading.Event,
+    total_steps: int = RESIZE_SOAK_STEPS,
+) -> Tuple[List[JobCase], Dict[str, ElasticWorkload]]:
+    """The resize tier's job matrix: one master-less elastic job (workers
+    are completion-bearing AND elastic) and one master'd job (the master is
+    process 0; only the workers flex).  Every pod runs the real
+    workload-side planner through the kubelet exec seam."""
+    cases: List[JobCase] = []
+    workloads: Dict[str, ElasticWorkload] = {}
+
+    name = f"{prefix}-el-wonly"
+    wl = ElasticWorkload(admin, name, initial_world=2,
+                         total_steps=total_steps, stop_event=stop_event,
+                         finish_gate=finish_gate)
+    cases.append(JobCase(
+        job=_job(name, {
+            "runPolicy": {"backoffLimit": 60},
+            "tpuReplicaSpecs": {
+                "Worker": {"replicas": 2,
+                           "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                           "template": _tmpl()},
+            },
+        }),
+        scripts=wl.scripts(),
+        expect_terminal="Succeeded",
+    ))
+    workloads[name] = wl
+
+    name = f"{prefix}-el-mw"
+    wl = ElasticWorkload(admin, name, initial_world=3, has_master=True,
+                         total_steps=total_steps, stop_event=stop_event,
+                         finish_gate=finish_gate)
+    cases.append(JobCase(
+        job=_job(name, {
+            "runPolicy": {"backoffLimit": 60},
+            "tpuReplicaSpecs": {
+                "Master": {"replicas": 1,
+                           "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                           "template": _tmpl()},
+                "Worker": {"replicas": 2,
+                           "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                           "template": _tmpl()},
+            },
+        }),
+        scripts=wl.scripts(),
+        expect_terminal="Succeeded",
+    ))
+    workloads[name] = wl
+    return cases, workloads
+
+
+def _job_world(job: TPUJob) -> int:
+    # the controller's own world computation — the convergence checks below
+    # must never diverge from it
+    from tpujob.controller.reconciler import get_total_replicas
+
+    return get_total_replicas(job)
+
+
+def _resize_converged(admin: ClientSet, name: str) -> bool:
+    """Has the controller fully converged this job's last resize?  The
+    commit point is the published world annotation matching the spec with
+    no pending target, the durable staging record cleared, and exactly the
+    in-range worker pods alive."""
+    try:
+        job = admin.tpujobs.get("default", name)
+    except NotFoundError:
+        return False
+    ann = job.metadata.annotations or {}
+    world = _job_world(job)
+    if ann.get(c.ANNOTATION_WORLD_SIZE) != str(world):
+        return False
+    if ann.get(c.ANNOTATION_TARGET_WORLD_SIZE) is not None:
+        return False
+    if job.status.resize is not None:
+        return False
+    rspec = job.spec.tpu_replica_specs.get(c.REPLICA_TYPE_WORKER)
+    workers = rspec.replicas if rspec and rspec.replicas is not None else 1
+    live = [p for p in admin.pods.list()
+            if p.metadata.labels.get(c.LABEL_JOB_NAME) == name
+            and p.metadata.labels.get(c.LABEL_REPLICA_TYPE)
+            == c.REPLICA_TYPE_WORKER.lower()]
+    indices = sorted(int(p.metadata.labels.get(c.LABEL_REPLICA_INDEX) or -1)
+                     for p in live)
+    return indices == list(range(workers))
+
+
+def _resize_job_problems(
+    admin: ClientSet,
+    workloads: Dict[str, ElasticWorkload],
+    pod_tracker: LivePodTracker,
+) -> List[str]:
+    """The resize tier's extra invariants, on top of the standard set:
+
+    10. the data-plane checkpoint contract held — the checkpoint step never
+        regressed, no restore landed past the last checkpoint, and every
+        resize-driven re-rendezvous was lossless;
+    11. never a duplicate (job, rtype, index) pod at ANY instant (the
+        continuous tracker, not just the end state);
+    12. every resize converged: published world == spec world, no pending
+        target, no staging record, observedGeneration caught up, and the
+        job still reached Succeeded with the full step count trained.
+    """
+    problems: List[str] = list(pod_tracker.problems())
+    for name, wl in sorted(workloads.items()):
+        snap = wl.ledger.snapshot()
+        problems.extend(snap["violations"])
+        if snap["progress"] < wl.total_steps:
+            problems.append(
+                f"{name}: trained only {snap['progress']}/{wl.total_steps} "
+                "steps")
+        if snap["rejoins"] < 1:
+            problems.append(
+                f"{name}: no resize-driven re-rendezvous ever happened "
+                "(the storm staged resizes, the workload never saw one)")
+        try:
+            job = admin.tpujobs.get("default", name)
+        except NotFoundError:
+            problems.append(f"{name}: job vanished")
+            continue
+        ann = job.metadata.annotations or {}
+        world = _job_world(job)
+        if ann.get(c.ANNOTATION_WORLD_SIZE) != str(world):
+            problems.append(
+                f"{name}: published world {ann.get(c.ANNOTATION_WORLD_SIZE)!r}"
+                f" != spec world {world}")
+        if ann.get(c.ANNOTATION_TARGET_WORLD_SIZE) is not None:
+            problems.append(f"{name}: pending drain target never cleared")
+        if int(ann.get(c.ANNOTATION_RESIZE_GENERATION) or 0) < 1:
+            problems.append(f"{name}: no resize ever completed "
+                            "(resize-generation never bumped)")
+        if job.status.resize is not None:
+            problems.append(
+                f"{name}: staging record survived convergence: "
+                f"{job.status.resize.to_dict()}")
+        if (job.metadata.generation
+                and job.status.observed_generation != job.metadata.generation):
+            problems.append(
+                f"{name}: observedGeneration {job.status.observed_generation}"
+                f" trails generation {job.metadata.generation}")
+        for cond in job.status.conditions:
+            if cond.type == c.JOB_RESIZING and cond.status == "True":
+                problems.append(f"{name}: Resizing condition stuck True")
+    return problems
+
+
+def run_resize_soak(
+    seed: int,
+    config: Optional[ChaosConfig] = None,
+    kills: int = 1,
+    resize_events: int = 4,
+    storm_kills: int = 3,
+    timeout: float = 90.0,
+    opt_overrides: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Elastic-resize soak: seeded resize storms (grow / shrink / flap
+    mid-resize) over live elastic jobs, interleaved with the full API fault
+    schedule, the kubelet preemption storm, and a seeded controller
+    hard-kill + cold restart.  Invariants: the standard set, plus no
+    progress lost past the last checkpoint (the ledger's checkpoint/restore
+    contract), never a duplicate (job, rtype, index) pod at any instant,
+    and every resize converging — world published, staging record cleared,
+    zero stuck Resizing conditions — before the jobs run to Succeeded.
+
+    Runs under the lock-order sentinel (see :func:`run_soak`).
+    """
+    with lockgraph.audit():
+        report = _run_resize_soak_inner(seed, config, kills, resize_events,
+                                        storm_kills, timeout, opt_overrides)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_resize_soak_inner(
+    seed: int,
+    config: Optional[ChaosConfig],
+    kills: int,
+    resize_events: int,
+    storm_kills: int,
+    timeout: float,
+    opt_overrides: Optional[Dict[str, Any]],
+) -> Dict[str, Any]:
+    trainer_stop = threading.Event()
+    finish_gate = threading.Event()  # held closed until resizes converge
+    prefix, _, inner, chaos, admin, tracker, _ = _soak_harness(
+        seed, "r", config, cases=[])
+    cases, workloads = elastic_matrix(prefix, admin, trainer_stop, finish_gate)
+    pod_tracker = LivePodTracker()
+    inner.hooks.append(pod_tracker.hook)
+    scripts = [s for case in cases for s in case.scripts]
+    rng = random.Random(f"{seed}:resize-kill")
+    started = time.monotonic()
+    trace_started0, trace_closed0 = TRACER.counters()
+
+    overrides = {"resize_drain_grace_s": 0.5, **(opt_overrides or {})}
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts)
+    app = _start_app(chaos, overrides)
+    kubelet.start()
+    storm = PreemptionStorm(admin, seed, kills=storm_kills,
+                            prefix=prefix).start()
+    resize_storm = ResizeStorm(
+        admin, {case.job.metadata.name: 2 for case in cases}, seed,
+        events=resize_events).start()
+    kill_log: List[Dict[str, float]] = []
+    try:
+        for case in cases:
+            admin.tpujobs.create(case.job)
+        for _ in range(kills):
+            # seeded mid-flight hard kill: a resize may be mid-stage — the
+            # restarted controller must resume it from status.resize
+            time.sleep(rng.uniform(0.6, 1.4))
+            app.hard_kill()
+            headless_s = rng.uniform(0.05, 0.4)
+            time.sleep(headless_s)
+            app = _start_app(chaos, overrides)
+            kill_log.append({"headless_s": round(headless_s, 3)})
+        if not resize_storm.wait(30):  # let the WHOLE schedule land,
+            # final-size pins included — aborting mid-loop could leave a
+            # job that never resized, which has no convergence to observe
+            raise AssertionError(f"seed {seed}: resize storm wedged")
+        deadline = started + timeout
+        names = sorted(workloads)
+        while time.monotonic() < deadline and not all(
+                _resize_converged(admin, n) for n in names):
+            time.sleep(0.05)
+        not_converged = [n for n in names if not _resize_converged(admin, n)]
+        if not_converged:
+            detail = {n: (admin.tpujobs.get("default", n).metadata.annotations)
+                      for n in not_converged}
+            raise AssertionError(
+                f"seed {seed}: resizes never converged within {timeout}s: "
+                f"{detail}")
+        # resizes done: open the completion gate and let training finish
+        finish_gate.set()
+        _converge_or_fail(admin, cases, deadline, seed,
+                          f" within {timeout}s after the resize storm")
+        storm.stop()
+        problems = _settle_invariants(admin, app.controller, cases, tracker,
+                                      chaos, deadline)
+        problems += _resize_job_problems(admin, workloads, pod_tracker)
+        if problems:
+            raise AssertionError(
+                f"seed {seed}: resize invariants violated:\n  "
+                + "\n  ".join(problems))
+        report = {
+            "mode": "resize",
+            "seed": seed,
+            "jobs": len(cases),
+            "controller_kills": kills,
+            "kill_schedule": kill_log,
+            "resizes_applied": resize_storm.applied,
+            "final_sizes": resize_storm.final,
+            "ledgers": {n: {k: v for k, v in wl.ledger.snapshot().items()
+                            if k != "violations"}
+                        for n, wl in sorted(workloads.items())},
+            "duration_s": round(time.monotonic() - started, 3),
+            "api_faults": len(chaos.injected),
+            "storm_strikes": storm.struck,
+            "invariants": "ok",
+        }
+    finally:
+        trainer_stop.set()
+        finish_gate.set()
+        resize_storm.stop()
+        storm.stop()
+        kubelet.stop()
+        app.shutdown()
+    # controller incarnations died mid-run by design: only the process-wide
+    # root-span ledger must balance (the crash-soak rule)
+    trace_problems, trace_stats = check_trace_ledger(trace_started0,
+                                                     trace_closed0)
+    if trace_problems:
+        raise AssertionError(
+            f"seed {seed}: trace ledger violated across the resize storm:\n  "
+            + "\n  ".join(trace_problems))
+    report["trace"] = trace_stats
+    return report
+
+
+def run_resize_smoke(seed: int = 11, timeout: float = 30.0) -> Dict[str, Any]:
+    """The fast resize acceptance gate (``make resize-smoke``): scale a LIVE
+    master-less job 2 -> 4 -> 2 workers with no injected faults.  Asserts
+    the headline contract: the two surviving pods keep their UIDs and zero
+    container restarts across BOTH resizes, the drain runs its checkpoint
+    barrier (workload ack, not grace timeout), the checkpoint/restore
+    ledger shows two lossless re-rendezvous, and the job then trains to
+    Succeeded with zero counted restarts.
+
+    Runs under the lock-order sentinel (see :func:`run_soak`).
+    """
+    with lockgraph.audit():
+        report = _run_resize_smoke_inner(seed, timeout)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_resize_smoke_inner(seed: int, timeout: float) -> Dict[str, Any]:
+    no_faults = ChaosConfig(
+        error_rate=0.0, timeout_rate=0.0, conflict_rate=0.0, latency_rate=0.0,
+        kill_watch_every=0, compact_every=0, duplicate_event_rate=0.0,
+    )
+    trainer_stop = threading.Event()
+    finish_gate = threading.Event()
+    prefix, _, inner, chaos, admin, tracker, _ = _soak_harness(
+        seed, "z", no_faults, cases=[])
+    pod_tracker = LivePodTracker()
+    inner.hooks.append(pod_tracker.hook)
+    name = f"{prefix}-elastic"
+    wl = ElasticWorkload(admin, name, initial_world=2,
+                         total_steps=RESIZE_SOAK_STEPS,
+                         stop_event=trainer_stop, finish_gate=finish_gate)
+    case = JobCase(
+        job=_job(name, {
+            "runPolicy": {"backoffLimit": 10},
+            "tpuReplicaSpecs": {
+                "Worker": {"replicas": 2,
+                           "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                           "template": _tmpl()},
+            },
+        }),
+        scripts=wl.scripts(),
+        expect_terminal="Succeeded",
+    )
+    started = time.monotonic()
+    deadline = started + timeout
+
+    def _wait(pred, what: str) -> None:
+        if not _wait_for(pred, max(0.1, deadline - time.monotonic())):
+            raise AssertionError(f"resize smoke: timed out waiting for {what}")
+
+    def _worker_pods():
+        return {p.metadata.name: p for p in admin.pods.list()
+                if p.metadata.labels.get(c.LABEL_JOB_NAME) == name}
+
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=case.scripts)
+    app = _start_app(chaos, {"resize_drain_grace_s": 10.0})
+    kubelet.start()
+    resizes: List[Dict[str, Any]] = []
+    try:
+        admin.tpujobs.create(case.job)
+        _wait(lambda: len(_worker_pods()) == 2 and all(
+            p.status.phase == "Running" for p in _worker_pods().values()),
+            "2 workers Running")
+        _wait(lambda: wl.ledger.snapshot()["progress"] > 0, "training steps")
+        survivors = {n: p.metadata.uid for n, p in _worker_pods().items()}
+
+        for target in (4, 2):
+            t0 = time.monotonic()
+            admin.tpujobs.patch("default", name, {
+                "spec": {"tpuReplicaSpecs": {"Worker": {"replicas": target}}}})
+            _wait(lambda: _resize_converged(admin, name),
+                  f"resize to {target} workers to converge")
+            pods = _worker_pods()
+            if len(pods) != target:
+                raise AssertionError(
+                    f"resize smoke: {len(pods)} pods after resize to {target}")
+            for n, uid in survivors.items():
+                pod = pods.get(n)
+                if pod is None or pod.metadata.uid != uid:
+                    raise AssertionError(
+                        f"resize smoke: surviving pod {n} was restarted "
+                        f"(uid {uid} -> "
+                        f"{pod.metadata.uid if pod else 'GONE'})")
+                restarts = sum(cs.restart_count
+                               for cs in pod.status.container_statuses)
+                if restarts:
+                    raise AssertionError(
+                        f"resize smoke: surviving pod {n} shows "
+                        f"{restarts} container restart(s)")
+            resizes.append({"target": target,
+                            "converged_s": round(time.monotonic() - t0, 3)})
+        if 2 not in wl.acked:
+            raise AssertionError(
+                f"resize smoke: drain barrier never acked (acked="
+                f"{wl.acked}) — the shrink proceeded on grace timeout, not "
+                "the checkpoint barrier")
+        finish_gate.set()
+        _wait(lambda: _all_converged(admin, [case]), "job completion")
+        problems = _settle_invariants(admin, app.controller, [case], tracker,
+                                      chaos, deadline)
+        problems += _resize_job_problems(admin, {name: wl}, pod_tracker)
+        job = admin.tpujobs.get("default", name)
+        restarts = sum(rs.restarts
+                       for rs in job.status.replica_statuses.values())
+        if restarts:
+            problems.append(
+                f"{name}: {restarts} counted restart(s) — a staged resize "
+                "must not register as a restart")
+        snap = wl.ledger.snapshot()
+        if snap["rejoins"] < 2:
+            problems.append(
+                f"{name}: expected 2 resize re-rendezvous (grow + shrink), "
+                f"saw {snap['rejoins']}")
+        if any(kind != "rejoin" for kind, _, _ in snap["restores"]):
+            problems.append(
+                f"{name}: unexpected crash restores in a fault-free smoke: "
+                f"{snap['restores']}")
+        if problems:
+            raise AssertionError(
+                "resize smoke invariants violated:\n  " + "\n  ".join(problems))
+        return {
+            "mode": "resize-smoke",
+            "seed": seed,
+            "resizes": resizes,
+            "ledger": {k: v for k, v in snap.items() if k != "violations"},
+            "duration_s": round(time.monotonic() - started, 3),
+            "invariants": "ok",
+        }
+    finally:
+        trainer_stop.set()
+        finish_gate.set()
+        kubelet.stop()
+        app.shutdown()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     import json
 
     parser = argparse.ArgumentParser(description="one seeded chaos soak run")
     parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--mode", choices=("api", "crash", "failover", "shard"),
+    parser.add_argument("--mode",
+                        choices=("api", "crash", "failover", "shard", "resize"),
                         default="api",
                         help="api = transport faults only; crash = + seeded "
                              "controller kills; failover = warm-standby "
                              "leader kill + fencing probes; shard = N-member "
-                             "sharded fleet under a membership storm")
+                             "sharded fleet under a membership storm; "
+                             "resize = seeded elastic-resize storms over "
+                             "live jobs + faults + a controller kill")
     parser.add_argument("--storm-kills", type=int, default=6)
     parser.add_argument("--timeout", type=float, default=60.0)
     parser.add_argument("--verbose", action="store_true")
@@ -1557,6 +2004,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.mode == "shard":
         report = run_shard_soak(args.seed, storm_kills=args.storm_kills,
                                 timeout=args.timeout)
+    elif args.mode == "resize":
+        report = run_resize_soak(args.seed, storm_kills=args.storm_kills,
+                                 timeout=args.timeout)
     else:
         report = run_soak(args.seed, storm_kills=args.storm_kills,
                           timeout=args.timeout)

@@ -1,0 +1,440 @@
+"""Elastic training workload model for the resize chaos tier.
+
+The in-process stand-in for a real elastic JAX training job, built on the
+REAL workload-side protocol pieces (``tpujob.workloads.distributed``:
+``parse_world_signal`` / ``plan_resize``) so the soak exercises the same
+drain/join contract a production container would follow:
+
+- every pod runs one :class:`ElasticLedger`-backed trainer loop through the
+  kubelet simulator's ``exec_fn`` seam (one thread per container lifetime);
+- the published world arrives as job annotations (the controller's
+  publication channel; a real pod would read them via a downward-API mount);
+- a pending drain makes every process checkpoint (the barrier), the
+  coordinator ack the target, and stepping pause until the republish —
+  pausing after the barrier is what makes a clean resize lossless;
+- a republish makes survivors checkpoint-then-re-rendezvous-then-restore
+  (``PLAN_REJOIN``), and a recreated coordinator pod restores from the last
+  checkpoint (the orbax ``restore_latest`` contract).
+
+The ledger enforces the data-plane invariants as they happen:
+
+1. the checkpoint step never decreases;
+2. progress never falls below the checkpoint (no progress is ever lost
+   PAST the last checkpoint — the resize soak's headline invariant);
+3. every restore lands exactly on the then-current checkpoint.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from e2e.kubelet import PodScript
+from tpujob.analysis import lockgraph
+from tpujob.api import constants as c
+from tpujob.kube.client import RESOURCE_TPUJOBS, ClientSet
+from tpujob.kube.errors import ApiError, NotFoundError
+from tpujob.workloads.distributed import (
+    PLAN_CHECKPOINT,
+    PLAN_LEAVE,
+    PLAN_REJOIN,
+    ProcessEnv,
+    parse_world_signal,
+    plan_resize,
+)
+
+
+class ElasticLedger:
+    """The durable training truth of one elastic job.
+
+    ``progress`` models the global step held in device memory; ``checkpoint``
+    models the last orbax-persisted step (which survives pod churn and
+    resizes); ``world`` is the world size the runtime is currently
+    rendezvoused at.  Violations of the checkpoint/restore contract are
+    recorded the moment they would happen, not reconstructed afterwards.
+    """
+
+    def __init__(self, job: str, initial_world: int):
+        self.job = job
+        self._lock = lockgraph.new_lock(f"elastic-ledger-{job}")
+        self.progress = 0  # guarded by self._lock
+        self.checkpoint = 0  # guarded by self._lock
+        self.world = initial_world  # guarded by self._lock
+        # resize epoch of the world above (the resize-generation annotation):
+        # rejoins apply monotonically, so a replica holding a STALE
+        # annotation read cannot re-rendezvous the job backwards after a
+        # sibling already moved it forward
+        self.generation = 0  # guarded by self._lock
+        self.paused = False  # guarded by self._lock; drain barrier hit
+        self.done = False  # guarded by self._lock
+        self.restores: List[Tuple[str, int, int]] = []  # guarded by self._lock; (kind, before, after)
+        self.rejoins = 0  # guarded by self._lock; resize-driven re-rendezvous
+        self.violations: List[str] = []  # guarded by self._lock
+
+    # -- contract-checked mutations (each documents one protocol step) ------
+
+    def _set_checkpoint(self, step: int) -> None:  # caller holds self._lock
+        if step < self.checkpoint:
+            self.violations.append(
+                f"{self.job}: checkpoint regressed {self.checkpoint} -> {step}")
+        self.checkpoint = max(self.checkpoint, step)
+
+    def step(self, total_steps: int, may_finish: bool = True) -> bool:
+        """One coordinator training step; False once the run is complete.
+        ``may_finish`` gates completion (the soak holds jobs alive until the
+        resize staging it wants to observe has converged — a finished job
+        freezes, and a resize that raced completion would be unobservable)."""
+        with self._lock:
+            if self.done:
+                return False
+            if self.paused:
+                return True  # drain barrier: stepping paused until republish
+            self.progress += 1
+            if may_finish and self.progress >= total_steps:
+                self.done = True
+            return not self.done
+
+    def periodic_checkpoint(self, every: int) -> None:
+        with self._lock:
+            if not self.paused and self.progress - self.checkpoint >= every:
+                self._set_checkpoint(self.progress)
+
+    def barrier(self) -> int:
+        """Drain pending: checkpoint NOW and pause stepping (collectives
+        with the leaving hosts would hang anyway).  Returns the acked step."""
+        with self._lock:
+            self._set_checkpoint(self.progress)
+            self.paused = True
+            return self.checkpoint
+
+    def resume(self) -> None:
+        """The pending drain vanished without a world change (a flap rolled
+        back): resume stepping at the same world."""
+        with self._lock:
+            self.paused = False
+
+    def rejoin(self, new_world: int, generation: int) -> None:
+        """The world republished: checkpoint (the runtime is still healthy —
+        its state is in device memory until the re-initialize tears it
+        down), re-rendezvous, restore.  Lossless by contract.  Guarded by
+        the resize epoch: a stale signal (older generation) is ignored."""
+        with self._lock:
+            if generation <= self.generation:
+                return  # stale signal, or a sibling already rendezvoused
+            self.generation = generation
+            if self.world == new_world:
+                return
+            before = self.progress
+            self._set_checkpoint(self.progress)
+            restored = self.checkpoint
+            if restored != before:
+                self.violations.append(
+                    f"{self.job}: resize rejoin lost progress "
+                    f"{before} -> {restored} (checkpoint-then-restore must "
+                    "be lossless)")
+            self.progress = restored
+            self.world = new_world
+            self.paused = False
+            self.rejoins += 1
+            self.restores.append(("rejoin", before, restored))
+
+    def crash_restore(self) -> None:
+        """A recreated coordinator pod: device state died with the old pod;
+        restore from the last checkpoint.  Loss up to the checkpoint
+        interval is allowed — loss PAST the checkpoint is not."""
+        with self._lock:
+            before = self.progress
+            restored = self.checkpoint
+            if restored > before:
+                self.violations.append(
+                    f"{self.job}: restore ahead of progress "
+                    f"{before} -> {restored}")
+            self.progress = restored
+            self.paused = False
+            self.restores.append(("pod-restart", before, restored))
+
+    def is_done(self) -> bool:
+        with self._lock:
+            return self.done
+
+    def current_world(self) -> int:
+        with self._lock:
+            return self.world
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "progress": self.progress,
+                "checkpoint": self.checkpoint,
+                "world": self.world,
+                "done": self.done,
+                "rejoins": self.rejoins,
+                "restores": list(self.restores),
+                "violations": list(self.violations),
+            }
+
+
+class ElasticWorkload:
+    """PodScript factory for one elastic job: every replica runs the real
+    workload-side planner against the job's published annotations."""
+
+    def __init__(
+        self,
+        admin: ClientSet,
+        job_name: str,
+        initial_world: int,
+        total_steps: int = 40,
+        checkpoint_every: int = 7,
+        tick_s: float = 0.01,
+        has_master: bool = False,
+        namespace: str = "default",
+        stop_event: Optional[threading.Event] = None,
+        finish_gate: Optional[threading.Event] = None,
+    ):
+        self.admin = admin
+        self.job_name = job_name
+        self.ns = namespace
+        self.total_steps = total_steps
+        self.checkpoint_every = checkpoint_every
+        self.tick_s = tick_s
+        self.has_master = has_master
+        self.initial_world = initial_world
+        self.stop_event = stop_event or threading.Event()
+        # completion gate: until set, the trainer keeps stepping past
+        # total_steps (default: open — finish as soon as the steps are done)
+        self.finish_gate = finish_gate or threading.Event()
+        if finish_gate is None:
+            self.finish_gate.set()
+        self.ledger = ElasticLedger(job_name, initial_world)
+        # targets this workload acked a checkpoint barrier for (appended by
+        # the coordinator's ack path; the annotation itself is consumed by
+        # the controller when the resize commits)
+        self.acked: List[int] = []
+
+    # -- the per-container trainer loop -------------------------------------
+
+    def _annotations(self) -> Optional[Dict[str, str]]:
+        try:
+            job = self.admin.tpujobs.get(self.ns, self.job_name)
+        except ApiError:
+            return None  # job gone or transport hiccup: next tick decides
+        return dict(job.metadata.annotations or {})
+
+    def _pod_alive(self, pod_name: str) -> bool:
+        try:
+            self.admin.pods.get(self.ns, pod_name)
+            return True
+        except NotFoundError:
+            return False
+        except ApiError:
+            return True  # transient: assume alive, next tick re-checks
+
+    def _ack(self, target_world: int, annotations: Dict[str, str]) -> None:
+        """Coordinator checkpoint ack: tell the controller the barrier is
+        hit for this target (idempotent; unconditional patch is fine — the
+        value is the same from every writer)."""
+        if annotations.get(c.ANNOTATION_CHECKPOINT_ACK) == str(target_world):
+            return
+        try:
+            self.admin.server.patch(
+                RESOURCE_TPUJOBS, self.ns, self.job_name,
+                {"metadata": {"annotations": {
+                    c.ANNOTATION_CHECKPOINT_ACK: str(target_world)}}})
+            self.acked.append(target_world)
+        except ApiError:
+            pass  # retried next tick
+
+    def _run(self, pod_name: str, process_id: int, attempt: int) -> int:
+        led = self.ledger
+        if attempt > 0 and process_id == 0:
+            # recreated coordinator: device state died with the old pod —
+            # the orbax restore_latest contract, not a cold start
+            led.crash_restore()
+        alive_check = 0
+        while not self.stop_event.is_set():
+            if led.is_done():
+                return 0  # trained to completion: container exits 0
+            annotations = self._annotations()
+            if annotations is None:
+                time.sleep(self.tick_s)
+                continue
+            world = led.current_world()
+            pe = ProcessEnv(
+                coordinator_address="coordinator:8476",
+                num_processes=world, process_id=process_id,
+                num_slices=1, slice_id=0, devices_per_host=None,
+                global_devices=None, accelerator=None, topology=None)
+            signal = parse_world_signal(annotations, self.initial_world)
+            plan = plan_resize(pe, signal)
+            if plan in (PLAN_CHECKPOINT, PLAN_LEAVE):
+                led.barrier()
+                if process_id == 0:
+                    self._ack(signal.target_world_size, annotations)
+            elif plan == PLAN_REJOIN:
+                led.rejoin(signal.world_size, signal.resize_generation)
+            else:
+                led.resume()
+                if process_id == 0:
+                    if not led.step(self.total_steps,
+                                    self.finish_gate.is_set()):
+                        return 0
+                    led.periodic_checkpoint(self.checkpoint_every)
+            # a drained (or preempted) pod's container loop ends when its
+            # pod object disappears; checking every few ticks keeps the
+            # API chatter bounded
+            alive_check += 1
+            if alive_check % 5 == 0 and not self._pod_alive(pod_name):
+                return 0
+            time.sleep(self.tick_s)
+        return 0
+
+    # -- PodScript wiring ----------------------------------------------------
+
+    def scripts(self, max_workers: int = 6) -> List[PodScript]:
+        """One exec-driven PodScript per possible replica (pre-registered up
+        to ``max_workers`` so a grow finds its script).  Master (when
+        present) is process 0; worker i is process i(+1 with a master)."""
+        out: List[PodScript] = []
+
+        def make(pod_name: str, pid: int) -> Callable[[int], int]:
+            return lambda attempt: self._run(pod_name, pid, attempt)
+
+        if self.has_master:
+            name = f"{self.job_name}-master-0"
+            out.append(PodScript(match=name, exec_fn=make(name, 0)))
+        for i in range(max_workers):
+            pid = i + 1 if self.has_master else i
+            name = f"{self.job_name}-worker-{i}"
+            out.append(PodScript(match=name, exec_fn=make(name, pid)))
+        return out
+
+
+class ResizeStorm:
+    """Seeded mid-flight ``spec.replicas`` mutator: grows, shrinks and
+    flap-mid-resize rewrites through the admin (fault-free) client — the
+    CONTROLLER sees them through its chaos-faulted watch.  Ends by pinning
+    each job to a seeded final size different from its initial one, so
+    every run stages at least one full resize per job."""
+
+    def __init__(self, admin: ClientSet, jobs: Dict[str, int], seed: int,
+                 events: int = 4, min_workers: int = 1, max_workers: int = 4,
+                 interval: Tuple[float, float] = (0.25, 0.7),
+                 namespace: str = "default"):
+        self.admin = admin
+        self.jobs = dict(jobs)  # job name -> initial worker count
+        self.rng = random.Random(f"{seed}:resize-storm")
+        self.events = events
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.interval = interval
+        self.ns = namespace
+        self.applied: List[Tuple[str, int]] = []
+        self.final: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ResizeStorm":
+        # start before publish: a concurrent stop() must never see (and
+        # join) a created-but-unstarted Thread (TPL001)
+        storm = threading.Thread(target=self._loop, daemon=True,
+                                 name="resize-storm")
+        storm.start()
+        self._thread = storm
+        return self
+
+    def stop(self) -> None:
+        """Abort mid-loop (teardown path); the final-size pins may be
+        skipped — use :meth:`wait` to let a run finish its schedule."""
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the storm ran its WHOLE schedule (events + the
+        final-size pins that guarantee every job stages at least one real
+        resize).  Returns False if it is still running at the timeout."""
+        if self._thread:
+            self._thread.join(timeout)
+            return not self._thread.is_alive()
+        return True
+
+    def _patch_workers(self, job: str, workers: int) -> None:
+        try:
+            self.admin.tpujobs.patch(self.ns, job, {
+                "spec": {"tpuReplicaSpecs": {"Worker": {"replicas": workers}}}})
+            self.applied.append((job, workers))
+        except ApiError:
+            pass  # job finished/deleted under the storm: skip the event
+
+    def _loop(self) -> None:
+        names = sorted(self.jobs)
+        current = dict(self.jobs)
+        for _ in range(self.events):
+            if self._stop.wait(self.rng.uniform(*self.interval)):
+                return
+            job = names[self.rng.randrange(len(names))]
+            choices = [n for n in range(self.min_workers, self.max_workers + 1)
+                       if n != current[job]]
+            workers = self.rng.choice(choices)
+            self._patch_workers(job, workers)
+            current[job] = workers
+            if self.rng.random() < 0.4:
+                # flap mid-resize: rewrite the target before the first
+                # staging can possibly complete
+                time.sleep(self.rng.uniform(0.01, 0.08))
+                choices = [n for n in
+                           range(self.min_workers, self.max_workers + 1)
+                           if n != current[job]]
+                workers = self.rng.choice(choices)
+                self._patch_workers(job, workers)
+                current[job] = workers
+        # pin each job to a final size != initial: every run completes at
+        # least one real resize per job (the acceptance gate needs staged
+        # resizes, not just flaps)
+        for job in names:
+            final = current[job]
+            if final == self.jobs[job]:
+                choices = [n for n in
+                           range(self.min_workers, self.max_workers + 1)
+                           if n != self.jobs[job]]
+                final = self.rng.choice(choices)
+                self._patch_workers(job, final)
+            self.final[job] = final
+
+
+class LivePodTracker:
+    """Continuous no-duplicate-pod invariant: watches the committed event
+    stream (an inner-server hook) and records any instant where two live
+    pods share one (job, replica type, replica index) slot — the end-state
+    check alone would miss a transient double that healed."""
+
+    def __init__(self):
+        self._lock = lockgraph.new_lock("live-pod-tracker")
+        self._live: Dict[Tuple[str, str, str], str] = {}  # guarded by self._lock
+        self.violations: List[str] = []  # guarded by self._lock
+
+    def hook(self, ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
+        if resource != "pods":
+            return
+        meta = obj.get("metadata") or {}
+        labels = meta.get("labels") or {}
+        slot = (labels.get(c.LABEL_JOB_NAME) or "",
+                labels.get(c.LABEL_REPLICA_TYPE) or "",
+                labels.get(c.LABEL_REPLICA_INDEX) or "")
+        if not slot[0]:
+            return
+        name = meta.get("name") or ""
+        with self._lock:
+            if ev_type == "ADDED":
+                holder = self._live.get(slot)
+                if holder is not None and holder != name:
+                    self.violations.append(
+                        f"duplicate live pods for {slot}: {holder} and {name}")
+                self._live[slot] = name
+            elif ev_type == "DELETED" and self._live.get(slot) == name:
+                del self._live[slot]
+
+    def problems(self) -> List[str]:
+        with self._lock:
+            return list(self.violations)

@@ -11,12 +11,16 @@ reproduces the same fault schedule byte for byte.
 ``--crash`` adds the controller-lifecycle tiers per seed: a seeded schedule
 of controller hard-kills + cold restarts (``run_crash_soak``), a
 two-candidate warm-standby failover with write-fencing probes
-(``run_failover_soak``), and the sharded-control-plane storm
+(``run_failover_soak``), the sharded-control-plane storm
 (``run_shard_soak``: 3 controllers sharding the job set under member
-kill/flap/rejoin churn) — the crash-only acceptance gate: all invariants
-hold across every kill, zero writes are accepted from a fenced leader or a
-deposed shard owner, and every job is synced by exactly one owner per
-shard-lease generation.
+kill/flap/rejoin churn), and the elastic-resize storm (``run_resize_soak``:
+seeded grow/shrink/flap ``spec.replicas`` rewrites over LIVE jobs plus a
+controller hard-kill; invariants: no progress lost past the last
+checkpoint, never a duplicate pod at any instant, every resize converges)
+— the crash-only acceptance gate: all invariants hold across every kill,
+zero writes are accepted from a fenced leader or a deposed shard owner,
+and every job is synced by exactly one owner per shard-lease generation.
+``--resize`` runs just the resize tier on top of the API tier.
 
 Usage:
     python soak.py                      # default 5 seeds x 5 jobs = 25 jobs
@@ -35,7 +39,13 @@ import sys
 import time
 from typing import List, Optional
 
-from e2e.chaos import run_crash_soak, run_failover_soak, run_shard_soak, run_soak
+from e2e.chaos import (
+    run_crash_soak,
+    run_failover_soak,
+    run_resize_soak,
+    run_shard_soak,
+    run_soak,
+)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -47,8 +57,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--storm-kills", type=int, default=6,
                         help="preemption-storm strikes per seed")
     parser.add_argument("--crash", action="store_true",
-                        help="also run the controller-kill and warm-standby "
-                             "failover schedules for every seed")
+                        help="also run the controller-kill, warm-standby "
+                             "failover, shard-storm and elastic-resize "
+                             "schedules for every seed")
+    parser.add_argument("--resize", action="store_true",
+                        help="also run the elastic-resize storm tier for "
+                             "every seed (included in --crash)")
     parser.add_argument("--timeout", type=float, default=60.0,
                         help="per-seed convergence timeout (s)")
     parser.add_argument("--verbose", action="store_true",
@@ -71,6 +85,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             seed, storm_kills=args.storm_kills, timeout=args.timeout)))
         runs.append(("shard", lambda seed: run_shard_soak(
             seed, storm_kills=args.storm_kills, timeout=args.timeout)))
+    if args.crash or args.resize:
+        # elastic-resize tier: seeded grow/shrink/flap storms over live
+        # jobs + the API fault schedule + a controller hard-kill per seed.
+        # Floored deadline: convergence is ~3s nominal but the tier runs
+        # ~15 threads that a loaded host schedules slowly
+        runs.append(("resize", lambda seed: run_resize_soak(
+            seed, timeout=max(args.timeout, 120.0))))
 
     failures = 0
     total_jobs = 0

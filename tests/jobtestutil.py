@@ -59,6 +59,11 @@ class Harness:
 
     def __init__(self, config: Optional[ControllerConfig] = None):
         self.server = InMemoryAPIServer()
+        # UPDATE admission on, like the real app wiring: spec updates other
+        # than Worker replicas are rejected server-side
+        from tpujob.api.validation import install_tpujob_admission
+
+        install_tpujob_admission(self.server)
         self.clients = ClientSet(self.server)
         self.controller = TPUJobController(self.clients, config=config)
 

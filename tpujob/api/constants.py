@@ -45,6 +45,7 @@ TPU_TOPOLOGY_NODE_SELECTOR = "cloud.google.com/gke-tpu-topology"
 JOB_CREATED = "Created"
 JOB_RUNNING = "Running"
 JOB_RESTARTING = "Restarting"
+JOB_RESIZING = "Resizing"  # elastic resize (staged drain/join) in flight
 JOB_SUCCEEDED = "Succeeded"
 JOB_FAILED = "Failed"
 
@@ -62,3 +63,25 @@ CLEAN_POD_POLICY_ALL = "All"
 # --- gang scheduling ---------------------------------------------------------
 DEFAULT_GANG_SCHEDULER_NAME = "volcano"
 POD_GROUP_ANNOTATION = "scheduling.k8s.io/group-name"
+
+# --- elastic resize: the world-size publication channel ----------------------
+# Pod env (TPUJOB_NUM_PROCESSES) is bootstrap-only — it names the world the
+# pod was BORN into and cannot change without a restart, which is exactly
+# what an elastic resize must avoid.  The live world is published on job
+# annotations instead (a real pod reads them through a downward-API mount;
+# the in-process harness reads the job object):
+#
+# - WORLD_SIZE: the world size currently in effect — every live replica has
+#   rendezvoused (or must re-rendezvous) at this size.  Written only by the
+#   controller, only after the join/drain staging completed.
+# - TARGET_WORLD_SIZE: a pending resize's destination, published BEFORE any
+#   drain deletion so the workload can hit a checkpoint barrier first.
+# - RESIZE_GENERATION: bumped on every completed resize — the workload's
+#   cheap change detector.
+# - CHECKPOINT_ACK: written by the WORKLOAD (coordinator process): the
+#   target world size it has checkpointed for.  The controller's drain
+#   barrier waits for this ack (bounded by the drain grace period).
+ANNOTATION_WORLD_SIZE = f"{GROUP_NAME}/world-size"
+ANNOTATION_TARGET_WORLD_SIZE = f"{GROUP_NAME}/target-world-size"
+ANNOTATION_RESIZE_GENERATION = f"{GROUP_NAME}/resize-generation"
+ANNOTATION_CHECKPOINT_ACK = f"{GROUP_NAME}/checkpoint-ack"

@@ -154,6 +154,26 @@ class ReplicaStatus(K8sObject):
 
 
 @dataclass
+class ResizeStatus(K8sObject):
+    """Durable staging record of an in-flight elastic resize.
+
+    The controller persists it the moment a resize is detected and clears it
+    when the new world size is published, so a crashed/restarted controller
+    (or a rebalanced-in shard owner) resumes a half-finished resize from
+    status instead of abandoning it.  Everything else about the resize —
+    which pods are beyond the target, which are missing — is re-derived from
+    live cluster state each sync; only the staging intent (target, phase,
+    barrier anchor) needs to survive the process."""
+
+    replica_type: str = ""  # only Worker is elastic today
+    from_replicas: Optional[int] = None  # world size when the resize began
+    target_replicas: Optional[int] = None  # world size being staged toward
+    phase: str = ""  # Draining (scale-down barrier) | Joining (scale-up)
+    started_at: Optional[str] = None  # drain-barrier grace anchor (wall)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
 class JobStatus(K8sObject):
     """Mirrors kubeflow/common JobStatus (types.go:23-45)."""
 
@@ -164,6 +184,14 @@ class JobStatus(K8sObject):
     start_time: Optional[str] = None
     completion_time: Optional[str] = None
     last_reconcile_time: Optional[str] = None
+    # metadata.generation of the spec this status was computed from: lets
+    # drift repair and the flight recorder distinguish a spec change (resize,
+    # runPolicy tweak) from status churn, and lets a restarted controller
+    # know whether missing pods mean node loss (observed == generation) or a
+    # half-applied resize (observed < generation)
+    observed_generation: Optional[int] = None
+    # in-flight elastic resize staging record (absent when no resize active)
+    resize: Optional[ResizeStatus] = field(default=None, metadata={"cls": ResizeStatus})
     extra: Dict[str, Any] = field(default_factory=dict)
 
 

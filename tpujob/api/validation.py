@@ -1,14 +1,23 @@
-"""Validation for TPUJob specs.
+"""Validation for TPUJob specs — at creation and at UPDATE admission.
 
 Mirrors reference ``pkg/apis/pytorch/validation/validation.go:23-77``:
 spec non-nil, only Master/Worker replica types, containers present, image
 defined, a managed container present, at most one Master replica.
 TPU-first additions: topology consistency (accelerator parses, chip grid
 matches chip count, replicas-vs-host-count coherence).
+
+UPDATE admission (:func:`validate_tpujob_update` +
+:func:`install_tpujob_admission`): with elastic resize, ``spec.replicas``
+on the Worker type is the ONE mutable field of a running job.  Everything
+else — pod templates, slice topology, the Master replica count, the replica
+type set, restart policies — is immutable: mutating them mid-flight cannot
+be reconciled without restarting pods, which is exactly the teardown
+elastic resize exists to avoid.  The validator rejects such updates
+server-side with a per-field error list, before they commit.
 """
 from __future__ import annotations
 
-from typing import List
+from typing import Any, Dict, List, Optional
 
 from tpujob.api import constants as c
 from tpujob.api.topology import TopologyError
@@ -130,3 +139,106 @@ def validate_or_raise(spec: TPUJobSpec, strict_topology: bool = False) -> None:
     errs = validate_tpujob_spec(spec, strict_topology=strict_topology)
     if errs:
         raise ValidationError(errs)
+
+
+# ---------------------------------------------------------------------------
+# UPDATE admission (elastic resize: only Worker replicas may change)
+# ---------------------------------------------------------------------------
+
+
+def _replicas_or_default(rspec) -> int:
+    return rspec.replicas if rspec.replicas is not None else 1
+
+
+def validate_tpujob_update(old: TPUJobSpec, new: TPUJobSpec) -> List[str]:
+    """Per-field error list for a spec UPDATE (empty = admissible).
+
+    Mutable: ``tpuReplicaSpecs[Worker].replicas`` (the elastic resize
+    surface) and the run policy.  Immutable: everything whose change would
+    force a pod restart — templates, slice topology, restart policies, the
+    Master count, the replica type set."""
+    errs: List[str] = []
+    if old is None or new is None:
+        return ["TPUJob update is not valid: spec is nil"]
+    old_types, new_types = set(old.tpu_replica_specs), set(new.tpu_replica_specs)
+    if old_types != new_types:
+        added = sorted(new_types - old_types)
+        removed = sorted(old_types - new_types)
+        detail = "; ".join(
+            s for s in (f"added {added}" if added else "",
+                        f"removed {removed}" if removed else "") if s)
+        errs.append(
+            f"spec.tpuReplicaSpecs: replica types are immutable ({detail})")
+    for rtype in sorted(old_types & new_types):
+        o, n = old.tpu_replica_specs[rtype], new.tpu_replica_specs[rtype]
+        path = f"spec.tpuReplicaSpecs[{rtype}]"
+        if n.replicas is not None and n.replicas < 0:
+            errs.append(f"{path}.replicas: must be >= 0, got {n.replicas}")
+        elif rtype == c.REPLICA_TYPE_MASTER and (
+            _replicas_or_default(o) != _replicas_or_default(n)
+        ):
+            errs.append(
+                f"{path}.replicas: the Master replica count is immutable "
+                f"({_replicas_or_default(o)} -> {_replicas_or_default(n)}); "
+                "only Worker replicas resize")
+        elif (rtype == c.REPLICA_TYPE_WORKER
+              and c.REPLICA_TYPE_MASTER not in old_types
+              and _replicas_or_default(n) < 1):
+            errs.append(
+                f"{path}.replicas: a master-less job must keep >= 1 worker "
+                "(worker 0 is the coordinator)")
+        if o.template.to_dict() != n.template.to_dict():
+            errs.append(f"{path}.template: the pod template is immutable "
+                        "(a template change cannot apply without restarting "
+                        "every pod)")
+        old_tpu = o.tpu.to_dict() if o.tpu is not None else None
+        new_tpu = n.tpu.to_dict() if n.tpu is not None else None
+        if old_tpu != new_tpu:
+            errs.append(f"{path}.tpu: the slice topology is immutable "
+                        f"({old_tpu} -> {new_tpu})")
+        if o.restart_policy != n.restart_policy:
+            errs.append(f"{path}.restartPolicy: immutable "
+                        f"({o.restart_policy!r} -> {n.restart_policy!r})")
+    # the updated spec must still be coherent on its own terms (strict:
+    # a Worker resize on a topology-pinned job breaks replicas-vs-hosts
+    # coherence and must be rejected HERE, not discovered as a Failed
+    # condition after the informers replay it — that would be exactly the
+    # resize-kills-the-job behavior this PR removes)
+    errs.extend(validate_tpujob_spec(new, strict_topology=True))
+    return errs
+
+
+def tpujob_update_admission(verb: str, resource: str,
+                            old: Optional[Dict[str, Any]],
+                            new: Dict[str, Any]) -> None:
+    """Admission-validator shape for ``InMemoryAPIServer.admission_validators``:
+    rejects an inadmissible TPUJob spec UPDATE/PATCH with InvalidError (maps
+    to HTTP 400/422 on the REST surface).  Writes that do not change the
+    spec (status, metadata/annotations) always pass — the controller's own
+    world-size publication rides the ``patch`` verb."""
+    if resource != c.PLURAL or old is None:
+        return
+    old_spec_d = old.get("spec")
+    new_spec_d = new.get("spec")
+    if new_spec_d == old_spec_d:
+        return  # spec untouched: status/metadata writes are not admitted here
+    try:
+        old_spec = TPUJobSpec.from_dict(old_spec_d if isinstance(old_spec_d, dict) else {})
+        new_spec = TPUJobSpec.from_dict(new_spec_d if isinstance(new_spec_d, dict) else {})
+    except (TypeError, ValueError) as e:
+        errs = [f"spec: {e}"]
+    else:
+        errs = validate_tpujob_update(old_spec, new_spec)
+    if errs:
+        from tpujob.kube.errors import InvalidError
+
+        name = (new.get("metadata") or {}).get("name")
+        raise InvalidError(
+            f"TPUJob {name} update rejected: " + "; ".join(errs))
+
+
+def install_tpujob_admission(server) -> None:
+    """Register TPUJob UPDATE admission on an in-memory API server (idempotent)."""
+    validators = getattr(server, "admission_validators", None)
+    if validators is not None and tpujob_update_admission not in validators:
+        validators.append(tpujob_update_admission)

@@ -99,6 +99,13 @@ class ControllerConfig:
     # recreate, the pre-backoff behavior).
     restart_backoff_seconds: float = 1.0
     restart_backoff_max_seconds: float = 300.0
+    # elastic resize drain barrier: how long a scale-down waits for the
+    # workload's checkpoint ack (the tpujob.dev/checkpoint-ack annotation
+    # naming the target world size) before deleting the drained replicas
+    # anyway.  Bounded: a wedged workload cannot block a shrink forever —
+    # the invariant is "no progress lost past the LAST checkpoint", which
+    # holds either way.  <= 0 skips the barrier (delete immediately).
+    resize_drain_grace_s: float = 15.0
     namespace: Optional[str] = None  # None = all namespaces
     # flight-recorder/tracing subsystem (tpujob/obs): per-sync span trees,
     # per-job lifecycle timelines, /debug/* endpoints.  Tracing is process-

@@ -21,7 +21,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from tpujob.api import constants as c
 from tpujob.api.defaults import set_defaults_tpujob
-from tpujob.api.types import ReplicaStatus, TPUJob
+from tpujob.api.types import ReplicaStatus, ResizeStatus, TPUJob
 from tpujob.api.validation import validate_tpujob_spec
 from tpujob.controller import status as st
 from tpujob.controller import tpu_env
@@ -93,6 +93,31 @@ def get_total_replicas(job: TPUJob) -> int:
     )
 
 
+def _replica_index(pod: Pod) -> Optional[int]:
+    try:
+        return int(pod.metadata.labels.get(c.LABEL_REPLICA_INDEX))
+    except (TypeError, ValueError):
+        return None
+
+
+def _pod_env_world(pod: Pod) -> Optional[int]:
+    """The world size this pod was BORN into — its injected
+    ``TPUJOB_NUM_PROCESSES``.  Pod env is bootstrap-only, so live pods are
+    the durable record of the last world they rendezvoused at before the
+    controller ever published an annotation (the first resize of a job has
+    no annotation to read)."""
+    for container in pod.spec.containers:
+        if container.name != c.DEFAULT_CONTAINER_NAME:
+            continue
+        for env in container.env:
+            if env.name == "TPUJOB_NUM_PROCESSES":
+                try:
+                    return int(env.value)
+                except (TypeError, ValueError):
+                    return None
+    return None
+
+
 class TPUJobController(JobController):
     """The operator's reconcile loop over TPUJob resources."""
 
@@ -117,6 +142,11 @@ class TPUJobController(JobController):
         # replacement.  Written only by the worker holding the job's
         # workqueue key (same safety argument as _restart_deltas above).
         self._restart_backoff: Dict[Tuple[str, str, int], Tuple[int, float, float]] = {}
+        # elastic-resize duration anchors (job key -> monotonic staging
+        # start).  Best-effort observability only: the durable anchor is
+        # status.resize.startedAt; this one just keeps the duration metric
+        # off the wall clock.  Same single-writer-per-key safety argument.
+        self._resize_started_mono: Dict[str, float] = {}
 
     # ------------------------------------------------------------------
     # cold-start recovery (crash-only controller semantics)
@@ -296,16 +326,34 @@ class TPUJobController(JobController):
             (new.get("metadata") or {}).get("resourceVersion")
         ):
             return  # periodic resync replay, nothing changed
+        key = self.job_key_of(new)
+        old_gen = int((old.get("metadata") or {}).get("generation") or 0)
+        new_gen = int((new.get("metadata") or {}).get("generation") or 0)
+        if new_gen and new_gen != old_gen:
+            # spec change (generation bump): enqueue IMMEDIATELY, bypassing
+            # the settle window — a resize must not ride an already-
+            # scheduled coalesced sync's latency, and the timeline event
+            # lets the flight recorder distinguish spec changes from the
+            # status churn that dominates MODIFIED traffic
+            if self._owns_key(key):  # sharded: only the owner's timeline
+                self.flight.record(
+                    key, "spec",
+                    f"spec generation {old_gen} -> {new_gen} "
+                    "(replicas/runPolicy changed)",
+                    {"from": old_gen, "to": new_gen})
+            self.enqueue_job(key)
+            return
         # coalesced: most job MODIFIED events are the echo of our own status
         # writes, and they burst together with the pod events of the same
         # reconcile round — one settled sync covers them all
-        self.enqueue_job_event(self.job_key_of(new))
+        self.enqueue_job_event(key)
 
     def _on_job_delete(self, obj: Dict) -> None:
         metrics.jobs_deleted.inc()
         key = self.job_key_of(obj)
         self._restart_deltas.pop(key, None)  # no leak; no carry-over to a
         # future job recreated under the same namespace/name
+        self._resize_started_mono.pop(key, None)  # same hygiene
         for rtype in (c.REPLICA_TYPE_MASTER, c.REPLICA_TYPE_WORKER):
             self.expectations.delete(expectation_key(key, rtype, "pods"))
             self.expectations.delete(expectation_key(key, rtype, "services"))
@@ -404,6 +452,7 @@ class TPUJobController(JobController):
 
         # terminal: clean up and freeze (controller.go:362-389)
         if st.is_finished(job.status):
+            job.status.resize = None  # a finished job has no in-flight resize
             self._delete_pods_and_services(job, pods, services)
             self._cleanup_ttl(job)
             if self.config.enable_gang_scheduling:
@@ -430,9 +479,20 @@ class TPUJobController(JobController):
                 f"TPUJob {job.metadata.name} is created.",
             )
 
+        # elastic resize pre-pass: a spec.replicas change is a STAGED
+        # drain/join transition, not a teardown.  Pods being drained are
+        # excluded from the normal per-type reconcile below — they must not
+        # be counted, restarted, or warned about as out-of-range.
+        with TRACER.span("phase", phase="resize"):
+            draining = self._reconcile_resize(job, pods)
+        drain_names = {p.metadata.name for p in draining}
+
         coord_rtype = tpu_env.coordinator_replica(job)
         for rtype, rspec in job.spec.tpu_replica_specs.items():
             typed_pods = self.filter_by_replica_type(pods, rtype)
+            if drain_names and rtype == c.REPLICA_TYPE_WORKER:
+                typed_pods = [p for p in typed_pods
+                              if p.metadata.name not in drain_names]
             with TRACER.span("phase", phase="pod_diff", rtype=rtype):
                 restarting = self._reconcile_pods(job, typed_pods, rtype, rspec, pods)
             if rtype == coord_rtype:
@@ -738,6 +798,335 @@ class TPUJobController(JobController):
             container.resources.limits.setdefault(c.TPU_RESOURCE, topo.chips_per_host)
 
     # ------------------------------------------------------------------
+    # elastic resize (staged drain/join; ROADMAP item 3)
+    # ------------------------------------------------------------------
+
+    def _reconcile_resize(self, job: TPUJob, pods: List[Pod]) -> List[Pod]:
+        """Stage a mid-flight ``spec.replicas`` change on the Worker type as
+        a drain/join transition instead of a teardown.
+
+        Scale-up (*Joining*): the normal reconcile creates the missing
+        replicas; the new world size publishes (``tpujob.dev/world-size``)
+        only once every in-range replica is Running, so survivors keep
+        training at the old world until the joiners can actually rendezvous.
+
+        Scale-down (*Draining*): the target publishes FIRST
+        (``tpujob.dev/target-world-size``) so the workload can hit a
+        checkpoint barrier; after the ack (or the bounded drain grace) the
+        highest-index replicas are deleted — surviving pods are never
+        touched, and the deletions are not failure strikes.  The shrunk
+        world publishes when the drained pods are gone.
+
+        All staging intent is durable in ``status.resize``; everything else
+        re-derives from live cluster state, so a restarted controller (or a
+        rebalanced-in shard owner, PR 8) resumes a half-finished resize from
+        status.  Every write rides the sync's shard/fencing context like any
+        other reconcile write.
+
+        Returns the pods currently being drained — the caller excludes them
+        from the normal per-type reconcile (no out-of-range warnings, no
+        ExitCode restarts of a pod that is leaving anyway).
+        """
+        rtype = c.REPLICA_TYPE_WORKER
+        rspec = job.spec.tpu_replica_specs.get(rtype)
+        if rspec is None:
+            return []
+        replicas = rspec.replicas if rspec.replicas is not None else 1
+        desired_world = get_total_replicas(job)
+        typed = self.filter_by_replica_type(pods, rtype)
+        over = []
+        for p in typed:
+            index = _replica_index(p)
+            if index is not None and index >= replicas:
+                over.append(p)
+        published = self._published_world(job, typed)
+
+        if not over and (published is None or published == desired_world):
+            ann = job.metadata.annotations or {}
+            if (ann.get(c.ANNOTATION_TARGET_WORLD_SIZE) is not None
+                    or ann.get(c.ANNOTATION_CHECKPOINT_ACK) is not None):
+                # a rolled-back drain can leave its target — and the ack the
+                # workload already wrote for it — behind without ever
+                # publishing a new world: clear BOTH, or the workload would
+                # see a phantom pending drain, and a LATER genuine shrink to
+                # the same target would ride the stale ack past its
+                # checkpoint barrier
+                self._patch_job_annotations(
+                    job, {c.ANNOTATION_TARGET_WORLD_SIZE: None,
+                          c.ANNOTATION_CHECKPOINT_ACK: None})
+            resize = job.status.resize
+            if resize is not None:
+                # the republish landed but a crash/conflict left the staging
+                # record behind (target == current replicas: completed) — or
+                # a flap returned to the origin before any pod moved
+                # (target abandoned, from == current replicas: a rollback)
+                self._finish_resize(
+                    job, desired_world,
+                    rolled_back=(resize.target_replicas != replicas
+                                 and resize.from_replicas == replicas))
+            return []
+
+        # -- a resize is in flight -------------------------------------------
+        direction = "down" if over else (
+            "down" if published is not None and published > desired_world else "up")
+        masters = desired_world - replicas
+        from_workers = (published - masters if published is not None
+                        else len(typed) - len(over))
+        resize = job.status.resize
+        if resize is None or resize.target_replicas != replicas:
+            self._begin_resize(job, rtype, replicas, from_workers, direction,
+                               superseded=resize)
+            resize = job.status.resize
+        st.update_job_conditions(
+            job.status, c.JOB_RESIZING, st.REASON_JOB_RESIZING,
+            f"TPUJob {job.metadata.name} is resizing {rtype} "
+            f"{resize.from_replicas} -> {replicas}.",
+        )
+
+        if direction == "up":
+            resize.phase = "Joining"
+            # join staging: _reconcile_pods creates the missing replicas;
+            # republish only when the full new replica set is Running —
+            # survivors keep the old world until the joiners can rendezvous
+            ready = (len(typed) == replicas
+                     and all(_replica_index(p) is not None
+                             and _replica_index(p) < replicas for p in typed)
+                     and all(p.status.phase == "Running"
+                             and not p.metadata.deletion_timestamp for p in typed))
+            if ready:
+                self._publish_world(job, desired_world)
+                self._finish_resize(job, desired_world)
+            return []
+
+        # -- scale-down ------------------------------------------------------
+        resize.phase = "Draining"
+        if over:
+            # checkpoint barrier: the target publishes BEFORE any deletion.
+            # Skipped when the published world ALREADY equals the target —
+            # then the out-of-range pods are never-rendezvoused joiners of
+            # an abandoned grow (a flap), the survivors hold no state at
+            # risk, and a target==world signal could never make the
+            # workload ack anyway (drain_pending would be False)
+            if published is not None and published != desired_world:
+                self._publish_target(job, desired_world)
+                if not self._drain_barrier_passed(job, desired_world):
+                    grace = self.config.resize_drain_grace_s
+                    self.queue.add_after(job.key,
+                                         max(0.01, min(grace / 4, 1.0)))
+                    return over
+            self._delete_drained_pods(job, rtype, replicas, over)
+            return over
+        # every drained pod is gone: republish the shrunk world
+        self._publish_world(job, desired_world)
+        self._finish_resize(job, desired_world)
+        return []
+
+    def _published_world(self, job: TPUJob, typed: List[Pod]) -> Optional[int]:
+        """The world size the job's live replicas currently operate at: the
+        controller-published annotation when present, else the smallest
+        world any live worker was born into (mid-join pods already carry
+        the larger new world; the survivors' env names the old one), else
+        None — no live workers means there is nothing to drain or join,
+        and the next bring-up simply uses the spec."""
+        ann = (job.metadata.annotations or {}).get(c.ANNOTATION_WORLD_SIZE)
+        if ann:
+            try:
+                return int(ann)
+            except ValueError:
+                _time_warner.warning(
+                    log, ("bad-world-annotation", job.key, ann),
+                    "unparseable %s annotation %r on %s; ignoring",
+                    c.ANNOTATION_WORLD_SIZE, ann, job.key)
+        worlds = [w for w in (_pod_env_world(p) for p in typed) if w]
+        return min(worlds) if worlds else None
+
+    def _begin_resize(self, job: TPUJob, rtype: str, target: int,
+                      from_workers: int, direction: str,
+                      superseded: Optional[ResizeStatus]) -> None:
+        """Open (or restage) the durable resize record and count it."""
+        if superseded is not None and superseded.from_replicas == target:
+            # flap back to the origin: the staged resize is abandoned — a
+            # rollback, not a second resize in the same direction
+            metrics.resize_rollbacks.inc()
+            self.recorder.event(
+                job, "Normal", st.REASON_RESIZE_ROLLED_BACK,
+                f"TPUJob {job.metadata.name} resize to "
+                f"{superseded.target_replicas} {rtype} replica(s) rolled "
+                f"back to {target}.")
+            self.flight.record(
+                job.key, "resize",
+                f"resize to {superseded.target_replicas} superseded: rolling "
+                f"back to the origin ({target})",
+                {"rtype": rtype, "abandoned": superseded.target_replicas,
+                 "target": target})
+        job.status.resize = ResizeStatus(
+            replica_type=rtype,
+            from_replicas=from_workers,
+            target_replicas=target,
+            phase="Draining" if direction == "down" else "Joining",
+            started_at=st.now_iso(),
+        )
+        self._resize_started_mono[job.key] = time.monotonic()
+        metrics.resize_total.labels(direction=direction).inc()
+        self.recorder.event(
+            job, "Normal", st.REASON_JOB_RESIZING,
+            f"TPUJob {job.metadata.name} is resizing {rtype} "
+            f"{from_workers} -> {target} ({direction}).")
+        self.flight.record(
+            job.key, "resize",
+            f"resize staged: {rtype} {from_workers} -> {target} ({direction})",
+            {"rtype": rtype, "from": from_workers, "to": target,
+             "direction": direction})
+
+    def _drain_barrier_passed(self, job: TPUJob, target_world: int) -> bool:
+        """Scale-down checkpoint barrier: wait for the workload's ack (the
+        checkpoint-ack annotation naming the target world) or the bounded
+        drain grace.  Fails open on a corrupt anchor — the barrier bounds
+        progress loss, it must never wedge a shrink."""
+        grace = self.config.resize_drain_grace_s
+        if grace <= 0:
+            return True
+        ack = (job.metadata.annotations or {}).get(c.ANNOTATION_CHECKPOINT_ACK)
+        if ack == str(target_world):
+            return True
+        # precise per-incarnation anchor: a controller that RESUMED a
+        # half-finished drain (crash, shard handoff) re-anchors here and
+        # grants the workload up to one more grace period
+        anchor = self._resize_started_mono.setdefault(job.key, time.monotonic())
+        if time.monotonic() - anchor >= grace:
+            return True
+        resize = job.status.resize
+        started = _parse_time(resize.started_at if resize is not None else None)
+        if started is None:
+            return True
+        # crash-resilient floor on the durable anchor (wall-vs-persisted
+        # math like _past_active_deadline; +1s covers the timestamp's
+        # second granularity): a drain already pending longer than the
+        # grace across incarnations proceeds immediately
+        return time.time() - started >= grace + 1.0  # noqa: TPL004
+
+    def _delete_drained_pods(self, job: TPUJob, rtype: str, replicas: int,
+                             over: List[Pod]) -> None:
+        """Delete the drained (highest-index-first) replicas with the usual
+        expectation bookkeeping.  Resize-driven deletions are NOT failure
+        strikes: no ``restarts`` increment, no Restarting condition, and the
+        crash-loop damper entry for the index is dropped so a shrink
+        followed by an immediate grow recreates the index promptly."""
+        ekey = expectation_key(job.key, rtype, "pods")
+        for pod in sorted(over, key=lambda p: _replica_index(p) or 0,
+                          reverse=True):
+            index = _replica_index(pod)
+            if index is not None:
+                self._restart_backoff.pop((job.key, rtype, index), None)
+            if pod.metadata.deletion_timestamp:
+                continue  # already terminating: don't re-delete or re-expect
+            self.expectations.expect(ekey, adds=0, dels=1)
+            self.flight.record(
+                job.key, "resize",
+                f"drain: deleting {pod.metadata.name} "
+                f"(index {index} >= target {replicas})",
+                {"rtype": rtype, "index": index, "pod": pod.metadata.name})
+            try:
+                self.pod_control.delete_pod(
+                    pod.metadata.namespace, pod.metadata.name, job)
+            except NotFoundError:
+                # already gone: the intended outcome — clear our expectation,
+                # whose DELETED event may have preceded the registration
+                self.expectations.observe_del(ekey)
+            except ServerTimeoutError:
+                # ambiguous 504 (lost response): idempotent — the retry sync
+                # re-derives the remaining drain set from live pods
+                self.expectations.observe_del(ekey)
+            except Exception:
+                # the delete did not happen: clear the expectation so the
+                # retry sync is not gated, and surface the error
+                self.expectations.observe_del(ekey)
+                raise
+
+    def _patch_job_annotations(self, job: TPUJob,
+                               annotations: Dict[str, Optional[str]]) -> None:
+        """Merge-patch job annotations (``None`` deletes a key), through the
+        sync's fenced/traced transport.  The world-size publication channel:
+        a real pod reads these through a downward-API mount, the in-process
+        harness through the job object."""
+        ns = job.metadata.namespace or "default"
+        try:
+            self.clients.server.patch(
+                RESOURCE_TPUJOBS, ns, job.metadata.name,
+                {"metadata": {"annotations": dict(annotations)}})
+        except NotFoundError:
+            return
+        # keep the in-memory object coherent for the rest of this sync
+        for k, v in annotations.items():
+            if v is None:
+                job.metadata.annotations.pop(k, None)
+            else:
+                job.metadata.annotations[k] = v
+
+    def _publish_target(self, job: TPUJob, target_world: int) -> None:
+        """Idempotently publish the PENDING world size so the workload can
+        checkpoint before the drain deletes anything."""
+        ann = job.metadata.annotations or {}
+        if ann.get(c.ANNOTATION_TARGET_WORLD_SIZE) == str(target_world):
+            return
+        self._patch_job_annotations(
+            job, {c.ANNOTATION_TARGET_WORLD_SIZE: str(target_world)})
+
+    def _publish_world(self, job: TPUJob, world: int) -> None:
+        """Republish the world size: the resize's commit point.  Survivors
+        re-rendezvous at this size; the pending target clears; the resize
+        generation bumps as the workload's cheap change detector."""
+        ann = job.metadata.annotations or {}
+        if ann.get(c.ANNOTATION_WORLD_SIZE) == str(world) and \
+                ann.get(c.ANNOTATION_TARGET_WORLD_SIZE) is None:
+            return
+        gen = 0
+        try:
+            gen = int(ann.get(c.ANNOTATION_RESIZE_GENERATION) or 0)
+        except ValueError:
+            pass
+        self._patch_job_annotations(job, {
+            c.ANNOTATION_WORLD_SIZE: str(world),
+            c.ANNOTATION_RESIZE_GENERATION: str(gen + 1),
+            c.ANNOTATION_TARGET_WORLD_SIZE: None,
+            # the ack is per-drain and now consumed: a future shrink to the
+            # same target must run its own checkpoint barrier, not ride a
+            # stale ack from this one
+            c.ANNOTATION_CHECKPOINT_ACK: None,
+        })
+
+    def _finish_resize(self, job: TPUJob, world: int,
+                       rolled_back: bool = False) -> None:
+        """Close the staging record: condition flips; a completed resize
+        observes its duration, an abandoned one (flap back to the origin
+        before any pod moved) counts a rollback instead."""
+        resize = job.status.resize
+        job.status.resize = None
+        started = self._resize_started_mono.pop(job.key, None)
+        target = resize.target_replicas if resize is not None else None
+        rtype = resize.replica_type if resize is not None else ""
+        if rolled_back:
+            metrics.resize_rollbacks.inc()
+            reason = st.REASON_RESIZE_ROLLED_BACK
+            message = (f"TPUJob {job.metadata.name} resize to {target} "
+                       f"{rtype} replica(s) rolled back "
+                       f"(world size stays {world}).")
+        else:
+            if started is not None:
+                metrics.resize_duration.observe(time.monotonic() - started)
+            reason = st.REASON_RESIZE_COMPLETED
+            message = (f"TPUJob {job.metadata.name} resize to {target} "
+                       f"{rtype} replica(s) complete (world size {world}).")
+        st.mark_condition_false(job.status, c.JOB_RESIZING, reason, message)
+        self.recorder.event(job, "Normal", reason, message)
+        self.flight.record(
+            job.key, "resize",
+            (f"resize to {target} rolled back (world size stays {world})"
+             if rolled_back else
+             f"resize complete: world size {world} published"),
+            {"world": world, "target": target, "rolled_back": rolled_back})
+
+    # ------------------------------------------------------------------
     # services (service.go:36-153)
     # ------------------------------------------------------------------
 
@@ -1013,7 +1402,21 @@ class TPUJobController(JobController):
         sync was a pure no-op and nothing is written (counted as
         suppressed).  Anything else goes through the injectable
         ``update_status_handler``, where the semantic diff decides between
-        a merge-patch write and suppression of volatile-only refreshes."""
+        a merge-patch write and suppression of volatile-only refreshes.
+
+        ``status.observedGeneration`` stamps here — the one choke point
+        every persisted reconcile status flows through — so a generation
+        bump alone (a spec change whose reconcile was otherwise a no-op)
+        still registers as a change to write, and drift repair / the flight
+        recorder can tell spec changes from status churn."""
+        gen = job.metadata.generation
+        if gen and job.status.observed_generation != gen:
+            prev = job.status.observed_generation
+            job.status.observed_generation = gen
+            self.flight.record(
+                job.key, "spec",
+                f"spec generation {prev or 0} -> {gen} processed",
+                {"from": prev or 0, "to": gen})
         if job.status == old_status:
             if self.config.suppress_noop_status:
                 metrics.status_writes.labels(result="suppressed").inc()

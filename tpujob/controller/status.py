@@ -27,6 +27,10 @@ REASON_JOB_RUNNING = "TPUJobRunning"
 REASON_JOB_RESTARTING = "TPUJobRestarting"
 REASON_JOB_SUCCEEDED = "TPUJobSucceeded"
 REASON_JOB_FAILED = "TPUJobFailed"
+# elastic resize (staged drain/join state machine)
+REASON_JOB_RESIZING = "TPUJobResizing"
+REASON_RESIZE_COMPLETED = "TPUJobResizeCompleted"
+REASON_RESIZE_ROLLED_BACK = "TPUJobResizeRolledBack"
 
 
 def get_condition(status: JobStatus, cond_type: str) -> Optional[JobCondition]:
@@ -92,8 +96,10 @@ def set_condition(status: JobStatus, condition: JobCondition) -> None:
         elif condition.type == c.JOB_RESTARTING:
             conditions = _filter_out(conditions, c.JOB_RUNNING)
         elif condition.type in (c.JOB_SUCCEEDED, c.JOB_FAILED):
+            # a finished job is neither running nor mid-resize: flip both to
+            # False (history preserved) rather than dropping them
             for cond in conditions:
-                if cond.type == c.JOB_RUNNING and cond.status == "True":
+                if cond.type in (c.JOB_RUNNING, c.JOB_RESIZING) and cond.status == "True":
                     cond.status = "False"
                     cond.last_transition_time = condition.last_transition_time
                     cond.last_update_time = condition.last_update_time
@@ -103,6 +109,14 @@ def set_condition(status: JobStatus, condition: JobCondition) -> None:
 
 def update_job_conditions(status: JobStatus, cond_type: str, reason: str, message: str) -> None:
     set_condition(status, _new_condition(cond_type, reason, message))
+
+
+def mark_condition_false(status: JobStatus, cond_type: str, reason: str, message: str) -> None:
+    """Flip a condition to False with a fresh reason/message (history kept):
+    the resize state machine's completion transition (Resizing True->False)."""
+    cond = _new_condition(cond_type, reason, message)
+    cond.status = "False"
+    set_condition(status, cond)
 
 
 def initialize_replica_statuses(status: JobStatus, rtype: str) -> None:

@@ -174,6 +174,15 @@ class InMemoryAPIServer:
         self._enable_gc = enable_gc
         # hooks: callables invoked (event_type, resource, obj_dict) after commit
         self.hooks: List[Callable[[str, str, Dict[str, Any]], None]] = []
+        # admission validators for UPDATE/PATCH: callables
+        # (verb, resource, old_obj, new_obj) raising InvalidError to reject
+        # the write BEFORE it commits (the ValidatingAdmissionWebhook role —
+        # e.g. TPUJob update admission: immutable fields, master replica
+        # count).  Append at setup, before serving traffic; invoked under
+        # the server lock, so validators must be pure (no API calls) and
+        # treat both objects as read-only.
+        self.admission_validators: List[
+            Callable[[str, str, Dict[str, Any], Dict[str, Any]], None]] = []
         # pod log store: (ns, pod_name) -> text, fed by the simulated kubelet
         self._pod_logs: Dict[Tuple[str, str], str] = {}  # guarded by self._lock
         # server-side fencing (opt-in): (lease namespace, lease name) the
@@ -258,6 +267,26 @@ class InMemoryAPIServer:
     def _next_rv(self) -> str:  # caller holds self._lock
         self._rv += 1
         return str(self._rv)
+
+    def _admit(self, verb: str, resource: str,  # caller holds self._lock
+               old: Dict[str, Any], new: Dict[str, Any]) -> None:
+        """Run the registered admission validators; any raise aborts the
+        write before commit (nothing is broadcast, no RV is burned)."""
+        for validator in self.admission_validators:
+            validator(verb, resource, old, new)
+
+    @staticmethod
+    def _bump_generation(current: Dict[str, Any], merged: Dict[str, Any]) -> None:
+        """Maintain ``metadata.generation`` the way a real apiserver does for
+        resources with a status subresource: it increments exactly when
+        ``.spec`` changes, never on status or metadata-only writes — the
+        signal ``status.observedGeneration`` tracking (and with it elastic
+        resize detection) is built on."""
+        meta = merged.setdefault("metadata", {})
+        gen = int(((current.get("metadata") or {}).get("generation")) or 1)
+        if merged.get("spec") != current.get("spec"):
+            gen += 1
+        meta["generation"] = gen
 
     def _key(self, obj: Dict[str, Any]) -> Tuple[str, str]:
         meta = obj.get("metadata") or {}
@@ -406,6 +435,7 @@ class InMemoryAPIServer:
             meta["uid"] = meta.get("uid") or str(uuid.uuid4())
             meta["resourceVersion"] = self._next_rv()
             meta.setdefault("creationTimestamp", now_iso())
+            meta["generation"] = 1  # spec revision counter (bumped on spec change)
             store.objects[key] = obj
             self._broadcast(ADDED, resource, obj)
             return copy.deepcopy(obj)
@@ -534,9 +564,11 @@ class InMemoryAPIServer:
                 raise ConflictError(
                     f"{resource} {key[0]}/{key[1]}: resourceVersion {rv} != {cur_rv}"
                 )
+            self._admit("update", resource, current, obj)
             meta = obj.setdefault("metadata", {})
             meta["uid"] = (current.get("metadata") or {}).get("uid")
             meta["creationTimestamp"] = (current.get("metadata") or {}).get("creationTimestamp")
+            self._bump_generation(current, obj)
             meta["resourceVersion"] = self._next_rv()
             store.objects[key] = obj
             self._broadcast(MODIFIED, resource, obj)
@@ -624,6 +656,8 @@ class InMemoryAPIServer:
                 raise NotFoundError(f"{resource} {namespace}/{name} not found")
             merged = copy.deepcopy(current)
             _merge(merged, patch)
+            self._admit("patch", resource, current, merged)
+            self._bump_generation(current, merged)
             merged["metadata"]["resourceVersion"] = self._next_rv()
             self._store(resource).objects[key] = merged
             self._broadcast(MODIFIED, resource, merged)
@@ -748,11 +782,21 @@ class InMemoryAPIServer:
             return w
 
 
+def _strip_nulls(v: Dict[str, Any]) -> Dict[str, Any]:
+    """RFC 7386: when a patch dict lands where no dict exists yet, its null
+    markers are deletions of keys that aren't there — they must be DROPPED,
+    not materialized as literal nulls on the stored object."""
+    return {k: (_strip_nulls(x) if isinstance(x, dict) else copy.deepcopy(x))
+            for k, x in v.items() if x is not None}
+
+
 def _merge(dst: Dict[str, Any], patch: Dict[str, Any]) -> None:
     for k, v in patch.items():
         if v is None:
             dst.pop(k, None)
         elif isinstance(v, dict) and isinstance(dst.get(k), dict):
             _merge(dst[k], v)
+        elif isinstance(v, dict):
+            dst[k] = _strip_nulls(v)
         else:
             dst[k] = copy.deepcopy(v)

@@ -27,7 +27,15 @@ log = logging.getLogger("tpujob.server")
 
 def build_transport(opt: ServerOption):
     if opt.apiserver == "memory":
-        return InMemoryAPIServer()
+        from tpujob.api.validation import install_tpujob_admission
+
+        server = InMemoryAPIServer()
+        # UPDATE admission: with elastic resize, Worker replicas is the one
+        # mutable spec field of a running job — reject everything else
+        # (templates, topology, Master count) server-side with a per-field
+        # error list, the ValidatingAdmissionWebhook role
+        install_tpujob_admission(server)
+        return server
     if opt.apiserver == "kube":
         # real-cluster transport: the self-contained K8s REST client
         # (in-cluster serviceaccount config, kubeconfig fallback)
@@ -135,6 +143,7 @@ class OperatorApp:
                 namespace=opt.namespace or None,
                 restart_backoff_seconds=opt.restart_backoff_s,
                 restart_backoff_max_seconds=opt.restart_backoff_max_s,
+                resize_drain_grace_s=opt.resize_drain_grace_s,
                 backoff_base_delay=opt.workqueue_base_backoff_s,
                 backoff_max_delay=opt.workqueue_max_backoff_s,
                 enable_tracing=opt.enable_tracing,

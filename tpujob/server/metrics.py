@@ -391,6 +391,34 @@ shard_handoff_duration = Histogram(
     REGISTRY,
 )
 
+# Elastic resize series (the staged drain/join resize of a live TPUJob):
+# a spec.replicas change on the Worker type is a first-class state
+# transition — scale-up joins new replicas and republishes the world size
+# only after they are Running; scale-down runs a checkpoint barrier, drains
+# the highest-index replicas, and never restarts a surviving pod.
+resize_total = LabeledCounter(
+    "tpujob_operator_resize_total",
+    "Elastic resizes staged, by direction (up = join new replicas, "
+    "down = drain the highest-index replicas); a superseded mid-flight "
+    "resize counts again when restaged at the new target",
+    REGISTRY,
+    ("direction",),
+)
+resize_duration = Histogram(
+    "tpujob_operator_resize_duration_seconds",
+    "Wall time of one completed elastic resize: staging record created -> "
+    "new world size published (drain barrier + pod churn included)",
+    REGISTRY,
+    buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600),
+)
+resize_rollbacks = Counter(
+    "tpujob_operator_resize_rollbacks_total",
+    "In-flight resizes superseded by a spec change back to their origin "
+    "(a flap: the staged target was abandoned and the job returned to the "
+    "replica count it started from)",
+    REGISTRY,
+)
+
 # API write-path series (the write-path overhaul): status persistence
 # proportional to CHANGE, not to sync count.  A sync whose recomputed status
 # is semantically identical to the informer-cached one skips the write

@@ -54,6 +54,9 @@ class ServerOption:
     # and the replacement pod's creation (<= 0 = instant recreate)
     restart_backoff_s: float = 1.0
     restart_backoff_max_s: float = 300.0
+    # elastic resize: how long a scale-down's checkpoint barrier waits for
+    # the workload's ack before draining anyway (<= 0 skips the barrier)
+    resize_drain_grace_s: float = 15.0
     # workqueue per-key failure backoff (client-go rate limiter bounds)
     workqueue_base_backoff_s: float = 0.005
     workqueue_max_backoff_s: float = 1200.0
@@ -146,6 +149,12 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--restart-backoff-max", type=float, default=300.0,
                         dest="restart_backoff_max_s",
                         help="cap on the exponential restart backoff delay")
+    parser.add_argument("--resize-drain-grace", type=float, default=15.0,
+                        dest="resize_drain_grace_s",
+                        help="seconds a scale-down's checkpoint barrier "
+                             "waits for the workload's checkpoint ack "
+                             "before deleting the drained replicas anyway "
+                             "(<=0 drains immediately)")
     parser.add_argument("--workqueue-base-backoff", type=float, default=0.005,
                         dest="workqueue_base_backoff_s")
     parser.add_argument("--workqueue-max-backoff", type=float, default=1200.0,

@@ -317,6 +317,177 @@ def local_batch_slice(global_batch: int, env: Optional[ProcessEnv] = None) -> Tu
     return pe.process_id * per, per
 
 
+# ---------------------------------------------------------------------------
+# Elastic resize: the workload half of the drain/join protocol
+# ---------------------------------------------------------------------------
+#
+# The controller publishes the LIVE world size on job annotations (pod env
+# is bootstrap-only — see tpujob.api.constants ANNOTATION_*): a pending
+# shrink publishes `target-world-size` first so every process can hit a
+# checkpoint barrier, and the committed world arrives as `world-size` +
+# a bumped `resize-generation` once the join/drain staging completed.  A
+# real pod reads the annotations through a downward-API file mount (the
+# `metadata.annotations` fieldRef format: one `key="escaped value"` line
+# per annotation); the in-process harness reads the job object directly.
+
+# Env var naming the downward-API file carrying the job annotations (the
+# conventional mount point for the elastic signal); absent = not elastic.
+RESIZE_SIGNAL_ENV = "TPUJOB_RESIZE_SIGNAL_FILE"
+
+# resize plan actions (plan_resize return values)
+PLAN_CHECKPOINT = "checkpoint"  # drain pending: checkpoint NOW and ack
+PLAN_LEAVE = "leave"  # this process is beyond the target: checkpoint, then
+# idle until the controller deletes the pod
+PLAN_REJOIN = "rejoin"  # world republished: re-initialize at the new size
+# and restore from the latest checkpoint
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldSignal:
+    """The published elastic state, parsed from the job annotations."""
+
+    world_size: int  # committed world (every live replica rendezvouses here)
+    target_world_size: Optional[int]  # pending drain target (None = steady)
+    resize_generation: int  # bumps on every completed resize
+
+    @property
+    def drain_pending(self) -> bool:
+        return (self.target_world_size is not None
+                and self.target_world_size != self.world_size)
+
+
+def parse_world_signal(annotations: Dict[str, str],
+                       default_world: int) -> WorldSignal:
+    """Build a :class:`WorldSignal` from job annotations.  ``default_world``
+    is the bootstrap world (this process's injected TPUJOB_NUM_PROCESSES) —
+    the committed world before the controller ever published one."""
+    from tpujob.api import constants as c
+
+    def _geti_ann(key):
+        v = annotations.get(key)
+        if v is None or v == "":
+            return None
+        try:
+            return int(v)
+        except ValueError:
+            return None
+
+    world = _geti_ann(c.ANNOTATION_WORLD_SIZE)
+    return WorldSignal(
+        world_size=world if world is not None else default_world,
+        target_world_size=_geti_ann(c.ANNOTATION_TARGET_WORLD_SIZE),
+        resize_generation=_geti_ann(c.ANNOTATION_RESIZE_GENERATION) or 0,
+    )
+
+
+def parse_downward_annotations(text: str) -> Dict[str, str]:
+    """Parse the downward-API `metadata.annotations` file format: one
+    ``key="escaped value"`` line per annotation (Go strconv.Quote)."""
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or "=" not in line:
+            continue
+        key, _, raw = line.partition("=")
+        raw = raw.strip()
+        if raw.startswith('"') and raw.endswith('"') and len(raw) >= 2:
+            raw = raw[1:-1].encode().decode("unicode_escape")
+        out[key.strip()] = raw
+    return out
+
+
+def read_world_signal(path: Optional[str] = None,
+                      default_world: Optional[int] = None) -> Optional[WorldSignal]:
+    """Read the elastic signal from the downward-API annotations file named
+    by ``path`` (default: $TPUJOB_RESIZE_SIGNAL_FILE).  Returns None when
+    the job is not elastic (no file configured/present) — callers then run
+    the classic fixed-world loop."""
+    path = path if path is not None else os.environ.get(RESIZE_SIGNAL_ENV)
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    if default_world is None:
+        default_world = process_env().num_processes
+    return parse_world_signal(parse_downward_annotations(text), default_world)
+
+
+def plan_resize(pe: ProcessEnv, signal: Optional[WorldSignal]) -> Optional[str]:
+    """What this process must do about the published elastic state:
+
+    - ``None`` — steady state: keep training.
+    - :data:`PLAN_CHECKPOINT` — a drain is pending: checkpoint now, ack the
+      target, and PAUSE stepping until the world republishes (collectives
+      with the leaving hosts would hang anyway; pausing after the barrier
+      is what makes a clean resize lossless).
+    - :data:`PLAN_LEAVE` — this process is beyond the target: checkpoint
+      (it may hold the most recent state), then idle until deleted.
+    - :data:`PLAN_REJOIN` — the world republished at a size this runtime is
+      not initialized for: re-rendezvous (:func:`reinitialize`) and restore
+      from the latest checkpoint.
+    """
+    if signal is None:
+        return None
+    if signal.drain_pending:
+        if pe.process_id >= signal.target_world_size:
+            return PLAN_LEAVE
+        return PLAN_CHECKPOINT
+    if signal.world_size != pe.num_processes:
+        if pe.process_id >= signal.world_size:
+            # beyond the committed world with no drain pending: either a
+            # JOINER born into the new (larger) world the controller has
+            # not republished yet — it must WAIT (its own readiness gates
+            # that republish), never "rejoin" a world it has no seat in —
+            # or a drained process awaiting deletion
+            return None
+        return PLAN_REJOIN
+    return None
+
+
+def shutdown() -> None:
+    """Tear down the distributed runtime (tolerant: a never-initialized or
+    already-shut-down runtime is a no-op) — the first half of an elastic
+    re-rendezvous."""
+    try:
+        import jax
+
+        jax.distributed.shutdown()
+    except (ImportError, RuntimeError, ValueError):
+        pass
+
+
+def reinitialize(pe: ProcessEnv, num_processes: int,
+                 process_id: Optional[int] = None) -> ProcessEnv:
+    """Re-rendezvous at a new world size (the elastic resize commit on the
+    workload side): shut the old runtime down, then ``initialize`` with the
+    new ``num_processes`` — the coordinator re-``initialize`` the staged
+    resize protocol promises.  Process ids are stable under the drain/join
+    protocol (scale-down drains the HIGHEST indices; scale-up appends), so
+    the default keeps this process's id.
+
+    Device arrays do not survive the teardown: restore the train state from
+    the latest checkpoint after this returns
+    (``Checkpointer.restore_latest``) — for a shrink that is a cheap
+    restore, not a cold start."""
+    new = dataclasses.replace(
+        pe,
+        num_processes=num_processes,
+        process_id=process_id if process_id is not None else pe.process_id,
+    )
+    if new.process_id >= new.num_processes:
+        # guard BEFORE the teardown: a drained process that reaches here by
+        # mistake must keep its healthy runtime (and its state) intact
+        # while it waits for the controller to delete its pod
+        raise ValueError(
+            f"process {new.process_id} is beyond the new world "
+            f"{new.num_processes}: a drained process must exit, not rejoin")
+    shutdown()
+    return initialize(new)
+
+
 def shard_map_supports_partial_manual() -> bool:
     """Whether this jax can leave some mesh axes *auto* inside a shard_map
     region (``axis_names``/``auto``).  Releases without the top-level

@@ -424,5 +424,21 @@ class Checkpointer:
 
         return self._mgr.restore(step, args=ocp.args.StandardRestore(like))
 
+    def restore_latest(self, like) -> Tuple[Optional[int], Any]:
+        """``(step, state)`` from the newest checkpoint, or ``(None, like)``
+        when none exists yet (cold start).
+
+        The elastic-resize restore contract: after a world-size change the
+        runtime re-rendezvouses (``distributed.reinitialize``) and device
+        arrays do not survive — the surviving processes restore from here
+        and continue at the checkpointed step.  Because the controller runs
+        a checkpoint barrier before draining (and the workload pauses
+        stepping between its ack and the republish), a clean shrink resumes
+        EXACTLY where it acked — the latest step, not a cold start."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None, like
+        return step, self.restore(step, like)
+
     def close(self) -> None:
         self._mgr.close()
