@@ -252,6 +252,36 @@ class StatusTracker:
                     f"{name}: terminal condition flipped {prev} -> {sorted(state)}")
 
 
+class StallTracker:
+    """Watches TPUJob status writes for Stalled=True transitions — the
+    telemetry soak invariant: with live (heartbeat-publishing, genuinely
+    progressing) workloads, the progress watchdog must never mint a false
+    ``Stalled`` under the chaos fault schedule.  The exemption windows
+    (resize staging, restarts, replica churn) exist precisely so injected
+    faults and storms cannot masquerade as stalls."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.stalls: List[str] = []  # job names observed Stalled=True
+
+    def hook(self, ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
+        if resource != RESOURCE_TPUJOBS:
+            return
+        name = (obj.get("metadata") or {}).get("name") or ""
+        conds = ((obj.get("status") or {}).get("conditions")) or []
+        if any(cond.get("type") == c.JOB_STALLED
+               and cond.get("status") == "True" for cond in conds):
+            with self._lock:
+                if name not in self.stalls:
+                    self.stalls.append(name)
+
+    def problems(self) -> List[str]:
+        with self._lock:
+            return [f"{name}: false Stalled condition under the fault "
+                    "schedule (workload was live and progressing)"
+                    for name in self.stalls]
+
+
 # ---------------------------------------------------------------------------
 # preemption storm (kubelet-level faults)
 # ---------------------------------------------------------------------------
@@ -1746,12 +1776,19 @@ def _run_resize_soak_inner(
     cases, workloads = elastic_matrix(prefix, admin, trainer_stop, finish_gate)
     pod_tracker = LivePodTracker()
     inner.hooks.append(pod_tracker.hook)
+    stall_tracker = StallTracker()
+    inner.hooks.append(stall_tracker.hook)
     scripts = [s for case in cases for s in case.scripts]
     rng = random.Random(f"{seed}:resize-kill")
     started = time.monotonic()
     trace_started0, trace_closed0 = TRACER.counters()
 
-    overrides = {"resize_drain_grace_s": 0.5, **(opt_overrides or {})}
+    # the watchdog runs armed through the whole storm (10ms-tick workloads
+    # publishing 100ms heartbeats against a 5s deadline): faults, resizes,
+    # preemptions and controller kills must all land inside the exemption
+    # windows — a single Stalled flip fails the soak (StallTracker)
+    overrides = {"resize_drain_grace_s": 0.5, "stall_timeout_s": 5.0,
+                 "stall_check_interval_s": 0.5, **(opt_overrides or {})}
     kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts)
     app = _start_app(chaos, overrides)
     kubelet.start()
@@ -1797,6 +1834,7 @@ def _run_resize_soak_inner(
         problems = _settle_invariants(admin, app.controller, cases, tracker,
                                       chaos, deadline)
         problems += _resize_job_problems(admin, workloads, pod_tracker)
+        problems += stall_tracker.problems()
         if problems:
             raise AssertionError(
                 f"seed {seed}: resize invariants violated:\n  "
@@ -1864,6 +1902,8 @@ def _run_resize_smoke_inner(seed: int, timeout: float) -> Dict[str, Any]:
         seed, "z", no_faults, cases=[])
     pod_tracker = LivePodTracker()
     inner.hooks.append(pod_tracker.hook)
+    stall_tracker = StallTracker()
+    inner.hooks.append(stall_tracker.hook)
     name = f"{prefix}-elastic"
     wl = ElasticWorkload(admin, name, initial_world=2,
                          total_steps=RESIZE_SOAK_STEPS,
@@ -1892,7 +1932,11 @@ def _run_resize_smoke_inner(seed: int, timeout: float) -> Dict[str, Any]:
                 if p.metadata.labels.get(c.LABEL_JOB_NAME) == name}
 
     kubelet = KubeletSim(admin, run_seconds=0.05, scripts=case.scripts)
-    app = _start_app(chaos, {"resize_drain_grace_s": 10.0})
+    # watchdog armed through both resizes: the staged drain/join (incl. the
+    # paused-at-barrier window) must never register as a stall
+    app = _start_app(chaos, {"resize_drain_grace_s": 10.0,
+                             "stall_timeout_s": 2.0,
+                             "stall_check_interval_s": 0.2})
     kubelet.start()
     resizes: List[Dict[str, Any]] = []
     try:
@@ -1938,6 +1982,7 @@ def _run_resize_smoke_inner(seed: int, timeout: float) -> Dict[str, Any]:
         problems = _settle_invariants(admin, app.controller, [case], tracker,
                                       chaos, deadline)
         problems += _resize_job_problems(admin, {name: wl}, pod_tracker)
+        problems += stall_tracker.problems()
         job = admin.tpujobs.get("default", name)
         restarts = sum(rs.restarts
                        for rs in job.status.replica_statuses.values())

@@ -33,15 +33,17 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from e2e.kubelet import PodScript
 from tpujob.analysis import lockgraph
 from tpujob.api import constants as c
-from tpujob.kube.client import RESOURCE_TPUJOBS, ClientSet
+from tpujob.kube.client import RESOURCE_PODS, RESOURCE_TPUJOBS, ClientSet
 from tpujob.kube.errors import ApiError, NotFoundError
 from tpujob.workloads.distributed import (
     PLAN_CHECKPOINT,
     PLAN_LEAVE,
     PLAN_REJOIN,
     ProcessEnv,
+    ProgressReporter,
     parse_world_signal,
     plan_resize,
+    pod_progress_patch,
 )
 
 
@@ -168,6 +170,7 @@ class ElasticLedger:
                 "progress": self.progress,
                 "checkpoint": self.checkpoint,
                 "world": self.world,
+                "generation": self.generation,
                 "done": self.done,
                 "rejoins": self.rejoins,
                 "restores": list(self.restores),
@@ -191,6 +194,7 @@ class ElasticWorkload:
         namespace: str = "default",
         stop_event: Optional[threading.Event] = None,
         finish_gate: Optional[threading.Event] = None,
+        heartbeat_interval_s: float = 0.1,
     ):
         self.admin = admin
         self.job_name = job_name
@@ -211,6 +215,10 @@ class ElasticWorkload:
         # the coordinator's ack path; the annotation itself is consumed by
         # the controller when the resize commits)
         self.acked: List[int] = []
+        # progress heartbeats: the coordinator publishes the REAL telemetry
+        # channel (tpujob.dev/progress on its own pod, rate-limited) so the
+        # resize chaos tier doubles as the watchdog's false-positive soak
+        self.heartbeat_interval_s = heartbeat_interval_s
 
     # -- the per-container trainer loop -------------------------------------
 
@@ -245,8 +253,21 @@ class ElasticWorkload:
         except ApiError:
             pass  # retried next tick
 
+    def _reporter(self, pod_name: str) -> ProgressReporter:
+        """The coordinator's heartbeat publisher: merge-patches this pod's
+        own progress annotation through the admin (fault-free) connection —
+        a real pod does the same through the apiserver."""
+
+        def publish(value: str) -> None:
+            self.admin.server.patch(RESOURCE_PODS, self.ns, pod_name,
+                                    pod_progress_patch(value))
+
+        return ProgressReporter(publish, interval_s=self.heartbeat_interval_s)
+
     def _run(self, pod_name: str, process_id: int, attempt: int) -> int:
         led = self.ledger
+        reporter = (self._reporter(pod_name) if process_id == 0
+                    and self.heartbeat_interval_s > 0 else None)
         if attempt > 0 and process_id == 0:
             # recreated coordinator: device state died with the old pod —
             # the orbax restore_latest contract, not a cold start
@@ -280,6 +301,17 @@ class ElasticWorkload:
                                     self.finish_gate.is_set()):
                         return 0
                     led.periodic_checkpoint(self.checkpoint_every)
+            if reporter is not None:
+                # heartbeat every tick, rate-limited inside the reporter;
+                # published even while paused at a drain barrier — a paused
+                # workload is alive, and the exemption windows (not fake
+                # step advances) are what keep the watchdog honest there
+                snap = led.snapshot()
+                reporter.report(
+                    snap["progress"],
+                    samples_per_sec=1.0 / max(self.tick_s, 1e-6),
+                    checkpoint_step=snap["checkpoint"],
+                    resize_generation=snap["generation"])
             # a drained (or preempted) pod's container loop ends when its
             # pod object disappears; checking every few ticks keeps the
             # API chatter bounded

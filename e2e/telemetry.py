@@ -1,0 +1,253 @@
+"""Workload-telemetry smoke harness: heartbeats → metrics → stall → recovery.
+
+The acceptance gate of the telemetry plane (``make telemetry-smoke``): one
+live job whose coordinator publishes REAL progress heartbeats (the
+``tpujob.workloads.distributed.ProgressReporter`` → ``tpujob.dev/progress``
+pod-annotation channel) through the kubelet exec seam, against a controller
+with the stall watchdog armed.  The run asserts, in order:
+
+1. heartbeats flow end to end: the ``tpujob_job_*`` series appear on the
+   real ``/metrics`` listener (HELP/TYPE lines included), ``/debug/fleet``
+   carries the job's progress row, and ``/debug/jobs/<ns>/<name>`` surfaces
+   the controller-owned ``status`` block (observedGeneration + progress);
+2. heartbeat ingestion adds ZERO status writes: across a steady heartbeat
+   window, ``status_writes_total{result=suppressed}`` grows while
+   ``result=written`` stays flat — the write-path contract;
+3. an induced stall (the workload keeps heartbeating but stops advancing
+   its step — a live-but-stuck trainer, the hardest case) flips the
+   ``Stalled`` condition within the configured deadline + one check tick;
+4. an induced recovery clears it (``TPUJobProgressResumed``), and the
+   stall/recovery transitions land on the flight-recorder timeline;
+5. the job then trains to Succeeded and its telemetry series are removed.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from e2e.chaos import (
+    ChaosConfig,
+    JobCase,
+    _job,
+    _settle_invariants,
+    _soak_harness,
+    _start_app,
+    _tmpl,
+    _wait_for,
+)
+from e2e.kubelet import KubeletSim, PodScript
+from tpujob.api import constants as c
+from tpujob.controller import status as st
+from tpujob.kube.client import RESOURCE_PODS, ClientSet
+from tpujob.server import metrics
+from tpujob.server.monitoring import MonitoringServer
+from tpujob.workloads.distributed import ProgressReporter, pod_progress_patch
+
+NO_FAULTS = ChaosConfig(
+    error_rate=0.0, timeout_rate=0.0, conflict_rate=0.0, latency_rate=0.0,
+    kill_watch_every=0, compact_every=0, duplicate_event_rate=0.0,
+)
+
+STALL_TIMEOUT_S = 0.6
+STALL_CHECK_S = 0.1
+
+
+class TelemetryWorkload:
+    """One trainer loop publishing real heartbeats, with seams to induce a
+    stall (``pause``: keep heartbeating, stop advancing — a live-but-stuck
+    workload) and to finish the run (``finish``)."""
+
+    def __init__(self, admin: ClientSet, job_name: str, total_steps: int = 10 ** 9,
+                 tick_s: float = 0.01, heartbeat_s: float = 0.05,
+                 checkpoint_every: int = 10, namespace: str = "default"):
+        self.admin = admin
+        self.job_name = job_name
+        self.ns = namespace
+        self.total_steps = total_steps
+        self.tick_s = tick_s
+        self.heartbeat_s = heartbeat_s
+        self.checkpoint_every = checkpoint_every
+        self.pause = threading.Event()  # set => stall (no step advance)
+        self.finish = threading.Event()  # set => exit 0 at the next tick
+        self.stop = threading.Event()
+        self._lock = threading.Lock()
+        self.step = 0  # guarded by self._lock
+        self.checkpoint = 0  # guarded by self._lock
+
+    def _run(self, pod_name: str, attempt: int) -> int:
+        def publish(value: str) -> None:
+            self.admin.server.patch(RESOURCE_PODS, self.ns, pod_name,
+                                    pod_progress_patch(value))
+
+        reporter = ProgressReporter(publish, interval_s=self.heartbeat_s)
+        while not self.stop.is_set():
+            with self._lock:
+                if not self.pause.is_set():
+                    self.step += 1
+                    if self.step - self.checkpoint >= self.checkpoint_every:
+                        self.checkpoint = self.step
+                step, ckpt = self.step, self.checkpoint
+            # published even while paused: the watchdog is a PROGRESS
+            # watchdog — a live-but-stuck workload must still flip Stalled
+            reporter.report(step, samples_per_sec=1.0 / self.tick_s,
+                            checkpoint_step=ckpt)
+            if self.finish.is_set():
+                return 0
+            time.sleep(self.tick_s)
+        return 0
+
+    def scripts(self) -> List[PodScript]:
+        name = f"{self.job_name}-worker-0"
+        return [PodScript(
+            match=name,
+            exec_fn=lambda attempt: self._run(name, attempt))]
+
+
+def _fetch(port: int, path: str):
+    url = f"http://127.0.0.1:{port}{path}"
+    with urllib.request.urlopen(url) as resp:  # noqa: S310 (local)
+        body = resp.read()
+    ctype = resp.headers.get("Content-Type", "")
+    return json.loads(body) if "json" in ctype else body.decode()
+
+
+def _job_condition(admin: ClientSet, name: str, cond_type: str) -> Optional[str]:
+    job = admin.tpujobs.get("default", name)
+    cond = st.get_condition(job.status, cond_type)
+    return cond.status if cond is not None else None
+
+
+def run_telemetry_smoke(seed: int = 13, timeout: float = 30.0) -> Dict[str, Any]:
+    prefix, _, inner, chaos, admin, tracker, _ = _soak_harness(
+        seed, "t", NO_FAULTS, cases=[])
+    name = f"{prefix}-telemetry"
+    wl = TelemetryWorkload(admin, name)
+    case = JobCase(
+        job=_job(name, {
+            "runPolicy": {"backoffLimit": 10},
+            "tpuReplicaSpecs": {
+                "Worker": {"replicas": 1,
+                           "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                           "template": _tmpl()},
+            },
+        }),
+        scripts=wl.scripts(),
+        expect_terminal="Succeeded",
+    )
+    started = time.monotonic()
+    deadline = started + timeout
+
+    def _wait(pred, what: str) -> None:
+        if not _wait_for(pred, max(0.1, deadline - time.monotonic()),
+                         interval=0.01):
+            raise AssertionError(f"telemetry smoke: timed out waiting for {what}")
+
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=case.scripts)
+    app = _start_app(chaos, {"stall_timeout_s": STALL_TIMEOUT_S,
+                             "stall_check_interval_s": STALL_CHECK_S})
+    mon = MonitoringServer(host="127.0.0.1", port=0,
+                           flight=app.controller.flight,
+                           fleet=app.controller.fleet_snapshot,
+                           debug_state=app.controller.debug_job_state).start()
+    kubelet.start()
+    key = f"default/{name}"
+    try:
+        admin.tpujobs.create(case.job)
+
+        # -- 1. heartbeats flow into the tracker + metrics ----------------
+        _wait(lambda: (app.controller.telemetry.get(key) is not None
+                       and app.controller.telemetry.get(key).progress.step > 0),
+              "heartbeats to reach the controller")
+        text = _fetch(mon.port, "/metrics")
+        for family in ("tpujob_job_steps_total", "tpujob_job_samples_per_second",
+                       "tpujob_job_checkpoint_age_seconds",
+                       "tpujob_job_heartbeat_age_seconds", "tpujob_job_stalled"):
+            assert f"# HELP {family} " in text, f"/metrics missing HELP {family}"
+            assert f"# TYPE {family} gauge" in text, f"/metrics missing TYPE {family}"
+        assert (f'tpujob_job_steps_total{{namespace="default",job="{name}",'
+                f'shard="-"}}') in text, "job steps series not exported"
+
+        fleet = _fetch(mon.port, "/debug/fleet")
+        rows = {r["job"]: r for r in fleet["jobs"]}
+        assert key in rows and rows[key]["step"] > 0, f"/debug/fleet: {fleet}"
+        assert rows[key]["stalled"] is False
+
+        view = _fetch(mon.port, f"/debug/jobs/default/{name}")
+        status_block = view.get("status") or {}
+        assert status_block.get("observedGeneration") == 1, status_block
+        assert (status_block.get("progress") or {}).get("step", 0) > 0, status_block
+        assert status_block.get("resize") is None, status_block
+
+        # -- 2. a steady heartbeat window adds ZERO status writes ---------
+        # (the write-path contract: annotation-only updates ride the settle
+        # coalescer and every resulting sync suppresses its status write)
+        written0 = metrics.status_writes.labels(result="written").value
+        sup0 = metrics.status_writes.labels(result="suppressed").value
+        time.sleep(0.4)
+        written = metrics.status_writes.labels(result="written").value - written0
+        suppressed = metrics.status_writes.labels(result="suppressed").value - sup0
+        assert written == 0, (
+            f"heartbeat ingestion triggered {written} status write(s) in a "
+            "steady window — must be zero")
+        assert suppressed > 0, (
+            "no suppressed status-write decisions in the heartbeat window — "
+            "heartbeats are not reaching the sync path")
+
+        # -- 3. induced stall flips Stalled within the deadline -----------
+        wl.pause.set()
+        t_stall = time.monotonic()
+        _wait(lambda: _job_condition(admin, name, c.JOB_STALLED) == "True",
+              "the Stalled condition to flip")
+        stall_latency = time.monotonic() - t_stall
+        slack = STALL_TIMEOUT_S + 4 * STALL_CHECK_S + 1.0
+        assert stall_latency <= slack, (
+            f"stall detected after {stall_latency:.2f}s, budget {slack:.2f}s")
+        fleet = _fetch(mon.port, "/debug/fleet")
+        assert {r["job"]: r for r in fleet["jobs"]}[key]["stalled"] is True
+
+        # -- 4. induced recovery clears it --------------------------------
+        wl.pause.clear()
+        _wait(lambda: _job_condition(admin, name, c.JOB_STALLED) == "False",
+              "the Stalled condition to clear")
+        job = admin.tpujobs.get("default", name)
+        cond = st.get_condition(job.status, c.JOB_STALLED)
+        assert cond is not None and cond.reason == st.REASON_PROGRESS_RESUMED
+        tl = app.controller.flight.timeline("default", name)
+        kinds = [(e["kind"], e["summary"]) for e in tl["entries"]]
+        assert any(k == "progress" and "STALLED" in s for k, s in kinds), kinds
+        assert any(k == "progress" and "recovered" in s for k, s in kinds), kinds
+
+        # -- 5. completion removes the series -----------------------------
+        wl.finish.set()
+        _wait(lambda: _job_condition(admin, name, c.JOB_SUCCEEDED) == "True",
+              "the job to succeed")
+        _wait(lambda: app.controller.telemetry.get(key) is None,
+              "telemetry state to be dropped")
+        text = _fetch(mon.port, "/metrics")
+        assert f'job="{name}"' not in text, (
+            "finished job still exporting tpujob_job_* series")
+
+        problems = _settle_invariants(admin, app.controller, [case], tracker,
+                                      chaos, deadline)
+        if problems:
+            raise AssertionError(
+                "telemetry smoke invariants violated:\n  "
+                + "\n  ".join(problems))
+        return {
+            "mode": "telemetry-smoke",
+            "seed": seed,
+            "stall_latency_s": round(stall_latency, 3),
+            "suppressed_in_window": int(suppressed),
+            "written_in_window": int(written),
+            "duration_s": round(time.monotonic() - started, 3),
+            "invariants": "ok",
+        }
+    finally:
+        wl.stop.set()
+        wl.finish.set()
+        kubelet.stop()
+        mon.stop()
+        app.shutdown()

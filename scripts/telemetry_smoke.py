@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Telemetry smoke: live heartbeats -> metrics -> induced stall ->
+``Stalled`` flips -> recovery clears it.
+
+The fast acceptance gate of the workload telemetry plane (``make
+telemetry-smoke``, wired as a ``make test`` prerequisite; budget ~5 s):
+
+- one live job publishes REAL progress heartbeats (ProgressReporter ->
+  ``tpujob.dev/progress`` pod annotation) through the kubelet exec seam;
+- the ``tpujob_job_*`` series appear on the real ``/metrics`` listener and
+  ``/debug/fleet`` / ``/debug/jobs/<ns>/<name>`` carry the progress state;
+- a steady heartbeat window adds ZERO status writes (suppressed grows,
+  written stays flat — the write-path suppressed-ratio contract);
+- pausing the workload's step clock (heartbeats continue — a live-but-stuck
+  trainer) flips ``Stalled`` within the deadline; resuming clears it with
+  ``TPUJobProgressResumed``; the job then trains to Succeeded and its
+  series are removed.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from e2e.telemetry import run_telemetry_smoke
+
+
+def main() -> int:
+    logging.disable(logging.CRITICAL)
+    report = run_telemetry_smoke(seed=13)
+    assert report["invariants"] == "ok"
+    print(f"telemetry-smoke: OK (stall flipped in "
+          f"{report['stall_latency_s']}s, recovery cleared it; "
+          f"{report['suppressed_in_window']} suppressed / "
+          f"{report['written_in_window']} written status decisions in the "
+          f"steady heartbeat window, in {report['duration_s']}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,660 @@
+"""Workload telemetry plane: heartbeat wire format, reporter rate limiting,
+metrics exposition (HELP/TYPE + escaping for every labeled family), the
+stall-watchdog unit matrix (detect / exemption windows / cold-restart and
+shard-handoff resume / restart policy), and the debug views."""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from tests.jobtestutil import Harness, new_tpujob
+from tpujob.api import constants as c
+from tpujob.api.progress import Progress, format_progress, parse_progress
+from tpujob.controller import status as st
+from tpujob.controller.job_base import ControllerConfig
+from tpujob.controller.reconciler import TPUJobController
+from tpujob.kube.client import RESOURCE_PODS, ClientSet
+from tpujob.kube.control import gen_general_name
+from tpujob.server import metrics
+from tpujob.server.metrics import REGISTRY, _LabeledFamily
+from tpujob.server.sharding import shard_of_uid, sync_shard
+from tpujob.workloads.distributed import ProgressReporter, pod_progress_patch
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+
+class TestProgressFormat:
+    def test_roundtrip(self):
+        v = format_progress(120, samples_per_sec=3411.5, checkpoint_step=98,
+                            resize_generation=2, published_at=1722772000.125)
+        p = parse_progress(v)
+        assert p == Progress(step=120, samples_per_sec=3411.5,
+                             checkpoint_step=98, resize_generation=2,
+                             published_at=1722772000.125)
+
+    def test_minimal(self):
+        p = parse_progress(format_progress(7))
+        assert p.step == 7
+        assert p.samples_per_sec is None and p.checkpoint_step is None
+        assert p.resize_generation == 0
+
+    def test_garbage_degrades_to_none(self):
+        for bad in (None, "", "garbage", "step=", "step=x", "sps=3.4"):
+            assert parse_progress(bad) is None
+
+    def test_unknown_keys_ignored_and_bad_optionals_tolerated(self):
+        p = parse_progress("step=5 future=abc sps=bogus ckpt=nan2 gen=x")
+        assert p.step == 5
+        assert p.samples_per_sec is None
+        assert p.checkpoint_step is None
+        assert p.resize_generation == 0
+
+
+# ---------------------------------------------------------------------------
+# reporter (rate limiting, failure tolerance)
+# ---------------------------------------------------------------------------
+
+
+class TestProgressReporter:
+    def test_rate_limited(self):
+        clock = {"t": 0.0}
+        shipped = []
+        r = ProgressReporter(shipped.append, interval_s=10.0,
+                             clock=lambda: clock["t"])
+        assert r.report(1) is True
+        assert r.report(2) is False  # inside the interval
+        clock["t"] = 10.1
+        assert r.report(3) is True
+        assert [parse_progress(v).step for v in shipped] == [1, 3]
+
+    def test_force_bypasses_interval(self):
+        shipped = []
+        r = ProgressReporter(shipped.append, interval_s=1e9)
+        assert r.report(1) and r.report(2, force=True)
+        assert len(shipped) == 2
+
+    def test_publish_failure_swallowed_and_rate_limited(self):
+        calls = {"n": 0}
+        clock = {"t": 0.0}
+
+        def dead(value):
+            calls["n"] += 1
+            raise RuntimeError("transport down")
+
+        r = ProgressReporter(dead, interval_s=5.0, clock=lambda: clock["t"])
+        assert r.report(1) is False  # swallowed, not raised
+        assert r.report(2) is False  # rate limit covers failures too
+        assert calls["n"] == 1
+        assert r.published == 0
+
+    def test_disabled_without_publish(self):
+        r = ProgressReporter(None)
+        assert not r.enabled and r.report(1) is False
+
+
+# ---------------------------------------------------------------------------
+# metrics: family removal + exposition (HELP/TYPE + escaping) — the
+# satellite's exposition test over EVERY labeled family
+# ---------------------------------------------------------------------------
+
+
+def _labeled_families():
+    return [m for m in vars(metrics).values()
+            if isinstance(m, _LabeledFamily)]
+
+
+def test_every_labeled_family_exposes_help_and_type():
+    fams = _labeled_families()
+    assert fams, "no labeled families registered"
+    names = {f.name for f in fams}
+    for want in ("tpujob_job_steps_total", "tpujob_job_samples_per_second",
+                 "tpujob_job_checkpoint_age_seconds",
+                 "tpujob_job_heartbeat_age_seconds", "tpujob_job_stalled"):
+        assert want in names, f"missing family {want}"
+    text = REGISTRY.expose()
+    for fam in fams:
+        assert f"# HELP {fam.name} " in text, fam.name
+        assert f"# TYPE {fam.name} {fam.kind()}" in text, fam.name
+
+
+def test_label_value_escaping_in_every_job_family():
+    hostile = 'we"ird\njob\\x'
+    labels = dict(namespace="default", job=hostile, shard="-")
+    escaped = 'job="we\\"ird\\njob\\\\x"'
+    try:
+        for fam in (metrics.job_steps, metrics.job_samples_per_second,
+                    metrics.job_checkpoint_age, metrics.job_heartbeat_age,
+                    metrics.job_stalled):
+            fam.labels(**labels).set(1.0)
+        text = REGISTRY.expose()
+        for fam_name in ("tpujob_job_steps_total", "tpujob_job_stalled"):
+            assert any(fam_name in line and escaped in line
+                       for line in text.splitlines()), fam_name
+        assert hostile not in text  # never raw
+    finally:
+        for fam in _labeled_families():
+            if fam.name.startswith("tpujob_job_"):
+                fam.remove(**labels)
+    assert escaped not in REGISTRY.expose()
+
+
+def test_family_remove_semantics():
+    fam = metrics.job_steps
+    labels = dict(namespace="ns1", job="gone-job", shard="3")
+    fam.labels(**labels).set(42)
+    assert 'job="gone-job"' in REGISTRY.expose()
+    assert fam.remove(**labels) is True
+    assert fam.remove(**labels) is False  # idempotent
+    assert 'job="gone-job"' not in REGISTRY.expose()
+    try:
+        fam.remove(namespace="ns1", job="gone-job")  # missing label name
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("remove with wrong labels must raise")
+
+
+# ---------------------------------------------------------------------------
+# watchdog unit matrix
+# ---------------------------------------------------------------------------
+
+
+JOB = "tele-job"
+KEY = f"default/{JOB}"
+
+
+@pytest.fixture(autouse=True)
+def _isolate_job_series():
+    """The metric registry is process-global: drop any tpujob_job_* child
+    the test minted for JOB so absence assertions (and -k subset runs)
+    never depend on which tests ran before."""
+    yield
+    for fam in _labeled_families():
+        if not fam.name.startswith("tpujob_job_"):
+            continue
+        with fam._lock:
+            stale = [k for k in fam._children if JOB in k]
+            for k in stale:
+                fam._children.pop(k, None)
+
+
+def _harness(stall: float = 30.0, policy: str = "event",
+             workers: int = 2, **extra) -> Harness:
+    h = Harness(config=ControllerConfig(
+        settle_window_s=0.0, stall_timeout_s=stall, stall_policy=policy,
+        stall_check_interval_s=0.05, **extra))
+    h.submit(new_tpujob(name=JOB, master=None, workers=workers,
+                        backoff_limit=20))
+    h.sync()
+    for i in range(workers):
+        h.set_pod_phase(JOB, c.REPLICA_TYPE_WORKER, i, "Running")
+    h.sync()
+    return h
+
+
+def _publish(h: Harness, step: int, index: int = 0, ckpt=None, gen: int = 0,
+             sps: float = 100.0) -> None:
+    name = gen_general_name(JOB, c.REPLICA_TYPE_WORKER, index)
+    h.server.patch(RESOURCE_PODS, "default", name, pod_progress_patch(
+        format_progress(step, samples_per_sec=sps, checkpoint_step=ckpt,
+                        resize_generation=gen, published_at=time.time())))
+
+
+def _rewind(h: Harness, seconds: float = 120.0) -> None:
+    """Age the job's advance anchor: the deterministic stand-in for waiting
+    out the stall deadline on the monotonic clock."""
+    state = h.controller.telemetry.get(KEY)
+    assert state is not None
+    state.last_advance_mono -= seconds
+
+
+def _stalled_status(h: Harness):
+    cond = st.get_condition(h.get_job(JOB).status, c.JOB_STALLED)
+    return cond.status if cond is not None else None
+
+
+def test_heartbeat_ingestion_adds_zero_status_writes():
+    h = _harness()
+    _publish(h, 10, ckpt=5)
+    h.sync()
+    state = h.controller.telemetry.get(KEY)
+    assert state is not None and state.progress.step == 10
+    written0 = metrics.status_writes.labels(result="written").value
+    sup0 = metrics.status_writes.labels(result="suppressed").value
+    for step in (11, 12, 13):
+        _publish(h, step, ckpt=10)
+        h.sync()
+    assert h.controller.telemetry.get(KEY).progress.step == 13
+    assert metrics.status_writes.labels(result="written").value == written0
+    assert metrics.status_writes.labels(result="suppressed").value > sup0
+    assert _stalled_status(h) is None
+
+
+def test_job_metric_families_follow_the_heartbeat():
+    h = _harness()
+    _publish(h, 25, ckpt=20, sps=512.0)
+    h.sync()
+    labels = dict(namespace="default", job=JOB, shard="-")
+    assert metrics.job_steps.labels(**labels).value == 25
+    assert metrics.job_samples_per_second.labels(**labels).value == 512.0
+    assert metrics.job_stalled.labels(**labels).value == 0
+    assert metrics.job_heartbeat_age.labels(**labels).value < 60
+    h.controller.telemetry.forget(KEY)
+
+
+def test_stall_detected_and_recovery_clears():
+    h = _harness(stall=30.0)
+    _publish(h, 10)
+    h.sync()
+    flips0 = metrics.jobs_stalled.value
+    _rewind(h)
+    h.sync()
+    assert _stalled_status(h) == "True"
+    assert metrics.jobs_stalled.value == flips0 + 1
+    assert metrics.job_stalled.labels(
+        namespace="default", job=JOB, shard="-").value == 1
+    # a second sync must not re-flip or re-count
+    h.sync()
+    assert metrics.jobs_stalled.value == flips0 + 1
+    # timeline carries the stall transition
+    tl = h.controller.flight.timeline("default", JOB)
+    assert any(e["kind"] == "progress" and "STALLED" in e["summary"]
+               for e in tl["entries"])
+    # recovery: the step advances again
+    _publish(h, 11)
+    h.sync()
+    cond = st.get_condition(h.get_job(JOB).status, c.JOB_STALLED)
+    assert cond.status == "False" and cond.reason == st.REASON_PROGRESS_RESUMED
+    assert any(e["kind"] == "progress" and "recovered" in e["summary"]
+               for e in h.controller.flight.timeline("default", JOB)["entries"])
+
+
+def test_live_but_stuck_workload_still_stalls():
+    """Heartbeats that keep arriving at the SAME step are a live-but-stuck
+    trainer: heartbeat age stays low, the stall flips anyway."""
+    h = _harness(stall=30.0)
+    _publish(h, 10)
+    h.sync()
+    _rewind(h)
+    _publish(h, 10)  # fresh heartbeat (new t=), same step
+    h.sync()
+    state = h.controller.telemetry.get(KEY)
+    assert time.monotonic() - state.last_heartbeat_mono < 30
+    assert _stalled_status(h) == "True"
+
+
+def test_resize_window_exempts_and_rearms():
+    h = _harness(stall=30.0)
+    _publish(h, 10)
+    h.sync()
+    # a REAL staged drain: spec.replicas 2 -> 1 opens status.resize and
+    # publishes the target; the drain barrier (default grace) holds it open
+    h.server.patch("tpujobs", "default", JOB, {
+        "spec": {"tpuReplicaSpecs": {"Worker": {"replicas": 1}}}})
+    h.sync(rounds=1)
+    assert h.get_job(JOB).status.resize is not None
+    _rewind(h)
+    h.sync(rounds=1)
+    assert _stalled_status(h) is None  # resize window exempts the gap
+    # flap back to the origin: the staging rolls back and the window
+    # closes — but the exemption re-armed the deadline, so no instant flip
+    h.server.patch("tpujobs", "default", JOB, {
+        "spec": {"tpuReplicaSpecs": {"Worker": {"replicas": 2}}}})
+    h.sync()
+    assert h.get_job(JOB).status.resize is None
+    assert _stalled_status(h) is None
+    assert h.controller.telemetry.stall_age(KEY) < 1.0
+    # the watchdog is live again after the window: a stale anchor now flips
+    _rewind(h)
+    h.sync()
+    assert _stalled_status(h) == "True"
+    h.controller.telemetry.forget(KEY)
+
+
+def test_replica_churn_exempts():
+    h = _harness(stall=30.0)
+    _publish(h, 10)
+    h.sync()
+    h.set_pod_phase(JOB, c.REPLICA_TYPE_WORKER, 1, "Pending")
+    _rewind(h)
+    h.sync()
+    assert _stalled_status(h) is None
+    # pods healthy again + stale anchor -> the flip happens now
+    h.set_pod_phase(JOB, c.REPLICA_TYPE_WORKER, 1, "Running")
+    _rewind(h)
+    h.sync()
+    assert _stalled_status(h) == "True"
+
+
+def test_cold_restart_resumes_stalled_state_without_refiring():
+    h = _harness(stall=30.0)
+    _publish(h, 10)
+    h.sync()
+    _rewind(h)
+    h.sync()
+    assert _stalled_status(h) == "True"
+    # a fresh controller (crash + cold restart): in-memory state is gone,
+    # the durable condition + the annotation still on the pod remain
+    flips0 = metrics.jobs_stalled.value
+    ctrl2 = TPUJobController(ClientSet(h.server), config=h.controller.config)
+    ctrl2.factory.sync_all()
+    ctrl2.sync_handler(KEY)
+    state = ctrl2.telemetry.get(KEY)
+    assert state is not None and state.stalled is True  # seeded from status
+    assert state.restart_fired is True  # the restart policy resumes as
+    # already-acted: once per EPISODE, not once per controller incarnation
+    assert metrics.jobs_stalled.value == flips0  # no duplicate flip
+    # and a granted-full-deadline anchor: nothing near the deadline yet
+    assert ctrl2.telemetry.stall_age(KEY) < 1.0
+    # recovery through the NEW controller clears the old condition
+    _publish(h, 11)
+    ctrl2.factory.sync_all()
+    ctrl2.sync_handler(KEY)
+    job = ClientSet(h.server).tpujobs.get("default", JOB)
+    cond = st.get_condition(job.status, c.JOB_STALLED)
+    assert cond.status == "False" and cond.reason == st.REASON_PROGRESS_RESUMED
+    ctrl2.telemetry.forget(KEY)
+    h.controller.telemetry.forget(KEY)
+
+
+class _FakeSharder:
+    def __init__(self, num_shards=4, active=()):
+        self.num_shards = num_shards
+        self.active = set(active)
+        self.identity = "member-a"
+
+    def shard_of_uid(self, uid):
+        return shard_of_uid(uid, self.num_shards)
+
+    def is_active(self, shard):
+        return shard in self.active
+
+    def sync_shard_context(self, shard):
+        return sync_shard(shard)
+
+    def owned_shards(self):
+        return set(self.active)
+
+
+def test_shard_handoff_drops_telemetry_and_series():
+    h = Harness(config=ControllerConfig(settle_window_s=0.0,
+                                        stall_timeout_s=30.0,
+                                        stall_check_interval_s=0.05))
+    job = h.submit(new_tpujob(name=JOB, master=None, workers=1,
+                              backoff_limit=20))
+    shard = shard_of_uid(job.metadata.uid, 4)
+    h.controller.set_sharder(_FakeSharder(active={shard}))
+    h.sync(key=KEY)
+    h.set_pod_phase(JOB, c.REPLICA_TYPE_WORKER, 0, "Running")
+    h.sync(key=KEY)
+    _publish(h, 10)
+    h.sync(key=KEY)
+    state = h.controller.telemetry.get(KEY)
+    assert state is not None and state.shard_label == str(shard)
+    assert f'shard="{shard}"' in REGISTRY.expose()
+    # the shard is handed off: drain barrier settles, then the state and
+    # every tpujob_job_* series of the shard's jobs must be gone
+    assert h.controller.drain_shard(shard) is True
+    assert h.controller.telemetry.get(KEY) is None
+    assert f'job="{JOB}"' not in REGISTRY.expose()
+    # fleet snapshot reflects identity + ownership
+    fleet = h.controller.fleet_snapshot()
+    assert fleet["identity"] == "member-a"
+    assert fleet["shards"] == [shard]
+    assert fleet["jobs"] == []
+
+
+def test_restart_policy_deletes_stuck_replica_once():
+    h = _harness(stall=30.0, policy="restart")
+    _publish(h, 10, index=0)
+    h.sync()
+    pod_name = gen_general_name(JOB, c.REPLICA_TYPE_WORKER, 0)
+    uid0 = h.clients.pods.get("default", pod_name).metadata.uid
+    restarts0 = metrics.watchdog_restarts.value
+    _rewind(h)
+    h.sync()
+    assert _stalled_status(h) == "True"
+    assert metrics.watchdog_restarts.value == restarts0 + 1
+    # the stuck replica was deleted and the normal reconcile recreated it
+    # within the same settled sync rounds: same name, NEW incarnation
+    assert pod_name in h.pod_names()
+    assert h.clients.pods.get("default", pod_name).metadata.uid != uid0
+    # not a failure strike: no restarts counted, no Restarting condition
+    job = h.get_job(JOB)
+    assert all(rs.restarts == 0 for rs in job.status.replica_statuses.values())
+    assert not st.has_condition(job.status, c.JOB_RESTARTING)
+    # one action per episode: the recreated replica is never re-deleted
+    h.set_pod_phase(JOB, c.REPLICA_TYPE_WORKER, 0, "Running")
+    h.sync()
+    uid1 = h.clients.pods.get("default", pod_name).metadata.uid
+    _rewind(h)
+    h.sync()
+    assert h.clients.pods.get("default", pod_name).metadata.uid == uid1
+    assert metrics.watchdog_restarts.value == restarts0 + 1
+    h.controller.telemetry.forget(KEY)
+
+
+def test_terminal_job_drops_telemetry_and_flips_stalled_false():
+    h = _harness(stall=30.0)
+    _publish(h, 10)
+    h.sync()
+    _rewind(h)
+    h.sync()
+    assert _stalled_status(h) == "True"
+    for i in range(2):
+        h.set_pod_phase(JOB, c.REPLICA_TYPE_WORKER, i, "Succeeded")
+    h.sync()
+    job = h.get_job(JOB)
+    assert st.is_succeeded(job.status)
+    stalled = st.get_condition(job.status, c.JOB_STALLED)
+    assert stalled is not None and stalled.status == "False"
+    assert h.controller.telemetry.get(KEY) is None
+    assert f'job="{JOB}"' not in REGISTRY.expose()
+
+
+def test_telemetry_disabled_ignores_heartbeats():
+    h = _harness(enable_telemetry=False)
+    _publish(h, 10)
+    h.sync()
+    assert h.controller.telemetry.get(KEY) is None
+    assert f'job="{JOB}"' not in REGISTRY.expose()
+
+
+def test_jobs_without_heartbeats_never_arm_the_watchdog():
+    h = _harness(stall=0.001)
+    time.sleep(0.01)
+    h.sync()
+    h.sync()
+    assert _stalled_status(h) is None
+    assert h.controller.telemetry.get(KEY) is None
+
+
+def test_watchdog_tick_armed_at_most_once_per_window():
+    """The delayed workqueue does not dedupe pending entries: every sync
+    scheduling its own tick would leak one immortal timer chain per
+    heartbeat event and self-amplify the sync rate without bound."""
+    h = _harness(stall=30.0)
+    scheduled = []
+    inner_add_after = h.controller.queue.add_after
+    h.controller.queue.add_after = lambda key, delay: (
+        scheduled.append((key, delay)), inner_add_after(key, delay))
+    for step in range(10, 16):
+        _publish(h, step)
+        h.sync(rounds=1)
+    assert len(scheduled) == 1, scheduled  # one live chain, not one per sync
+    # once the due time passes, the next sync re-arms the chain
+    state = h.controller.telemetry.get(KEY)
+    state.tick_due_mono = 0.0
+    h.sync(rounds=1)
+    assert len(scheduled) == 2, scheduled
+    h.controller.telemetry.forget(KEY)
+
+
+def test_watchdog_disabled_still_arms_metrics_refresh_tick():
+    """--stall-timeout 0 disables the Stalled machinery but the age gauges
+    must keep flowing: without the tick, a dead publisher stops producing
+    pod events and tpujob_job_heartbeat_age_seconds would freeze at its
+    last small value — exactly when an age-based alert needs it to grow."""
+    h = Harness(config=ControllerConfig(
+        settle_window_s=0.0, stall_timeout_s=0.0))
+    h.submit(new_tpujob(name=JOB, master=None, workers=2, backoff_limit=20))
+    h.sync()
+    for i in range(2):
+        h.set_pod_phase(JOB, c.REPLICA_TYPE_WORKER, i, "Running")
+    h.sync()
+    scheduled = []
+    inner_add_after = h.controller.queue.add_after
+    h.controller.queue.add_after = lambda key, delay: (
+        scheduled.append((key, delay)), inner_add_after(key, delay))
+    _publish(h, 10)
+    h.sync(rounds=1)
+    assert scheduled and scheduled[0][1] == 60.0  # the refresh cadence
+    assert _stalled_status(h) is None
+    # the refreshing sync recomputes the age from the tracker anchors
+    state = h.controller.telemetry.get(KEY)
+    state.last_heartbeat_mono -= 500.0
+    h.controller.telemetry.export(KEY)
+    assert metrics.job_heartbeat_age.labels(
+        namespace="default", job=JOB, shard="-").value >= 500.0
+    h.controller.telemetry.forget(KEY)
+
+
+def test_arm_tick_claims_one_window():
+    h = _harness(stall=30.0)
+    _publish(h, 1)
+    h.sync(rounds=1)
+    tr = h.controller.telemetry
+    assert tr.arm_tick("missing/key", 1.0) is False
+    tr.get(KEY).tick_due_mono = None  # reset the chain the sync armed
+    assert tr.arm_tick(KEY, 5.0, now=100.0) is True
+    assert tr.arm_tick(KEY, 5.0, now=104.9) is False  # window still live
+    assert tr.arm_tick(KEY, 5.0, now=105.0) is True  # due passed: re-arm
+    h.controller.telemetry.forget(KEY)
+
+
+def _strip_stalled_condition(h: Harness, to_status: str = None) -> None:
+    """Simulate a lost status write: rewrite the job's durable conditions
+    as if the flip/clear never landed."""
+    job = h.get_job(JOB)
+    conds = [cd for cd in job.status.conditions if cd.type != c.JOB_STALLED]
+    if to_status is not None:
+        cond = st._new_condition(c.JOB_STALLED, st.REASON_JOB_STALLED, "x")
+        cond.status = to_status
+        conds.append(cond)
+    job.status.conditions = conds
+    h.clients.tpujobs.update_status(job)
+
+
+def test_lost_flip_write_is_reasserted_without_recount():
+    h = _harness(stall=30.0)
+    _publish(h, 10)
+    h.sync()
+    _rewind(h)
+    h.sync()
+    assert _stalled_status(h) == "True"
+    flips0 = metrics.jobs_stalled.value
+    # the flip's write is "lost": the durable condition vanishes while the
+    # in-memory episode stays stalled — the next sync must repair it
+    _strip_stalled_condition(h)
+    h.sync(rounds=1)
+    assert _stalled_status(h) == "True"
+    assert metrics.jobs_stalled.value == flips0  # same episode, no recount
+    h.controller.telemetry.forget(KEY)
+
+
+def test_lost_clear_write_is_recleared():
+    h = _harness(stall=30.0)
+    _publish(h, 10)
+    h.sync()
+    _rewind(h)
+    h.sync()
+    _publish(h, 11)
+    h.sync()
+    assert _stalled_status(h) == "False"
+    # the clear's write is "lost": the stale True condition resurfaces
+    # while the in-memory episode is over — the next sync re-clears it
+    _strip_stalled_condition(h, to_status="True")
+    h.sync(rounds=1)
+    cond = st.get_condition(h.get_job(JOB).status, c.JOB_STALLED)
+    assert cond.status == "False" and cond.reason == st.REASON_PROGRESS_RESUMED
+    h.controller.telemetry.forget(KEY)
+
+
+def test_restart_policy_retries_after_transient_delete_failure():
+    h = _harness(stall=30.0, policy="restart")
+    _publish(h, 10, index=0)
+    h.sync()
+    pod_name = gen_general_name(JOB, c.REPLICA_TYPE_WORKER, 0)
+    uid0 = h.clients.pods.get("default", pod_name).metadata.uid
+    real_delete = h.controller.pod_control.delete_pod
+    boom = {"armed": True}
+
+    def flaky_delete(ns, name, owner):
+        if boom.pop("armed", False):
+            raise RuntimeError("transient transport failure")
+        return real_delete(ns, name, owner)
+
+    h.controller.pod_control.delete_pod = flaky_delete
+    flips0 = metrics.jobs_stalled.value
+    _rewind(h)
+    try:
+        h.sync(rounds=1)
+    except RuntimeError:
+        pass  # the sync surfaces the failed delete like any API error
+    # the abort landed BEFORE the status persist: the durable condition is
+    # missing while the in-memory episode is stalled — exactly the
+    # lost-flip-write case the repair path owns
+    assert _stalled_status(h) is None
+    assert h.controller.telemetry.get(KEY).stalled is True
+    assert h.clients.pods.get("default", pod_name).metadata.uid == uid0
+    # the next tick repairs the condition AND retries the delete rather
+    # than silently degrading the restart policy to event-only
+    h.sync()
+    assert _stalled_status(h) == "True"
+    assert metrics.jobs_stalled.value == flips0 + 1  # one episode, one count
+    assert h.clients.pods.get("default", pod_name).metadata.uid != uid0
+    assert h.controller.telemetry.get(KEY).restart_fired is True
+    h.controller.telemetry.forget(KEY)
+
+
+# ---------------------------------------------------------------------------
+# debug views
+# ---------------------------------------------------------------------------
+
+
+def test_debug_job_state_surfaces_resize_generation_and_progress():
+    h = _harness()
+    _publish(h, 33, ckpt=30)
+    h.sync()
+    state = h.controller.debug_job_state("default", JOB)
+    assert state["observedGeneration"] == 1
+    assert state["resize"] is None
+    assert state["progress"]["step"] == 33
+    assert state["progress"]["checkpoint_step"] == 30
+    assert state["progress"]["stalled"] is False
+    # a mid-flight resize surfaces its durable staging record
+    h.server.patch("tpujobs", "default", JOB, {
+        "spec": {"tpuReplicaSpecs": {"Worker": {"replicas": 4}}}})
+    h.sync(rounds=1)
+    state = h.controller.debug_job_state("default", JOB)
+    assert state["resize"] is not None
+    assert state["resize"]["targetReplicas"] == 4
+    assert state["observedGeneration"] == 2
+    assert h.controller.debug_job_state("default", "absent") is None
+    h.controller.telemetry.forget(KEY)
+
+
+def test_fleet_snapshot_single_controller():
+    h = _harness()
+    _publish(h, 5)
+    h.sync()
+    fleet = h.controller.fleet_snapshot()
+    assert fleet["identity"] == "single-controller"
+    assert fleet["shards"] is None
+    rows = {r["job"]: r for r in fleet["jobs"]}
+    assert rows[KEY]["step"] == 5 and rows[KEY]["stalled"] is False
+    h.controller.telemetry.forget(KEY)

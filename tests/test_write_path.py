@@ -237,6 +237,53 @@ def test_resync_drift_repair_not_suppressed():
     assert job.status.replica_statuses["Worker"].active == 3
 
 
+def test_mid_sync_cache_advance_never_erases_landed_restarts():
+    """The write-time diff must use the snapshot the sync was computed FROM,
+    never a re-read of the informer cache: the cache can advance mid-sync —
+    most commonly with the echo of the previous sync's own landed restarts
+    write — and diffing the stale recomputation against the fresh base
+    emits an explicit ``restarts: null`` delete, RV-guarded by the very
+    resourceVersion the advanced cache just handed over, silently erasing
+    the landed counter (reproduced as a rare flake in
+    test_preemption_over_k8s_rest_transport before the fix)."""
+    from tpujob.api.defaults import set_defaults_tpujob
+    from tpujob.api.types import TPUJob
+
+    h = Harness()
+    h.submit(new_tpujob(name="echo-job", master=None, workers=1,
+                        restart_policy=c.RESTART_POLICY_EXIT_CODE,
+                        backoff_limit=10))
+    h.sync()
+    h.set_pod_phase("echo-job", c.REPLICA_TYPE_WORKER, 0, "Running")
+    h.sync()
+    # the stale snapshot: the job as a sync starting NOW would read it
+    import copy
+
+    stale_dict = copy.deepcopy(
+        h.controller.job_informer.store.get("default", "echo-job"))
+    # a retryable preemption lands restarts=1 on the server AND (via the
+    # echo) in the informer cache
+    h.set_pod_phase("echo-job", c.REPLICA_TYPE_WORKER, 0, "Failed",
+                    exit_code=137)
+    h.sync()
+    assert h.get_job("echo-job").status.replica_statuses[
+        c.REPLICA_TYPE_WORKER].restarts == 1
+    cached = h.controller.job_informer.store.get("default", "echo-job")
+    assert ((cached["status"]["replicaStatuses"][c.REPLICA_TYPE_WORKER]
+             .get("restarts")) == 1), "cache must hold the landed echo"
+    # a sync computed from the STALE snapshot persists while the cache
+    # already shows the fresh object — the exact mid-sync-advance window
+    stale_job = TPUJob.from_dict(stale_dict)
+    set_defaults_tpujob(stale_job)
+    old_status = stale_job.status.deepcopy()
+    st.update_job_conditions(stale_job.status, c.JOB_RUNNING,
+                             st.REASON_JOB_RUNNING, "stale recompute")
+    h.controller._persist_status(stale_job, old_status)
+    job = h.get_job("echo-job")
+    assert job.status.replica_statuses[c.REPLICA_TYPE_WORKER].restarts == 1, (
+        "the landed restarts counter was erased by a stale-base diff")
+
+
 def test_patch_write_survives_concurrent_spec_bump():
     """The point of the merge-patch verb: a status write whose diff touches
     only derived fields must land even though a concurrent spec/metadata

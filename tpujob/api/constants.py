@@ -46,6 +46,7 @@ JOB_CREATED = "Created"
 JOB_RUNNING = "Running"
 JOB_RESTARTING = "Restarting"
 JOB_RESIZING = "Resizing"  # elastic resize (staged drain/join) in flight
+JOB_STALLED = "Stalled"  # progress watchdog: workload heartbeats stopped
 JOB_SUCCEEDED = "Succeeded"
 JOB_FAILED = "Failed"
 
@@ -85,3 +86,13 @@ ANNOTATION_WORLD_SIZE = f"{GROUP_NAME}/world-size"
 ANNOTATION_TARGET_WORLD_SIZE = f"{GROUP_NAME}/target-world-size"
 ANNOTATION_RESIZE_GENERATION = f"{GROUP_NAME}/resize-generation"
 ANNOTATION_CHECKPOINT_ACK = f"{GROUP_NAME}/checkpoint-ack"
+
+# --- workload telemetry: the progress-heartbeat channel ----------------------
+# Written by the WORKLOAD (coordinator process) on its OWN pod, rate-limited
+# and merge-patched so it composes with every other annotation writer: a
+# compact `step=N sps=F ckpt=N gen=N t=T` record of training progress (see
+# tpujob.api.progress for the exact grammar).  The controller ingests it from
+# its informer cache — the reverse direction of the world-size channel above,
+# and the signal the Stalled-job watchdog and the tpujob_job_* metric
+# families are built on.
+ANNOTATION_PROGRESS = f"{GROUP_NAME}/progress"

@@ -1,0 +1,103 @@
+"""The progress-heartbeat wire format (``tpujob.dev/progress``).
+
+One compact, single-line, order-insensitive ``key=value`` record published
+by the workload's step loop on its own pod annotation and parsed by the
+controller from its informer cache.  Shared here — dependency-free, importable
+by both halves without dragging jax into the control plane — the same split
+as the world-size channel (constants + ``workloads.distributed`` parser).
+
+Grammar (all fields optional except ``step``; unknown keys are ignored so
+the two halves can upgrade independently)::
+
+    step=1200 sps=3411.5 ckpt=1100 gen=2 t=1722772000.123
+
+- ``step`` — the workload's global training step (monotonic per incarnation;
+  a crash restore may legitimately regress it to the last checkpoint).
+- ``sps``  — smoothed samples/sec throughput.
+- ``ckpt`` — last durably checkpointed step.
+- ``gen``  — the resize epoch the workload last rendezvoused at (the
+  ``tpujob.dev/resize-generation`` annotation echoed back).
+- ``t``    — the workload's wall clock at publish.  Informational only: the
+  controller measures heartbeat age on ITS OWN monotonic clock from the
+  moment the annotation *changed* in the cache, so a skewed workload clock
+  can never fake (or mask) a stall.  Its role is to make every publish
+  distinct — a live-but-not-advancing workload still registers heartbeats.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Progress:
+    """One parsed heartbeat."""
+
+    step: int = 0
+    samples_per_sec: Optional[float] = None
+    checkpoint_step: Optional[int] = None
+    resize_generation: int = 0
+    published_at: Optional[float] = None  # workload wall clock (informational)
+
+
+def format_progress(
+    step: int,
+    samples_per_sec: Optional[float] = None,
+    checkpoint_step: Optional[int] = None,
+    resize_generation: int = 0,
+    published_at: Optional[float] = None,
+) -> str:
+    """Render one heartbeat annotation value."""
+    parts = [f"step={int(step)}"]
+    if samples_per_sec is not None:
+        parts.append(f"sps={float(samples_per_sec):.6g}")
+    if checkpoint_step is not None:
+        parts.append(f"ckpt={int(checkpoint_step)}")
+    if resize_generation:
+        parts.append(f"gen={int(resize_generation)}")
+    if published_at is not None:
+        parts.append(f"t={float(published_at):.3f}")
+    return " ".join(parts)
+
+
+def parse_progress(value: Optional[str]) -> Optional[Progress]:
+    """Parse a heartbeat annotation value; ``None`` when absent or
+    unparseable (a corrupt heartbeat degrades to "no heartbeat", it must
+    never crash a sync)."""
+    if not value:
+        return None
+    fields = {}
+    for token in value.split():
+        key, sep, raw = token.partition("=")
+        if sep:
+            fields[key] = raw
+    try:
+        step = int(fields["step"])
+    except (KeyError, ValueError):
+        return None
+
+    def _f(key: str) -> Optional[float]:
+        raw = fields.get(key)
+        if raw is None:
+            return None
+        try:
+            return float(raw)
+        except ValueError:
+            return None
+
+    def _i(key: str) -> Optional[int]:
+        raw = fields.get(key)
+        if raw is None:
+            return None
+        try:
+            return int(raw)
+        except ValueError:
+            return None
+
+    return Progress(
+        step=step,
+        samples_per_sec=_f("sps"),
+        checkpoint_step=_i("ckpt"),
+        resize_generation=_i("gen") or 0,
+        published_at=_f("t"),
+    )

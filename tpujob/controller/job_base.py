@@ -146,7 +146,36 @@ class ControllerConfig:
     # initial LIST.  The 10s default fits test clusters; a six-figure
     # object count (bench_controller --objects) needs minutes, not seconds.
     cache_sync_timeout_s: float = 10.0
+    # --- workload telemetry plane (progress heartbeats + stall watchdog) ---
+    # ingest tpujob.dev/progress pod-annotation heartbeats from the informer
+    # cache into per-job progress state + the tpujob_job_* metric families.
+    # False disables the whole plane (the bench_controller --watchdog
+    # control); jobs that never publish a heartbeat cost nothing either way.
+    enable_telemetry: bool = True
+    # progress watchdog: flip the job's Stalled condition when its reported
+    # step has not advanced for this long (monotonic clock; gaps during
+    # resize/restart/replica-churn windows are exempt and re-arm the
+    # deadline).  <= 0 disables the watchdog (heartbeat metrics still flow).
+    stall_timeout_s: float = 600.0
+    # what a detected stall does beyond the condition + event: "event" =
+    # observability only; "restart" = delete the stuck heartbeat-publishing
+    # replica once per stall episode (the normal reconcile recreates it)
+    stall_policy: str = "event"
+    # watchdog re-check cadence (requeued like ActiveDeadline); <= 0 derives
+    # stall_timeout_s / 4 clamped to [0.05s, 60s]
+    stall_check_interval_s: float = 0.0
     extra: Dict[str, Any] = field(default_factory=dict)
+
+    def stall_check_interval(self) -> float:
+        """The effective telemetry tick: the watchdog's re-check cadence,
+        or — with the watchdog disabled — the metrics-refresh cadence that
+        keeps the age gauges moving after a publisher dies (the
+        "heartbeat metrics still flow" contract)."""
+        if self.stall_check_interval_s > 0:
+            return self.stall_check_interval_s
+        if self.stall_timeout_s > 0:
+            return min(60.0, max(0.05, self.stall_timeout_s / 4.0))
+        return 60.0
 
 
 def expectation_key(job_key: str, rtype: str, kind: str) -> str:
@@ -389,14 +418,27 @@ class JobController:
         the caller must then let the shard lease expire instead of
         releasing it."""
         deadline = time.monotonic() + timeout
+        drained = True
         while True:
             with self._inflight_lock:
                 busy = bool(self._inflight_by_shard.get(shard))
             if not busy:
-                return True
+                break
             if time.monotonic() >= deadline:
-                return False
+                drained = False
+                break
             time.sleep(0.005)
+        # either way the shard is leaving this member (graceful release, or
+        # lease expiry after the timeout): per-shard state that must not be
+        # exported by two members — the telemetry series — is dropped here,
+        # behind the barrier, so no in-flight sync can resurrect it
+        self.on_shard_drained(shard)
+        return drained
+
+    def on_shard_drained(self, shard: int) -> None:
+        """Hook invoked after the drain barrier settled (successfully or
+        not) for a shard leaving this member; subclasses drop per-shard
+        derived state here."""
 
     def enqueue_shard(self, shard: int) -> int:
         """Acquisition replay: enqueue every cached job of a just-acquired

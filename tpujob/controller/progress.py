@@ -1,0 +1,282 @@
+"""Per-job workload-progress state: heartbeat ingestion + the stall clock.
+
+The in-memory half of the telemetry plane.  The reconciler feeds each sync's
+informer-cached pods through :meth:`ProgressTracker.ingest` (zero extra API
+reads) and this module keeps, per job:
+
+- the latest parsed :class:`~tpujob.api.progress.Progress` record and which
+  pod published it;
+- monotonic anchors for the three ages the watchdog and the ``tpujob_job_*``
+  metric families need: last heartbeat *change*, last *step advance*, last
+  *checkpoint advance*.  All controller-clock: a heartbeat "arrives" when
+  its annotation string changes in the cache, so workload clock skew can
+  neither fake nor mask a stall;
+- the stall episode state (condition currently True, restart already fired).
+
+Everything here is reconstructed, not durable: a cold-started controller (or
+a rebalanced-in shard owner) re-seeds from the pod annotations still on the
+cluster and grants the workload one full stall deadline from the moment it
+first observes them — exactly the conservative stance of the crash-loop
+damper rebuild.  The *Stalled condition* itself is durable in job status;
+:meth:`ingest` seeds the episode state from it so a restart never re-fires
+the flip (or the restart policy) for a stall already on record.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpujob.analysis import lockgraph
+from tpujob.api.progress import Progress
+from tpujob.server import metrics
+
+# ingestion events (returned by ProgressTracker.ingest)
+EVENT_FIRST = "first"  # first heartbeat this tracker has seen for the job
+EVENT_HEARTBEAT = "heartbeat"  # the annotation string changed
+EVENT_ADVANCE = "advance"  # the reported step moved forward
+EVENT_CHECKPOINT = "checkpoint"  # the reported checkpoint step advanced
+
+
+@dataclasses.dataclass
+class JobProgress:
+    """One job's telemetry state (mutated only under the tracker lock)."""
+
+    namespace: str
+    name: str
+    shard_label: str  # owning shard at ingest time ('-' when unsharded)
+    pod: str  # the pod whose annotation the newest heartbeat came from
+    raw: str  # last annotation value (change detector)
+    progress: Progress
+    first_mono: float
+    last_heartbeat_mono: float
+    last_advance_mono: float
+    last_checkpoint_mono: float
+    stalled: bool = False
+    restart_fired: bool = False  # restart policy acted this stall episode
+    tick_due_mono: Optional[float] = None  # in-flight watchdog tick's due time
+
+
+class ProgressTracker:
+    def __init__(self):
+        self._lock = lockgraph.new_lock("progress-tracker")
+        self._jobs: Dict[str, JobProgress] = {}  # guarded by self._lock
+
+    # ------------------------------------------------------------------
+    # ingestion
+    # ------------------------------------------------------------------
+
+    def ingest(
+        self,
+        key: str,
+        namespace: str,
+        name: str,
+        shard_label: str,
+        pod: str,
+        raw: str,
+        progress: Progress,
+        stalled_in_status: bool = False,
+        now: Optional[float] = None,
+    ) -> Tuple[JobProgress, List[str]]:
+        """Fold one observed heartbeat into the job's state and return
+        ``(state, events)``.  ``stalled_in_status`` seeds a fresh entry's
+        episode state from the durable condition (crash/handoff resume)."""
+        now = time.monotonic() if now is None else now
+        events: List[str] = []
+        with self._lock:
+            state = self._jobs.get(key)
+            if state is None:
+                state = JobProgress(
+                    namespace=namespace, name=name, shard_label=shard_label,
+                    pod=pod, raw=raw, progress=progress,
+                    first_mono=now, last_heartbeat_mono=now,
+                    last_advance_mono=now, last_checkpoint_mono=now,
+                    stalled=stalled_in_status,
+                    # a stall already on record resumes as already-acted:
+                    # the restart policy is once per EPISODE, and a
+                    # controller restart / shard handoff mid-episode must
+                    # not buy the stuck job another pod deletion
+                    restart_fired=stalled_in_status,
+                )
+                self._jobs[key] = state
+                return state, [EVENT_FIRST, EVENT_HEARTBEAT]
+            state.shard_label = shard_label
+            if raw == state.raw:
+                return state, events
+            prev = state.progress
+            events.append(EVENT_HEARTBEAT)
+            state.last_heartbeat_mono = now
+            if progress.step > prev.step or (
+                progress.resize_generation > prev.resize_generation
+            ):
+                # a new resize epoch counts as progress even when the step
+                # regressed to the restore point: the workload just moved
+                # through a re-rendezvous, which is the opposite of stuck
+                events.append(EVENT_ADVANCE)
+                state.last_advance_mono = now
+            if (progress.checkpoint_step or 0) > (prev.checkpoint_step or 0):
+                events.append(EVENT_CHECKPOINT)
+                state.last_checkpoint_mono = now
+            state.pod = pod
+            state.raw = raw
+            state.progress = progress
+            return state, events
+
+    def exempt(self, key: str, now: Optional[float] = None) -> None:
+        """Push the job's stall deadline: the sync observed an exemption
+        window (resize staging, restart, replica churn) during which a
+        heartbeat gap proves nothing.  Re-anchoring the advance clock grants
+        one full deadline after the window closes."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            state = self._jobs.get(key)
+            if state is not None:
+                state.last_advance_mono = now
+
+    # ------------------------------------------------------------------
+    # watchdog state
+    # ------------------------------------------------------------------
+
+    def get(self, key: str) -> Optional[JobProgress]:
+        with self._lock:
+            return self._jobs.get(key)
+
+    def stall_age(self, key: str, now: Optional[float] = None) -> Optional[float]:
+        """Seconds since the job's step last advanced (None = no telemetry)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            state = self._jobs.get(key)
+            if state is None:
+                return None
+            return max(0.0, now - state.last_advance_mono)
+
+    def mark_stalled(self, key: str, stalled: bool) -> None:
+        with self._lock:
+            state = self._jobs.get(key)
+            if state is None:
+                return
+            state.stalled = stalled
+            if not stalled:
+                state.restart_fired = False
+
+    def note_restart_fired(self, key: str) -> None:
+        with self._lock:
+            state = self._jobs.get(key)
+            if state is not None:
+                state.restart_fired = True
+
+    def arm_tick(self, key: str, interval: float,
+                 now: Optional[float] = None) -> bool:
+        """Claim the job's watchdog tick: True = the caller should schedule
+        one requeue ``interval`` out.  At most ONE tick chain lives per job
+        — the workqueue's delayed heap does not dedupe pending entries, so
+        an unconditional per-sync requeue would spawn a new immortal timer
+        chain per heartbeat event and self-amplify the sync rate without
+        bound.  A tick is re-armable only once its due time passed (the
+        sync the timer itself fired, or a later one)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            state = self._jobs.get(key)
+            if state is None:
+                return False
+            if state.tick_due_mono is not None and now < state.tick_due_mono:
+                return False  # a live tick already covers this window
+            state.tick_due_mono = now + interval
+            return True
+
+    # ------------------------------------------------------------------
+    # lifecycle / export
+    # ------------------------------------------------------------------
+
+    def forget(self, key: str) -> Optional[JobProgress]:
+        """Drop one job's state (finished/deleted job) and its metric
+        series; returns the dropped state."""
+        with self._lock:
+            state = self._jobs.pop(key, None)
+        if state is not None:
+            clear_job_series(state)
+        return state
+
+    def forget_shard(self, shard_label: str) -> List[JobProgress]:
+        """Drop every job of a handed-off shard (and its series): the new
+        owner re-seeds from the annotations, and two members must never
+        export the same job — that is the scrape-merge partition invariant."""
+        with self._lock:
+            keys = [k for k, s in self._jobs.items()
+                    if s.shard_label == shard_label]
+            dropped = [self._jobs.pop(k) for k in keys]
+        for state in dropped:
+            clear_job_series(state)
+        return dropped
+
+    def export(self, key: str, now: Optional[float] = None) -> None:
+        """Refresh the job's ``tpujob_job_*`` gauge children.
+
+        The sets run UNDER the tracker lock: ``labels()`` re-creates a
+        removed child on demand, so a set racing ``forget``/``forget_shard``
+        (whose pop also holds this lock) could otherwise resurrect a
+        just-cleared series — a permanently stale export, and on shard
+        handoff a violation of the one-exporter-per-job partition
+        invariant.  Lock order tracker -> family is one-way (nothing under
+        the family locks ever takes the tracker lock)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            state = self._jobs.get(key)
+            if state is None:
+                return
+            labels = dict(namespace=state.namespace, job=state.name,
+                          shard=state.shard_label)
+            prog = state.progress
+            metrics.job_steps.labels(**labels).set(float(prog.step))
+            metrics.job_samples_per_second.labels(**labels).set(
+                float(prog.samples_per_sec or 0.0))
+            metrics.job_heartbeat_age.labels(**labels).set(
+                round(max(0.0, now - state.last_heartbeat_mono), 3))
+            metrics.job_checkpoint_age.labels(**labels).set(
+                round(max(0.0, now - state.last_checkpoint_mono), 3))
+            metrics.job_stalled.labels(**labels).set(
+                1.0 if state.stalled else 0.0)
+
+    @staticmethod
+    def _row(key: str, s: JobProgress, now: float) -> Dict[str, Any]:  # caller holds self._lock
+        return {
+            "job": key,
+            "shard": s.shard_label,
+            "pod": s.pod,
+            "step": s.progress.step,
+            "samples_per_sec": s.progress.samples_per_sec,
+            "checkpoint_step": s.progress.checkpoint_step,
+            "resize_generation": s.progress.resize_generation,
+            "heartbeat_age_s": round(
+                max(0.0, now - s.last_heartbeat_mono), 3),
+            "advance_age_s": round(
+                max(0.0, now - s.last_advance_mono), 3),
+            "stalled": s.stalled,
+        }
+
+    def row(self, key: str, now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """One job's progress row (the /debug/jobs status-block half) —
+        O(1), not a full-fleet snapshot under the sync path's lock."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            state = self._jobs.get(key)
+            if state is None:
+                return None
+            return self._row(key, state, now)
+
+    def snapshot(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """The ``/debug/fleet`` rows: one dict per tracked job."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return [self._row(key, s, now)
+                    for key, s in sorted(self._jobs.items())]
+
+
+def clear_job_series(state: JobProgress) -> None:
+    """Remove the job's children from every ``tpujob_job_*`` family."""
+    labels = dict(namespace=state.namespace, job=state.name,
+                  shard=state.shard_label)
+    for family in (metrics.job_steps, metrics.job_samples_per_second,
+                   metrics.job_checkpoint_age, metrics.job_heartbeat_age,
+                   metrics.job_stalled):
+        family.remove(**labels)

@@ -21,11 +21,19 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from tpujob.api import constants as c
 from tpujob.api.defaults import set_defaults_tpujob
+from tpujob.api.progress import parse_progress
 from tpujob.api.types import ReplicaStatus, ResizeStatus, TPUJob
 from tpujob.api.validation import validate_tpujob_spec
 from tpujob.controller import status as st
 from tpujob.controller import tpu_env
 from tpujob.controller.config import render_init_containers
+from tpujob.controller.progress import (
+    EVENT_ADVANCE,
+    EVENT_CHECKPOINT,
+    EVENT_FIRST,
+    JobProgress,
+    ProgressTracker,
+)
 from tpujob.controller.joblogger import (
     logger_for_job,
     logger_for_key,
@@ -147,6 +155,19 @@ class TPUJobController(JobController):
         # status.resize.startedAt; this one just keeps the duration metric
         # off the wall clock.  Same single-writer-per-key safety argument.
         self._resize_started_mono: Dict[str, float] = {}
+        # workload telemetry plane: per-job progress-heartbeat state ingested
+        # from the informer-cached pod annotations (never an extra API read)
+        # + the stall watchdog's monotonic deadline clocks.  Reconstructed,
+        # not durable — a restarted controller (or a rebalanced-in shard
+        # owner) re-seeds from the annotations still on the cluster and
+        # grants one full stall deadline, the damper-rebuild stance.
+        self.telemetry = ProgressTracker()
+        # the status snapshot THIS sync was computed from, stashed for the
+        # write path's diff (job key -> JobStatus; same single-writer-per-
+        # key safety as _restart_deltas).  The patch diff must use the
+        # sync-start base, never a write-time cache re-read — see
+        # _patch_job_status.
+        self._sync_status_base: Dict[str, Any] = {}
 
     # ------------------------------------------------------------------
     # cold-start recovery (crash-only controller semantics)
@@ -354,6 +375,7 @@ class TPUJobController(JobController):
         self._restart_deltas.pop(key, None)  # no leak; no carry-over to a
         # future job recreated under the same namespace/name
         self._resize_started_mono.pop(key, None)  # same hygiene
+        self.telemetry.forget(key)  # drops the tpujob_job_* series too
         for rtype in (c.REPLICA_TYPE_MASTER, c.REPLICA_TYPE_WORKER):
             self.expectations.delete(expectation_key(key, rtype, "pods"))
             self.expectations.delete(expectation_key(key, rtype, "services"))
@@ -453,6 +475,10 @@ class TPUJobController(JobController):
         # terminal: clean up and freeze (controller.go:362-389)
         if st.is_finished(job.status):
             job.status.resize = None  # a finished job has no in-flight resize
+            # a finished job stops exporting telemetry: its heartbeat age
+            # only grows, and the terminal transition already flipped any
+            # Stalled condition False (status.set_condition semantics)
+            self.telemetry.forget(key)
             self._delete_pods_and_services(job, pods, services)
             self._cleanup_ttl(job)
             if self.config.enable_gang_scheduling:
@@ -515,6 +541,15 @@ class TPUJobController(JobController):
             if exceeded:
                 return self._fail_job(job, old_status, pods, services,
                                       self._backoff_message(job, reason))
+
+        # workload telemetry: ingest the job's progress heartbeat from the
+        # pods already claimed this sync and run the stall watchdog.  After
+        # the status machine (so exemption checks see THIS sync's
+        # conditions), before persistence (so a Stalled flip rides the same
+        # status write).  A pure heartbeat tick changes no status field and
+        # stays a suppressed write.
+        with TRACER.span("phase", phase="telemetry"):
+            self._reconcile_telemetry(job, pods)
 
         self._persist_status(job, old_status)
         return True
@@ -1127,6 +1162,293 @@ class TPUJobController(JobController):
             {"world": world, "target": target, "rolled_back": rolled_back})
 
     # ------------------------------------------------------------------
+    # workload telemetry: heartbeat ingestion + the stall watchdog
+    # ------------------------------------------------------------------
+
+    def _reconcile_telemetry(self, job: TPUJob, pods: List[Pod]) -> None:
+        """Ingest the job's workload progress heartbeat and run the
+        Stalled-job watchdog.
+
+        Ingestion reads the ``tpujob.dev/progress`` annotation off the pods
+        this sync already claimed from the informer cache — zero extra API
+        reads, and an annotation-only pod MODIFIED event reaches here
+        through the normal settle-window coalescer like any other pod
+        event.  A job that never publishes a heartbeat costs nothing and
+        never arms the watchdog.
+
+        The watchdog flips a ``Stalled`` condition when the reported step
+        has not advanced for ``stall_timeout_s`` on the controller's
+        monotonic clock.  Chaos-safe: heartbeat gaps during windows where
+        a gap proves nothing — a resize staging, a counted restart, replica
+        churn from preemption — re-arm the deadline instead of counting
+        toward it, so the soak's fault schedule cannot mint false stalls.
+        Recovery (the step advances again) clears the condition.  The tick
+        is requeued like ActiveDeadline; across a crash or shard handoff
+        the durable condition survives in status while the deadline clock
+        conservatively restarts from re-ingestion.
+        """
+        if not self.config.enable_telemetry:
+            return
+        key = job.key
+        if st.is_finished(job.status):
+            # the job went terminal THIS sync: the terminal transition just
+            # flipped any Stalled condition False (set_condition semantics)
+            # and the lost-write repair below must not read that flip as a
+            # lost stall write and resurrect it onto a finished job
+            self.telemetry.forget(key)
+            return
+        if self.sharder is not None and not self._owns_key(key):
+            return  # a draining shard's wedged sync must not resurrect state
+        best: Optional[Tuple] = None
+        best_pod: Optional[Pod] = None
+        best_raw = ""
+        for p in pods:
+            raw = (p.metadata.annotations or {}).get(c.ANNOTATION_PROGRESS)
+            if not raw:
+                continue
+            prog = parse_progress(raw)
+            if prog is None:
+                _time_warner.warning(
+                    log, ("bad-progress", key, raw),
+                    "unparseable %s annotation %r on pod %s; ignoring",
+                    c.ANNOTATION_PROGRESS, raw, p.metadata.name)
+                continue
+            rank = (prog.resize_generation, prog.step,
+                    prog.published_at or 0.0, p.metadata.name)
+            if best is None or rank > best[0]:
+                best = (rank, prog)
+                best_pod, best_raw = p, raw
+        events: List[str] = []
+        if best is not None:
+            ns = job.metadata.namespace or "default"
+            shard = None
+            if self.sharder is not None and job.metadata.uid:
+                shard = self.sharder.shard_of_uid(job.metadata.uid)
+            state, events = self.telemetry.ingest(
+                key, ns, job.metadata.name,
+                str(shard) if shard is not None else "-",
+                best_pod.metadata.name, best_raw, best[1],
+                stalled_in_status=st.has_condition(job.status, c.JOB_STALLED),
+            )
+        else:
+            state = self.telemetry.get(key)
+            if state is None:
+                return  # not a telemetry-publishing job
+        if EVENT_FIRST in events:
+            self.flight.record(
+                key, "progress",
+                f"heartbeat channel established by {state.pod} "
+                f"(step {state.progress.step})",
+                {"pod": state.pod, "step": state.progress.step,
+                 "stalled_in_status": state.stalled})
+        if EVENT_CHECKPOINT in events:
+            self.flight.record(
+                key, "progress",
+                f"checkpoint advanced to step {state.progress.checkpoint_step}",
+                {"checkpoint_step": state.progress.checkpoint_step,
+                 "step": state.progress.step})
+        exempt = self._telemetry_exempt(job, pods)
+        if exempt is not None:
+            # the gap proves nothing during this window: re-arm the deadline
+            # so the workload gets one full stall_timeout after it closes
+            self.telemetry.exempt(key)
+        timeout = self.config.stall_timeout_s
+        if timeout > 0:
+            if state.stalled:
+                if EVENT_ADVANCE in events:
+                    self._clear_stalled(job, state)
+                elif not st.has_condition(job.status, c.JOB_STALLED):
+                    # the flip's status write was lost (conflict/transport
+                    # error after the in-memory transition): unlike every
+                    # other condition, Stalled is not re-derived from pods
+                    # each sync, so it must repair itself here — quietly,
+                    # with no second event/count for the same episode
+                    st.update_job_conditions(
+                        job.status, c.JOB_STALLED, st.REASON_JOB_STALLED,
+                        f"TPUJob {job.metadata.name} has stalled: no "
+                        f"training progress (last step "
+                        f"{state.progress.step} from {state.pod}).")
+            elif st.has_condition(job.status, c.JOB_STALLED):
+                # the clear's status write was lost: re-clear quietly
+                st.mark_condition_false(
+                    job.status, c.JOB_STALLED, st.REASON_PROGRESS_RESUMED,
+                    f"TPUJob {job.metadata.name} resumed progress at step "
+                    f"{state.progress.step}.")
+            elif exempt is None:
+                age = self.telemetry.stall_age(key)
+                if age is not None and age >= timeout:
+                    self._flip_stalled(job, state, age)
+            if (state.stalled and self.config.stall_policy == "restart"
+                    and not state.restart_fired and exempt is None):
+                # attempted while stalled on every tick until it lands once:
+                # a transient delete failure (or a mid-recreation window,
+                # which reads as churn-exempt) must not silently degrade
+                # the restart policy to event-only for the whole episode
+                self._restart_stuck_replica(job, state, pods)
+        # the telemetry tick: requeued like ActiveDeadline so a stall is
+        # detected within ~one check interval of its deadline even when no
+        # event ever surfaces the job again — and armed with the watchdog
+        # DISABLED too, at a slower cadence, so the age gauges keep moving
+        # after a dead publisher stops producing pod events (the
+        # metrics-still-flow contract).  arm_tick keeps exactly one live
+        # tick chain per job — the delayed queue does not dedupe, so
+        # scheduling unconditionally would leak a timer chain per
+        # heartbeat event
+        interval = self.config.stall_check_interval()
+        if self.telemetry.arm_tick(key, interval):
+            self.queue.add_after(key, interval)
+        self.telemetry.export(key)
+
+    def _telemetry_exempt(self, job: TPUJob, pods: List[Pod]) -> Optional[str]:
+        """Why a heartbeat gap is currently unaccountable (None = it counts):
+        resize staging in flight, a counted restart in progress, or replica
+        churn (missing/non-Running pods — preemption, node loss, a watchdog
+        restart itself)."""
+        ann = job.metadata.annotations or {}
+        if (job.status.resize is not None
+                or ann.get(c.ANNOTATION_TARGET_WORLD_SIZE) is not None
+                or st.has_condition(job.status, c.JOB_RESIZING)):
+            return "resize"
+        if st.has_condition(job.status, c.JOB_RESTARTING):
+            return "restart"
+        expected = get_total_replicas(job)
+        running = sum(1 for p in pods
+                      if p.status.phase == "Running"
+                      and not p.metadata.deletion_timestamp)
+        if running < expected:
+            return "replica-churn"
+        return None
+
+    def _flip_stalled(self, job: TPUJob, state: JobProgress, age: float) -> None:
+        timeout = self.config.stall_timeout_s
+        message = (f"TPUJob {job.metadata.name} has stalled: no training "
+                   f"progress for {age:.1f}s (deadline {timeout:g}s; last "
+                   f"step {state.progress.step} from {state.pod}).")
+        st.update_job_conditions(job.status, c.JOB_STALLED,
+                                 st.REASON_JOB_STALLED, message)
+        self.telemetry.mark_stalled(job.key, True)
+        metrics.jobs_stalled.inc()
+        self.recorder.event(job, "Warning", st.REASON_JOB_STALLED, message)
+        self.flight.record(
+            job.key, "progress",
+            f"STALLED: no step advance for {age:.1f}s "
+            f"(deadline {timeout:g}s, last step {state.progress.step})",
+            {"age_s": round(age, 3), "deadline_s": timeout,
+             "step": state.progress.step, "pod": state.pod,
+             "policy": self.config.stall_policy})
+
+    def _clear_stalled(self, job: TPUJob, state: JobProgress) -> None:
+        message = (f"TPUJob {job.metadata.name} resumed progress at step "
+                   f"{state.progress.step}.")
+        st.mark_condition_false(job.status, c.JOB_STALLED,
+                                st.REASON_PROGRESS_RESUMED, message)
+        self.telemetry.mark_stalled(job.key, False)
+        self.recorder.event(job, "Normal", st.REASON_PROGRESS_RESUMED, message)
+        self.flight.record(
+            job.key, "progress",
+            f"recovered: progress resumed at step {state.progress.step}",
+            {"step": state.progress.step, "pod": state.pod})
+
+    def _restart_stuck_replica(self, job: TPUJob, state: JobProgress,
+                               pods: List[Pod]) -> None:
+        """The restart policy: delete the heartbeat-publishing replica once
+        per stall episode; the normal reconcile recreates the missing index.
+        NOT a failure strike — no ``restarts`` increment, no Restarting
+        condition (the pod was Running, just silent), and the recreated
+        pod's churn window is itself a watchdog exemption."""
+        pod = next((p for p in pods if p.metadata.name == state.pod), None)
+        if pod is None or pod.metadata.deletion_timestamp:
+            return
+        rtype = pod.metadata.labels.get(c.LABEL_REPLICA_TYPE) or ""
+        ekey = expectation_key(job.key, rtype, "pods")
+        self.expectations.expect(ekey, adds=0, dels=1)
+        self.flight.record(
+            job.key, "progress",
+            f"watchdog restart: deleting stuck replica {pod.metadata.name}",
+            {"pod": pod.metadata.name, "rtype": rtype})
+        try:
+            self.pod_control.delete_pod(
+                pod.metadata.namespace, pod.metadata.name, job)
+        except NotFoundError:
+            self.expectations.observe_del(ekey)
+        except ServerTimeoutError:
+            # ambiguous 504: either way the episode acted once — idempotent
+            # because restart_fired is set below only on this path too
+            self.expectations.observe_del(ekey)
+        except Exception:
+            # the delete did not happen: clear the expectation and leave
+            # restart_fired unset so the next tick retries it
+            self.expectations.observe_del(ekey)
+            raise
+        self.telemetry.note_restart_fired(job.key)
+        metrics.watchdog_restarts.inc()
+        self.recorder.event(
+            job, "Warning", st.REASON_JOB_STALLED,
+            f"Progress watchdog deleted stuck replica {pod.metadata.name} "
+            f"of TPUJob {job.metadata.name}.")
+
+    def on_shard_drained(self, shard: int) -> None:
+        """Shard handoff: drop the handed-off shard's telemetry state and
+        metric series — the new owner re-seeds from the pod annotations,
+        and two members exporting the same job would break the scrape-merge
+        partition invariant."""
+        dropped = self.telemetry.forget_shard(str(shard))
+        if dropped:
+            from tpujob.obs.recorder import CONTROLLER_TIMELINE_KEY
+
+            self.flight.record(
+                CONTROLLER_TIMELINE_KEY, "shard",
+                f"shard {shard} drained: telemetry for {len(dropped)} "
+                f"job(s) dropped",
+                {"shard": shard, "jobs": len(dropped)})
+
+    # ------------------------------------------------------------------
+    # debug introspection (the /debug/fleet and /debug/jobs payload halves
+    # owned by the controller rather than the flight recorder)
+    # ------------------------------------------------------------------
+
+    def fleet_snapshot(self) -> Dict[str, Any]:
+        """The ``/debug/fleet`` payload: this instance's identity, the
+        shards it currently owns, and one progress row per tracked job.
+        Scrape-merge story: every member of a sharded fleet serves this
+        endpoint; concatenating the ``jobs`` arrays (or the scraped
+        ``tpujob_job_*`` series) across members yields the fleet view, and
+        each job must appear under exactly one member — the same partition
+        invariant ``shard_ownership`` makes checkable in promql."""
+        identity = "single-controller"
+        shards: Optional[List[int]] = None
+        if self.sharder is not None:
+            identity = getattr(self.sharder, "identity", identity)
+            owned = getattr(self.sharder, "owned_shards", None)
+            if callable(owned):
+                shards = sorted(owned())
+        return {
+            "identity": identity,
+            "shards": shards,
+            "stall_timeout_s": self.config.stall_timeout_s,
+            "stall_policy": self.config.stall_policy,
+            "jobs": self.telemetry.snapshot(),
+        }
+
+    def debug_job_state(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        """Controller-owned state merged into ``/debug/jobs/<ns>/<name>``:
+        the durable resize staging record, the observed spec generation,
+        and the live progress row — the fields the timeline alone cannot
+        show."""
+        ns = namespace or "default"
+        obj = self.job_informer.store.get(ns, name)
+        row = self.telemetry.row(f"{ns}/{name}")
+        if obj is None and row is None:
+            return None
+        out: Dict[str, Any] = {"progress": row}
+        if obj is not None:
+            status = obj.get("status")
+            status = status if isinstance(status, dict) else {}
+            out["resize"] = status.get("resize")
+            out["observedGeneration"] = status.get("observedGeneration")
+        return out
+
+    # ------------------------------------------------------------------
     # services (service.go:36-153)
     # ------------------------------------------------------------------
 
@@ -1421,7 +1743,11 @@ class TPUJobController(JobController):
             if self.config.suppress_noop_status:
                 metrics.status_writes.labels(result="suppressed").inc()
             return
-        self.update_status_handler(job)
+        self._sync_status_base[job.key] = old_status
+        try:
+            self.update_status_handler(job)
+        finally:
+            self._sync_status_base.pop(job.key, None)
 
     def _update_job_status(self, job: TPUJob) -> None:
         with TRACER.span("phase", phase="status_update"):
@@ -1479,8 +1805,27 @@ class TPUJobController(JobController):
             logger_for_job(log, job).info(
                 "job was recreated mid-sync; dropping the stale status write")
             return
-        old = (cached or {}).get("status")
-        old = old if isinstance(old, dict) else {}
+        # The diff base MUST be the snapshot this sync was computed FROM
+        # (stashed by _persist_status), never a write-time cache re-read:
+        # the cache can advance mid-sync — most commonly with the echo of
+        # the PREVIOUS sync's own landed write — and diffing the stale
+        # recomputation against the fresh base emits explicit null deletes
+        # for keys the recomputation never knew about (a just-landed
+        # cumulative restarts counter), silently erasing them server-side.
+        # The restarts RV guard cannot catch that case: it would assert the
+        # very resourceVersion the advanced cache just handed us.  The
+        # re-read above serves ONLY the incarnation (uid) check.
+        base = self._sync_status_base.get(job.key)
+        if base is not None:
+            old = base.to_dict()
+            base_rv = job.metadata.resource_version
+        else:
+            # handler invoked directly (tests, custom injectors): fall back
+            # to the cache as both diff base and RV source
+            old = (cached or {}).get("status")
+            old = old if isinstance(old, dict) else {}
+            base_rv = ((cached or {}).get("metadata") or {}).get(
+                "resourceVersion")
         patch = st.status_merge_patch(old, job.status.to_dict())
         if patch is None:
             # a semantically empty diff can never hide a condition
@@ -1505,7 +1850,9 @@ class TPUJobController(JobController):
         patch["lastReconcileTime"] = job.status.last_reconcile_time
         rv = None
         if st.patch_touches_restarts(patch):
-            rv = ((cached or {}).get("metadata") or {}).get("resourceVersion")
+            # guard with the RV of the DIFF BASE: a restarts-bearing patch
+            # is only valid against the state it was derived from
+            rv = base_rv
         try:
             self.clients.tpujobs.patch_status(ns, name, patch, resource_version=rv)
         except NotFoundError:

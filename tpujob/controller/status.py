@@ -31,6 +31,9 @@ REASON_JOB_FAILED = "TPUJobFailed"
 REASON_JOB_RESIZING = "TPUJobResizing"
 REASON_RESIZE_COMPLETED = "TPUJobResizeCompleted"
 REASON_RESIZE_ROLLED_BACK = "TPUJobResizeRolledBack"
+# progress watchdog (workload telemetry plane)
+REASON_JOB_STALLED = "TPUJobStalled"
+REASON_PROGRESS_RESUMED = "TPUJobProgressResumed"
 
 
 def get_condition(status: JobStatus, cond_type: str) -> Optional[JobCondition]:
@@ -96,10 +99,12 @@ def set_condition(status: JobStatus, condition: JobCondition) -> None:
         elif condition.type == c.JOB_RESTARTING:
             conditions = _filter_out(conditions, c.JOB_RUNNING)
         elif condition.type in (c.JOB_SUCCEEDED, c.JOB_FAILED):
-            # a finished job is neither running nor mid-resize: flip both to
-            # False (history preserved) rather than dropping them
+            # a finished job is neither running, nor mid-resize, nor stalled:
+            # flip all three to False (history preserved) rather than
+            # dropping them
             for cond in conditions:
-                if cond.type in (c.JOB_RUNNING, c.JOB_RESIZING) and cond.status == "True":
+                if cond.type in (c.JOB_RUNNING, c.JOB_RESIZING,
+                                 c.JOB_STALLED) and cond.status == "True":
                     cond.status = "False"
                     cond.last_transition_time = condition.last_transition_time
                     cond.last_update_time = condition.last_update_time

@@ -120,6 +120,13 @@ def cluster_env(
     coord_host = "localhost" if is_coordinator else coordinator_dns(job)
     coord = f"{coord_host}:{port}"
 
+    # pod self-identity (downward-API convention): lets the workload address
+    # its OWN pod — the progress-heartbeat channel publishes on it
+    self_env = {
+        "TPUJOB_POD_NAME": gen_general_name(job.metadata.name, rtype, index),
+        "TPUJOB_POD_NAMESPACE": job.metadata.namespace or "default",
+    }
+
     if topo is None:
         # No TPU spec: fall back to flat 1-pod-1-process accounting, exactly
         # the reference's WORLD_SIZE = Σ replicas (pod.go:252).
@@ -132,6 +139,7 @@ def cluster_env(
             "TPUJOB_COORDINATOR_ADDRESS": coord,
             "TPUJOB_NUM_PROCESSES": str(world),
             "TPUJOB_PROCESS_ID": str(pid),
+            **self_env,
             "MASTER_ADDR": coord_host,
             "MASTER_PORT": str(port),
             "WORLD_SIZE": str(world),
@@ -150,6 +158,7 @@ def cluster_env(
         "TPUJOB_NUM_SLICES": str(topo.num_slices),
         "TPUJOB_SLICE_ID": str(slice_id),
         "TPUJOB_HOST_INDEX": str(host_index),
+        **self_env,
         "TPUJOB_DEVICES_PER_HOST": str(topo.devices_per_host),
         "TPUJOB_GLOBAL_DEVICES": str(topo.global_devices),
         # libtpu multi-host contract (per-slice: ids and hostnames must agree)

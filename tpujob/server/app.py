@@ -155,6 +155,10 @@ class OperatorApp:
                 informer_page_size=opt.informer_page_size,
                 watch_bookmarks=opt.watch_bookmarks,
                 cache_sync_timeout_s=opt.cache_sync_timeout_s,
+                enable_telemetry=opt.enable_telemetry,
+                stall_timeout_s=opt.stall_timeout_s,
+                stall_policy=opt.stall_policy,
+                stall_check_interval_s=opt.stall_check_interval_s,
             ),
         )
         if self.coordinator is not None:
@@ -183,8 +187,10 @@ class OperatorApp:
             self.monitoring = MonitoringServer(
                 port=self.opt.monitoring_port,
                 flight=self.controller.flight,
+                fleet=self.controller.fleet_snapshot,
+                debug_state=self.controller.debug_job_state,
             ).start()
-            log.info("monitoring on :%d/metrics (+/debug/jobs)",
+            log.info("monitoring on :%d/metrics (+/debug/jobs, /debug/fleet)",
                      self.monitoring.port)
 
         def start_controller():

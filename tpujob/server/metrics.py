@@ -188,6 +188,19 @@ class _LabeledFamily:
                 self._children[key] = child
         return child
 
+    def remove(self, **labelvalues) -> bool:
+        """Drop one label combination's child series (returns whether it
+        existed).  Per-job families (``tpujob_job_*``) need this: a deleted
+        job's gauges would otherwise export stale — and ever-growing —
+        heartbeat/checkpoint ages forever."""
+        if set(labelvalues) != set(self._labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labelvalues)} != declared "
+                f"{sorted(self._labelnames)}")
+        key = tuple(str(labelvalues[n]) for n in self._labelnames)
+        with self._lock:
+            return self._children.pop(key, None) is not None
+
     def kind(self) -> str:
         return self._kind
 
@@ -327,8 +340,8 @@ api_request_duration = LabeledHistogram(
 )
 sync_phase_duration = LabeledHistogram(
     "tpujob_operator_sync_phase_duration_seconds",
-    "Latency of one reconcile phase (cache_get, claim, pod_diff, "
-    "service_diff, slow_start_create, status_update)",
+    "Latency of one reconcile phase (cache_get, claim, resize, pod_diff, "
+    "service_diff, slow_start_create, telemetry, status_update)",
     REGISTRY,
     ("phase",),
 )
@@ -481,5 +494,62 @@ history_compactions = Counter(
     "Compaction pressure on the in-memory API server's bounded watch "
     "history: explicit compact() calls plus events evicted by the history "
     "bound — each advances the oldest servable resume/continue point",
+    REGISTRY,
+)
+
+# Workload-telemetry series (the job telemetry plane): per-job training
+# progress ingested from the workloads' tpujob.dev/progress pod-annotation
+# heartbeats — zero extra API reads; everything arrives through the informer
+# cache the reconciler already holds.  Label semantics: each controller
+# instance exports ONLY the jobs whose shard it currently owns, with the
+# owning shard as a label ('-' when unsharded), so N scraped controllers
+# compose into one fleet view and the partition invariant stays checkable in
+# promql (each (namespace, job) must appear on exactly one instance).  Series
+# are removed when the job finishes, is deleted, or its shard is handed off.
+_JOB_LABELS = ("namespace", "job", "shard")
+job_steps = LabeledGauge(
+    "tpujob_job_steps_total",
+    "Latest global training step reported by the job's workload heartbeat "
+    "(gauge: a crash restore may regress it to the last checkpoint)",
+    REGISTRY,
+    _JOB_LABELS,
+)
+job_samples_per_second = LabeledGauge(
+    "tpujob_job_samples_per_second",
+    "Smoothed training throughput reported by the job's workload heartbeat",
+    REGISTRY,
+    _JOB_LABELS,
+)
+job_checkpoint_age = LabeledGauge(
+    "tpujob_job_checkpoint_age_seconds",
+    "Seconds since the job's reported checkpoint step last advanced "
+    "(controller monotonic clock; the workload's clock is never trusted)",
+    REGISTRY,
+    _JOB_LABELS,
+)
+job_heartbeat_age = LabeledGauge(
+    "tpujob_job_heartbeat_age_seconds",
+    "Seconds since the job's progress heartbeat last changed in the "
+    "informer cache (controller monotonic clock)",
+    REGISTRY,
+    _JOB_LABELS,
+)
+job_stalled = LabeledGauge(
+    "tpujob_job_stalled",
+    "Whether the progress watchdog currently holds the job's Stalled "
+    "condition True (1) or not (0)",
+    REGISTRY,
+    _JOB_LABELS,
+)
+jobs_stalled = Counter(
+    "tpujob_operator_stalled_jobs_total",
+    "Stalled-condition flips by the progress watchdog (each is one detected "
+    "stall episode; recoveries clear the condition but are not counted here)",
+    REGISTRY,
+)
+watchdog_restarts = Counter(
+    "tpujob_operator_watchdog_restarts_total",
+    "Stuck replicas deleted by the progress watchdog's restart policy "
+    "(--stall-policy restart; the normal reconcile then recreates them)",
     REGISTRY,
 )

@@ -5,8 +5,18 @@ flight recorder's introspection payloads — the ``kubectl describe`` analog
 for the operator's own decision history:
 
 - ``/debug/jobs``                 index of tracked jobs
-- ``/debug/jobs/<ns>/<name>``     ordered per-job lifecycle timeline
+- ``/debug/jobs/<ns>/<name>``     ordered per-job lifecycle timeline, plus
+                                  the controller-owned ``status`` block
+                                  (resize staging record, observed
+                                  generation, live progress row)
 - ``/debug/traces/<corr-id>``     one sync's nested span tree
+- ``/debug/fleet``                this instance's workload-telemetry view:
+                                  identity, owned shards, one progress row
+                                  per job it currently syncs.  Merging the
+                                  payloads of every fleet member yields the
+                                  fleet-wide view (each job appears under
+                                  exactly one member — the shard partition
+                                  invariant).
 
 All JSON, all read-only, all bounded (the recorder rotates history).
 """
@@ -28,14 +38,27 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _debug_payload(self, path: str):
         """Resolve one /debug/* path to its JSON payload (None = 404)."""
+        parts = [p for p in path.split("/") if p]  # ["debug", ...]
+        if parts == ["debug", "fleet"]:
+            fleet = getattr(self.server, "fleet", None)
+            return fleet() if callable(fleet) else None
         flight = getattr(self.server, "flight", None)
         if flight is None:
             return None
-        parts = [p for p in path.split("/") if p]  # ["debug", ...]
         if parts == ["debug", "jobs"]:
             return flight.jobs_index()
         if len(parts) == 4 and parts[:2] == ["debug", "jobs"]:
-            return flight.timeline(parts[2], parts[3])
+            payload = flight.timeline(parts[2], parts[3])
+            state_fn = getattr(self.server, "debug_state", None)
+            if callable(state_fn):
+                # controller-owned state the timeline cannot carry: the
+                # durable resize record, observedGeneration, live progress
+                state = state_fn(parts[2], parts[3])
+                if payload is None and state is not None:
+                    payload = {"job": f"{parts[2]}/{parts[3]}", "entries": []}
+                if payload is not None:
+                    payload["status"] = state
+            return payload
         if len(parts) == 3 and parts[:2] == ["debug", "traces"]:
             return flight.trace(parts[2])
         return None
@@ -65,11 +88,16 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class MonitoringServer:
-    def __init__(self, host: str = "0.0.0.0", port: int = 8443, flight=None):
+    def __init__(self, host: str = "0.0.0.0", port: int = 8443, flight=None,
+                 fleet=None, debug_state=None):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.daemon_threads = True
         # the flight recorder backing /debug/* (None = endpoints 404)
         self.httpd.flight = flight
+        # callable returning the /debug/fleet payload (None = 404)
+        self.httpd.fleet = fleet
+        # callable(ns, name) merged into /debug/jobs/<ns>/<name> as "status"
+        self.httpd.debug_state = debug_state
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -80,6 +80,14 @@ class ServerOption:
     # cold-start barrier budget: how long run() waits for every informer's
     # initial LIST — six-figure object counts need minutes, not seconds
     cache_sync_timeout_s: float = 120.0
+    # workload telemetry plane: progress-heartbeat ingestion + tpujob_job_*
+    # metrics (--no-telemetry disables the whole plane) and the stall
+    # watchdog (Stalled condition after this many heartbeat-less seconds;
+    # <= 0 disables the watchdog, metrics still flow)
+    enable_telemetry: bool = True
+    stall_timeout_s: float = 600.0
+    stall_policy: str = "event"  # "event" | "restart"
+    stall_check_interval_s: float = 0.0  # <= 0 derives stall_timeout / 4
 
 
 class _LazyVersionAction(argparse.Action):
@@ -213,6 +221,31 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="seconds to wait for the informers' initial "
                              "LIST at cold start before failing; size to "
                              "your object count (100k objects needs minutes)")
+    parser.add_argument("--telemetry", dest="enable_telemetry",
+                        action="store_true", default=True,
+                        help="ingest workload progress heartbeats "
+                             "(tpujob.dev/progress pod annotations) into "
+                             "per-job metrics + /debug/fleet (default on)")
+    parser.add_argument("--no-telemetry", dest="enable_telemetry",
+                        action="store_false",
+                        help="disable the workload telemetry plane "
+                             "(heartbeats ignored, watchdog off)")
+    parser.add_argument("--stall-timeout", type=float, default=600.0,
+                        dest="stall_timeout_s",
+                        help="progress watchdog: flip a job's Stalled "
+                             "condition when its reported step has not "
+                             "advanced for this many seconds (resize/"
+                             "restart/churn windows exempt; <=0 disables)")
+    parser.add_argument("--stall-policy", choices=("event", "restart"),
+                        default="event", dest="stall_policy",
+                        help="what a detected stall does beyond the "
+                             "condition + event: 'restart' deletes the "
+                             "stuck heartbeat-publishing replica once per "
+                             "stall episode")
+    parser.add_argument("--stall-check-interval", type=float, default=0.0,
+                        dest="stall_check_interval_s",
+                        help="watchdog re-check cadence in seconds "
+                             "(<=0 derives stall-timeout / 4)")
 
 
 def parse_options(argv: Optional[List[str]] = None) -> ServerOption:

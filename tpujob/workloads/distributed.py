@@ -20,7 +20,8 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
-from typing import Dict, Optional, Tuple
+import time
+from typing import Callable, Dict, Optional, Tuple
 
 log = logging.getLogger("tpujob.workloads")
 
@@ -486,6 +487,120 @@ def reinitialize(pe: ProcessEnv, num_processes: int,
             f"{new.num_processes}: a drained process must exit, not rejoin")
     shutdown()
     return initialize(new)
+
+
+# ---------------------------------------------------------------------------
+# Progress heartbeats: the workload -> controller telemetry channel
+# ---------------------------------------------------------------------------
+#
+# The reverse direction of the world-size channel above: the coordinator
+# process publishes a compact `tpujob.dev/progress` record (step, smoothed
+# samples/sec, last checkpoint step, resize epoch — tpujob.api.progress) on
+# its OWN pod annotation, rate-limited and merge-patched so it composes with
+# every other annotation writer and never amplifies the API write path.  The
+# controller ingests it from its informer cache into the tpujob_job_* metric
+# families and the Stalled-job watchdog.
+
+# Pod self-identity env (downward-API fieldRef convention): names the pod
+# whose annotation the reporter patches.  Absent = not running under the
+# operator; the reporter then stays disabled.
+POD_NAME_ENV = "TPUJOB_POD_NAME"
+POD_NAMESPACE_ENV = "TPUJOB_POD_NAMESPACE"
+
+
+class ProgressReporter:
+    """Rate-limited publisher of the progress heartbeat.
+
+    ``publish(value)`` ships one annotation value (a merge patch of this
+    pod's ``tpujob.dev/progress`` key) and may raise on transport failure —
+    failures are swallowed with a rate-limited warning, because telemetry
+    must never take training down.  ``interval_s`` bounds the publish rate:
+    a 10 ms step loop heartbeats every few seconds, not every step.
+    """
+
+    def __init__(self, publish: Optional[Callable[[str], None]],
+                 interval_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.publish = publish
+        self.interval_s = interval_s
+        self._clock = clock
+        self._last_pub: Optional[float] = None
+        self._last_warn: Optional[float] = None
+        self.published = 0  # successful publishes (test/debug visibility)
+
+    @property
+    def enabled(self) -> bool:
+        return self.publish is not None
+
+    def report(self, step: int, samples_per_sec: Optional[float] = None,
+               checkpoint_step: Optional[int] = None,
+               resize_generation: int = 0, force: bool = False) -> bool:
+        """Publish one heartbeat unless rate-limited; True when it shipped.
+        ``force`` bypasses the interval (checkpoint saves, loop exit)."""
+        if self.publish is None:
+            return False
+        now = self._clock()
+        if (not force and self._last_pub is not None
+                and now - self._last_pub < self.interval_s):
+            return False
+        from tpujob.api.progress import format_progress
+
+        value = format_progress(
+            step, samples_per_sec=samples_per_sec,
+            checkpoint_step=checkpoint_step,
+            resize_generation=resize_generation,
+            published_at=time.time(),
+        )
+        # stamp BEFORE the attempt: a failing transport must not turn every
+        # step into a publish attempt (the rate limit covers failures too)
+        self._last_pub = now
+        try:
+            self.publish(value)
+        except Exception as e:  # noqa: TPL005 - telemetry is best-effort;
+            # a dead transport must not kill training (warned, rate-limited)
+            if self._last_warn is None or now - self._last_warn >= 60.0:
+                self._last_warn = now
+                log.warning("progress heartbeat publish failed: %s", e)
+            return False
+        self.published += 1
+        return True
+
+
+def pod_progress_patch(value: str) -> Dict[str, Dict[str, Dict[str, str]]]:
+    """The merge-patch body publishing one heartbeat on a pod: patching only
+    this one annotation key composes with concurrent metadata writers (the
+    controller's world-size publications, adoption owner-refs)."""
+    from tpujob.api import constants as c
+
+    return {"metadata": {"annotations": {c.ANNOTATION_PROGRESS: value}}}
+
+
+def progress_publisher_from_env(
+    env: Optional[Dict[str, str]] = None,
+) -> Optional[Callable[[str], None]]:
+    """Build a publish callable for the conventional in-cluster setup: the
+    pod patches its own annotation through the cluster apiserver, using the
+    downward-API-injected pod identity (TPUJOB_POD_NAME / _NAMESPACE).
+    Returns None — reporter disabled — when the identity or a cluster
+    config is absent (local runs, dryruns, tests)."""
+    e = dict(os.environ) if env is None else env
+    pod = e.get(POD_NAME_ENV)
+    if not pod:
+        return None
+    namespace = e.get(POD_NAMESPACE_ENV) or "default"
+    try:
+        from tpujob.kube.kubetransport import KubeApiTransport, KubeConfig
+
+        transport = KubeApiTransport(KubeConfig.load())
+    except Exception as e_cfg:  # noqa: TPL005 - no cluster config is the
+        # normal local-run case, not an error worth crashing a workload over
+        log.info("progress heartbeats disabled (no cluster config): %s", e_cfg)
+        return None
+
+    def publish(value: str) -> None:
+        transport.patch("pods", namespace, pod, pod_progress_patch(value))
+
+    return publish
 
 
 def shard_map_supports_partial_manual() -> bool:

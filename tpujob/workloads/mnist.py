@@ -64,7 +64,7 @@ def eval_metrics(params: Any, batch):
 
 
 def train_epoch(args, state, train_step, mesh, train_x, train_y, epoch, writer, pe,
-                profiler=None):
+                profiler=None, telemetry=None):
     n = len(train_x) - len(train_x) % args.batch_size
     steps_per_epoch = n // args.batch_size
     # every host iterates the same global batch order (same seed) and feeds
@@ -83,6 +83,11 @@ def train_epoch(args, state, train_step, mesh, train_x, train_y, epoch, writer, 
             state, train_lib.put_batch((bx[lo : lo + sz], by[lo : lo + sz]), mesh)
         )
         prev_loss = loss
+        if telemetry is not None:
+            # throughput EMA + the operator-facing progress heartbeat
+            # (rate-limited inside the reporter; a no-op locally)
+            telemetry.step((epoch - 1) * steps_per_epoch + batch_idx + 1,
+                           samples=args.batch_size)
         if batch_idx % args.log_interval == 0:
             loss_v = float(loss)
             print(
@@ -181,12 +186,13 @@ def run(args, mesh=None) -> Dict[str, Any]:
 
     accuracy, last_loss = 0.0, None
     profiler = train_lib.profiler_from_args(args, pe)
+    telemetry = train_lib.TrainTelemetry.from_env()
     t0 = time.perf_counter()
     try:
         for epoch in range(1, args.epochs + 1):
             state, last_loss = train_epoch(
                 args, state, train_step, mesh, train_x, train_y, epoch, writer, pe,
-                profiler=profiler,
+                profiler=profiler, telemetry=telemetry,
             )
             accuracy = test_epoch(
                 args, state, eval_step, mesh, test_x, test_y, epoch, writer, pe
@@ -196,6 +202,7 @@ def run(args, mesh=None) -> Dict[str, Any]:
         wall = time.perf_counter() - t0 - profiler.overhead_s
     finally:
         profiler.close(block_on=state)
+        telemetry.close()
 
     if args.save_model:
         # collective: every process participates in the orbax save (each
@@ -204,6 +211,7 @@ def run(args, mesh=None) -> Dict[str, Any]:
         ckpt = train_lib.Checkpointer(args.dir + "/ckpt")
         ckpt.save(int(state["step"]), state)
         ckpt.close()
+        telemetry.checkpointed(int(state["step"]))
     writer.close()
     return {
         "accuracy": accuracy,

@@ -137,6 +137,81 @@ class Profiler:
             self.overhead_s += time.perf_counter() - t0
 
 
+class TrainTelemetry:
+    """Step-loop telemetry: smoothed samples/sec plus the progress
+    heartbeat (``tpujob.dev/progress``) the operator's telemetry plane and
+    Stalled-job watchdog consume.
+
+    Call :meth:`step` at each loop iteration (after the train step
+    dispatched) and :meth:`checkpointed` after each durable save.  Only the
+    coordinator publishes by default — the controller reads one heartbeat
+    per job, and process 0 is the one whose silence means the job is stuck
+    (a straggling non-coordinator host stalls the collective, which stalls
+    process 0's step clock right along with it).  With no reporter (local
+    runs, tests) this is throughput bookkeeping only.
+    """
+
+    def __init__(self, reporter: Optional["dist.ProgressReporter"] = None,
+                 enabled: Optional[bool] = None, ema: float = 0.3,
+                 clock: Callable[[], float] = time.monotonic):
+        if enabled is None:
+            enabled = dist.process_env().process_id == 0
+        self.reporter = reporter if enabled else None
+        self._ema = ema
+        self._clock = clock
+        self.samples_per_sec: Optional[float] = None
+        self.step_count = 0
+        self.checkpoint_step: Optional[int] = None
+        self.resize_generation = 0
+        self._last_t: Optional[float] = None
+
+    @classmethod
+    def from_env(cls, interval_s: float = 10.0) -> "TrainTelemetry":
+        """The conventional in-cluster construction: coordinator publishes
+        through the pod-identity env (no-op reporter everywhere else)."""
+        pe = dist.process_env()
+        publish = (dist.progress_publisher_from_env()
+                   if pe.process_id == 0 else None)
+        return cls(reporter=dist.ProgressReporter(publish,
+                                                  interval_s=interval_s),
+                   enabled=pe.process_id == 0)
+
+    def step(self, step: int, samples: int = 0,
+             resize_generation: Optional[int] = None) -> None:
+        """One loop iteration: fold ``samples`` into the throughput EMA and
+        heartbeat (rate-limited inside the reporter)."""
+        now = self._clock()
+        if self._last_t is not None and samples > 0:
+            dt = now - self._last_t
+            if dt > 0:
+                inst = samples / dt
+                self.samples_per_sec = (
+                    inst if self.samples_per_sec is None
+                    else self._ema * inst + (1 - self._ema) * self.samples_per_sec)
+        self._last_t = now
+        self.step_count = step
+        if resize_generation is not None:
+            self.resize_generation = resize_generation
+        if self.reporter is not None:
+            self.reporter.report(step, self.samples_per_sec,
+                                 self.checkpoint_step, self.resize_generation)
+
+    def checkpointed(self, step: int) -> None:
+        """A durable checkpoint landed: publish immediately (the watchdog's
+        checkpoint-age metric keys off this)."""
+        self.checkpoint_step = step
+        if self.reporter is not None:
+            self.reporter.report(self.step_count, self.samples_per_sec,
+                                 step, self.resize_generation, force=True)
+
+    def close(self) -> None:
+        """Final forced heartbeat so the controller sees the last step."""
+        if self.reporter is not None and self.step_count:
+            self.reporter.report(self.step_count, self.samples_per_sec,
+                                 self.checkpoint_step,
+                                 self.resize_generation, force=True)
+
+
 def add_profile_flags(parser) -> None:
     """The shared --profile-* surface for every workload CLI."""
     parser.add_argument("--profile-dir", default=None,
