@@ -11,7 +11,7 @@ SHELL := /bin/bash
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
+.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
@@ -88,9 +88,17 @@ read-path-smoke:
 telemetry-smoke:
 	$(PY) scripts/telemetry_smoke.py
 
+# gang-scheduler smoke (~5 s): 2-slice fleet, 3 queued gangs, one
+# preemption — admission order asserted exactly (priority beats FIFO),
+# no gang ever partially admitted (continuous hook), and the preempted
+# victim resumes at its barrier checkpoint with zero counted restarts
+# (docs/failure-handling, "gang admission & preemption")
+sched-smoke:
+	$(PY) scripts/sched_smoke.py
+
 # the tier-1 command from ROADMAP.md, verbatim (modulo $$-escaping for
 # make), so local and CI invocations agree on what "the tests pass" means
-test: lint trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke
+test: lint trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # the operator/controller/kube/api tests only — the model-path suites
@@ -113,9 +121,12 @@ e2e:
 # --crash adds the controller-lifecycle tiers per seed: hard-kill + cold
 # restart schedules, warm-standby failover with write-fencing probes, the
 # sharded-control-plane membership storm (3 controllers, member
-# kill/flap/rejoin, exactly-one-owner-per-generation asserted), and the
+# kill/flap/rejoin, exactly-one-owner-per-generation asserted), the
 # elastic-resize storm (grow/shrink/flap spec.replicas over live jobs +
-# a controller kill; no progress lost past the last checkpoint).
+# a controller kill; no progress lost past the last checkpoint), and the
+# gang-scheduler storm (oversubscribed admission queue + seeded
+# preemption; no gang ever partially admitted, no starvation, every
+# scheduled eviction checkpoint-safe).
 soak:
 	$(PY) soak.py --seeds 1,2,3,4,5 --crash
 
@@ -143,6 +154,7 @@ bench-controller:
 	$(PY) bench_controller.py --jobs 10 --workers 8 --churn 4 --no-suppress --no-coalesce
 	$(PY) bench_controller.py --jobs 10 --workers 8 --watchdog
 	$(PY) bench_controller.py --jobs 24 --workers 4 --controllers 4 --threadiness 2
+	$(PY) bench_controller.py --queue 100 --threadiness 4
 
 # read path at scale: 100k-object cold-start/relist curve — the paged +
 # bookmark run vs the unpaged/bookmark-less control, asserting the >= 5x

@@ -534,6 +534,112 @@ def run_bench(jobs: int, workers: int, threadiness: int, mode: str,
     }
 
 
+def run_queue_bench(jobs: int, threadiness: int, timeout: float,
+                    capacity: str = "v4-16x4",
+                    tick_s: float = 0.01) -> Dict:
+    """Gang-scheduler admission throughput + decision latency.
+
+    N single-host gangs are thrown at a capacity-limited admission queue
+    (default 8 host slots) whose workloads complete instantly — so the
+    whole queue drains through the full admit -> run -> release cycle and
+    the measurement covers the scheduler's real decision loop, not just an
+    empty-fleet fast path.  Reports admissions/sec, per-gang admission
+    wait (create -> assignment committed) p50/p99, and per-tick decision
+    latency p50/p99.
+    """
+    from tpujob.server.scheduler import GangScheduler
+
+    server = InMemoryAPIServer()
+
+    def kubelet(ev_type: str, resource: str, obj: Dict) -> None:
+        # instant-completion kubelet: a pod is born Succeeded, so a gang
+        # admits, completes, and releases its capacity within a few syncs
+        if resource != RESOURCE_PODS or ev_type != ADDED:
+            return
+        meta = obj.get("metadata") or {}
+        server.update_status(RESOURCE_PODS, {
+            "metadata": {"namespace": meta.get("namespace"),
+                         "name": meta.get("name")},
+            "status": {"phase": "Succeeded", "containerStatuses": [
+                {"name": c.DEFAULT_CONTAINER_NAME, "ready": False,
+                 "restartCount": 0,
+                 "state": {"terminated": {"exitCode": 0}}}]},
+        })
+
+    admitted_at: Dict[str, float] = {}
+    adm_lock = threading.Lock()
+
+    def admission_hook(ev_type: str, resource: str, obj: Dict) -> None:
+        if resource != RESOURCE_TPUJOBS:
+            return
+        meta = obj.get("metadata") or {}
+        ann = meta.get("annotations") or {}
+        if ann.get(c.ANNOTATION_SCHED_ASSIGNMENT) is None:
+            return
+        with adm_lock:
+            admitted_at.setdefault(meta.get("name") or "",
+                                   time.perf_counter())
+
+    server.hooks.append(kubelet)
+    server.hooks.append(admission_hook)
+    clients = ClientSet(server)
+    ctrl = TPUJobController(
+        clients,
+        config=ControllerConfig(threadiness=threadiness, resync_period=0.2),
+    )
+    sched = GangScheduler(ctrl, capacity, tick_s=tick_s, aging_s=5.0)
+    ctrl.set_scheduler(sched)
+    stop = threading.Event()
+    threads = ctrl.run(stop, threadiness)
+    threads.append(sched.start(stop))
+
+    names = [f"queue-{i:04d}" for i in range(jobs)]
+    created_at: Dict[str, float] = {}
+    t0 = time.perf_counter()
+    for name in names:
+        d = job_dict(name, 0)
+        # masterless single-host gang: 1 torus-adjacent host slot
+        d["spec"]["tpuReplicaSpecs"] = {
+            c.REPLICA_TYPE_WORKER: {
+                "replicas": 1,
+                "template": d["spec"]["tpuReplicaSpecs"][
+                    c.REPLICA_TYPE_WORKER]["template"]}}
+        created_at[name] = time.perf_counter()
+        server.create(RESOURCE_TPUJOBS, d)
+    deadline = time.monotonic() + timeout
+    pending = set(names)
+    while pending and time.monotonic() < deadline:
+        with adm_lock:
+            pending = {n for n in pending if n not in admitted_at}
+        if pending:
+            time.sleep(0.005)
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    ctrl.factory.stop()
+    if pending:
+        raise TimeoutError(
+            f"{len(pending)}/{jobs} gangs never admitted after "
+            f"{timeout:.0f}s")
+    with adm_lock:
+        waits = sorted(admitted_at[n] - created_at[n] for n in names)
+    ticks = sched.tick_latencies()
+    return {
+        "metric": "scheduler_queue",
+        "jobs": jobs,
+        "capacity": capacity,
+        "threadiness": threadiness,
+        "elapsed_s": round(elapsed, 4),
+        "admissions_per_sec": round(jobs / elapsed, 2),
+        "admission_wait_p50_ms": round(_percentile(waits, 0.50) * 1e3, 3),
+        "admission_wait_p99_ms": round(_percentile(waits, 0.99) * 1e3, 3),
+        "ticks": len(ticks),
+        "tick_p50_ms": round(_percentile(ticks, 0.50) * 1e3, 3),
+        "tick_p99_ms": round(_percentile(ticks, 0.99) * 1e3, 3),
+    }
+
+
 def run_watchdog_bench(jobs: int, workers: int, threadiness: int, mode: str,
                        serial: bool, create_latency: float, timeout: float,
                        background_pods: int = 1000, trace: bool = True,
@@ -956,6 +1062,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--shards", type=int, default=16,
                    help="scale-out mode: virtual job shards the fleet "
                         "splits (must exceed the largest controller count)")
+    p.add_argument("--queue", type=int, default=0, dest="queue_jobs",
+                   help="gang-scheduler mode: push N single-host gangs "
+                        "through a capacity-limited admission queue and "
+                        "report admissions/sec + decision latency")
+    p.add_argument("--queue-capacity", default="v4-16x4",
+                   dest="queue_capacity",
+                   help="modeled fleet for --queue (default v4-16x4 = 8 "
+                        "host slots)")
     p.add_argument("--watchdog", action="store_true",
                    help="telemetry-overhead mode: run the heartbeat-"
                         "annotated bring-up twice (telemetry off, then "
@@ -999,6 +1113,17 @@ def _run_cli(args, lock_graph) -> int:
                 shard_count=args.shards, threadiness=args.threadiness,
                 create_latency=args.create_latency,
                 background_pods=args.background_pods, timeout=args.timeout)
+        except (TimeoutError, AssertionError, ValueError) as e:
+            print(f"FAIL: {e}", file=sys.stderr)
+            return 1
+        rc = _lock_verdict(result)
+        print(json.dumps(result))
+        return rc
+    if args.queue_jobs > 0:
+        try:
+            result = run_queue_bench(
+                args.queue_jobs, args.threadiness, args.timeout,
+                capacity=args.queue_capacity)
         except (TimeoutError, AssertionError, ValueError) as e:
             print(f"FAIL: {e}", file=sys.stderr)
             return 1

@@ -2024,14 +2024,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(description="one seeded chaos soak run")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--mode",
-                        choices=("api", "crash", "failover", "shard", "resize"),
+                        choices=("api", "crash", "failover", "shard",
+                                 "resize", "sched"),
                         default="api",
                         help="api = transport faults only; crash = + seeded "
                              "controller kills; failover = warm-standby "
                              "leader kill + fencing probes; shard = N-member "
                              "sharded fleet under a membership storm; "
                              "resize = seeded elastic-resize storms over "
-                             "live jobs + faults + a controller kill")
+                             "live jobs + faults + a controller kill; "
+                             "sched = oversubscribed gang-admission queue + "
+                             "seeded preemption + faults + a controller kill")
     parser.add_argument("--storm-kills", type=int, default=6)
     parser.add_argument("--timeout", type=float, default=60.0)
     parser.add_argument("--verbose", action="store_true")
@@ -2052,6 +2055,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif args.mode == "resize":
         report = run_resize_soak(args.seed, storm_kills=args.storm_kills,
                                  timeout=args.timeout)
+    elif args.mode == "sched":
+        # imported here: e2e.scheduler imports this module at load time
+        from e2e.scheduler import run_sched_soak
+
+        report = run_sched_soak(args.seed, timeout=args.timeout)
     else:
         report = run_soak(args.seed, storm_kills=args.storm_kills,
                           timeout=args.timeout)

@@ -1,0 +1,804 @@
+"""Gang-scheduler chaos tier: oversubscribed queue, preemption, no partial gangs.
+
+The scheduler soak (``--mode sched`` / ``make soak``) drives an
+OVERSUBSCRIBED admission queue — three namespaces' worth of gangs against a
+2-slice modeled fleet — under the full API fault schedule, a seeded kubelet
+preemption storm, and controller hard-kills, with the progress watchdog
+armed.  Every pod runs a real checkpointing trainer loop through the
+kubelet exec seam, publishing PR-10 heartbeats and answering the
+scheduler's preemption barrier the way a production container would
+(checkpoint, ack, exit when the pod dies).
+
+Invariants, on top of the standard chaos set:
+
+13. **no gang is ever partially admitted at any instant** — every committed
+    ``sched-assignment`` covers the job's WHOLE request (slices x
+    torus-adjacent hosts), never overlaps another live assignment, and
+    never exceeds the modeled capacity (:class:`AdmissionTracker`, a
+    committed-stream hook — the end state alone would miss a transient
+    partial grant that healed);
+14. **no starvation past fair share + aging** — every queued gang is
+    admitted (and runs to Succeeded) within the run; the queue is empty at
+    convergence;
+15. **scheduled preemption is checkpoint-safe** — a preempted workload's
+    restore lands exactly on its barrier checkpoint (the ElasticLedger
+    stance: nothing is ever lost past the last checkpoint, and a SCHEDULED
+    eviction — unlike a storm kill — loses nothing at all).
+
+``run_sched_smoke`` is the fast tier-1 gate (``make sched-smoke``): 2-slice
+capacity, 3 queued gangs, one preemption, asserting admission order,
+all-or-nothing, and checkpoint-safe eviction in seconds.
+
+Runnable:  python -m e2e.chaos --seed 7 --mode sched
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from e2e.chaos import (
+    JobCase,
+    PreemptionStorm,
+    StallTracker,
+    _all_converged,
+    _converge_or_fail,
+    _job,
+    _lock_audit_report,
+    _settle_invariants,
+    _soak_harness,
+    _start_app,
+    _tmpl,
+    _wait_for,
+    check_trace_ledger,
+)
+from e2e.kubelet import KubeletSim, PodScript
+from tpujob.analysis import lockgraph
+from tpujob.api import constants as c
+from tpujob.api.quota import gang_request, parse_capacity
+from tpujob.api.types import TPUJob
+from tpujob.controller import status as st
+from tpujob.kube.chaos import ChaosConfig
+from tpujob.kube.client import RESOURCE_PODS, RESOURCE_TPUJOBS, ClientSet
+from tpujob.kube.errors import ApiError, NotFoundError
+from tpujob.obs.trace import TRACER
+from tpujob.server.scheduler import Assignment
+from tpujob.workloads.distributed import ProgressReporter, pod_progress_patch
+
+SCHED_CAPACITY = "v4-16x2"  # 2 slices x 2 hosts = 4 host slots
+SCHED_SOAK_STEPS = 30
+
+
+# ---------------------------------------------------------------------------
+# the workload half: a checkpointing trainer that answers preemption
+# ---------------------------------------------------------------------------
+
+
+class SchedLedger:
+    """One gang's durable training truth under scheduled preemption.
+
+    ``progress`` models device-memory step state, ``checkpoint`` the last
+    persisted step.  A preemption barrier checkpoints NOW and records the
+    barrier step; the restore after re-admission must land exactly there —
+    a scheduled eviction loses nothing (storm kills may lose up to the
+    checkpoint interval, but never anything PAST the checkpoint).
+    """
+
+    def __init__(self, job: str):
+        self.job = job
+        self._lock = lockgraph.new_lock(f"sched-ledger-{job}")
+        self.progress = 0  # guarded by self._lock
+        self.checkpoint = 0  # guarded by self._lock
+        self.paused = False  # guarded by self._lock; preempt barrier hit
+        self.done = False  # guarded by self._lock
+        self.barriers: List[int] = []  # guarded by self._lock; acked steps
+        self.restores: List[Tuple[int, int]] = []  # guarded by self._lock
+        self.violations: List[str] = []  # guarded by self._lock
+
+    def step(self, total_steps: int, may_finish: bool) -> bool:
+        with self._lock:
+            if self.done:
+                return False
+            if self.paused:
+                return True
+            self.progress += 1
+            if may_finish and self.progress >= total_steps:
+                self.done = True
+            return not self.done
+
+    def periodic_checkpoint(self, every: int) -> None:
+        with self._lock:
+            if not self.paused and self.progress - self.checkpoint >= every:
+                self.checkpoint = self.progress
+
+    def barrier(self) -> int:
+        """Preemption pending: checkpoint NOW and pause stepping.  Returns
+        the step the coordinator acks."""
+        with self._lock:
+            if self.progress < self.checkpoint:
+                self.violations.append(
+                    f"{self.job}: progress {self.progress} below checkpoint "
+                    f"{self.checkpoint} at the barrier")
+            self.checkpoint = max(self.checkpoint, self.progress)
+            self.paused = True
+            if not self.barriers or self.barriers[-1] != self.checkpoint:
+                self.barriers.append(self.checkpoint)
+            return self.checkpoint
+
+    def resume(self) -> None:
+        with self._lock:
+            self.paused = False
+
+    def crash_restore(self) -> None:
+        """A recreated coordinator pod (post-eviction re-admission, or a
+        storm kill): device state died, restore from the checkpoint."""
+        with self._lock:
+            before = self.progress
+            restored = self.checkpoint
+            if restored > before:
+                self.violations.append(
+                    f"{self.job}: restore ahead of progress "
+                    f"{before} -> {restored}")
+            if self.barriers and restored < self.barriers[-1]:
+                self.violations.append(
+                    f"{self.job}: scheduled eviction lost progress past the "
+                    f"barrier checkpoint ({self.barriers[-1]} -> {restored})")
+            self.progress = restored
+            self.paused = False
+            self.restores.append((before, restored))
+
+    def is_done(self) -> bool:
+        with self._lock:
+            return self.done
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "progress": self.progress,
+                "checkpoint": self.checkpoint,
+                "done": self.done,
+                "barriers": list(self.barriers),
+                "restores": list(self.restores),
+                "violations": list(self.violations),
+            }
+
+
+class SchedWorkload:
+    """PodScript factory for one gang: every replica runs the trainer loop
+    against the job's published annotations; the coordinator publishes real
+    PR-10 heartbeats and acks the preemption barrier."""
+
+    def __init__(
+        self,
+        admin: ClientSet,
+        job_name: str,
+        total_steps: int = SCHED_SOAK_STEPS,
+        checkpoint_every: int = 5,
+        tick_s: float = 0.01,
+        has_master: bool = False,
+        namespace: str = "default",
+        stop_event: Optional[threading.Event] = None,
+        finish_gate: Optional[threading.Event] = None,
+        heartbeat_interval_s: float = 0.1,
+    ):
+        self.admin = admin
+        self.job_name = job_name
+        self.ns = namespace
+        self.total_steps = total_steps
+        self.checkpoint_every = checkpoint_every
+        self.tick_s = tick_s
+        self.has_master = has_master
+        self.stop_event = stop_event or threading.Event()
+        self.finish_gate = finish_gate or threading.Event()
+        if finish_gate is None:
+            self.finish_gate.set()
+        self.ledger = SchedLedger(job_name)
+        self.acked = 0  # barrier acks written (informational)
+        self.heartbeat_interval_s = heartbeat_interval_s
+
+    def _annotations(self) -> Optional[Dict[str, str]]:
+        try:
+            job = self.admin.tpujobs.get(self.ns, self.job_name)
+        except ApiError:
+            return None
+        return dict(job.metadata.annotations or {})
+
+    def _pod_alive(self, pod_name: str) -> bool:
+        try:
+            self.admin.pods.get(self.ns, pod_name)
+            return True
+        except NotFoundError:
+            return False
+        except ApiError:
+            return True
+
+    def _ack(self, annotations: Dict[str, str]) -> None:
+        if annotations.get(c.ANNOTATION_PREEMPT_ACK) is not None:
+            return
+        try:
+            self.admin.server.patch(
+                RESOURCE_TPUJOBS, self.ns, self.job_name,
+                {"metadata": {"annotations": {
+                    c.ANNOTATION_PREEMPT_ACK: "1"}}})
+            self.acked += 1
+        except ApiError:
+            pass  # retried next tick
+
+    def _reporter(self, pod_name: str) -> ProgressReporter:
+        def publish(value: str) -> None:
+            self.admin.server.patch(RESOURCE_PODS, self.ns, pod_name,
+                                    pod_progress_patch(value))
+
+        return ProgressReporter(publish, interval_s=self.heartbeat_interval_s)
+
+    def _run(self, pod_name: str, pid: int, attempt: int) -> int:
+        led = self.ledger
+        reporter = (self._reporter(pod_name) if pid == 0
+                    and self.heartbeat_interval_s > 0 else None)
+        if attempt > 0 and pid == 0:
+            led.crash_restore()
+        alive_check = 0
+        while not self.stop_event.is_set():
+            if led.is_done():
+                return 0
+            annotations = self._annotations()
+            if annotations is None:
+                time.sleep(self.tick_s)
+                continue
+            if annotations.get(c.ANNOTATION_PREEMPT_TARGET) is not None:
+                # the scheduler published the preemption target: hit the
+                # checkpoint barrier and (coordinator) ack the eviction
+                led.barrier()
+                if pid == 0:
+                    self._ack(annotations)
+            elif annotations.get(c.ANNOTATION_SCHED_EVICTED) is not None:
+                led.barrier()  # stay paused: the pod is about to die
+            else:
+                led.resume()
+                if pid == 0:
+                    if not led.step(self.total_steps,
+                                    self.finish_gate.is_set()):
+                        return 0
+                    led.periodic_checkpoint(self.checkpoint_every)
+            if reporter is not None:
+                snap = led.snapshot()
+                reporter.report(
+                    snap["progress"],
+                    samples_per_sec=1.0 / max(self.tick_s, 1e-6),
+                    checkpoint_step=snap["checkpoint"])
+            alive_check += 1
+            if alive_check % 5 == 0 and not self._pod_alive(pod_name):
+                return 0
+            time.sleep(self.tick_s)
+        return 0
+
+    def scripts(self, max_workers: int = 6) -> List[PodScript]:
+        out: List[PodScript] = []
+
+        def make(pod_name: str, pid: int) -> Callable[[int], int]:
+            return lambda attempt: self._run(pod_name, pid, attempt)
+
+        if self.has_master:
+            name = f"{self.job_name}-master-0"
+            out.append(PodScript(match=name, exec_fn=make(name, 0)))
+        for i in range(max_workers):
+            pid = i + 1 if self.has_master else i
+            name = f"{self.job_name}-worker-{i}"
+            out.append(PodScript(match=name, exec_fn=make(name, pid)))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the all-or-nothing admission invariant (committed-stream hook)
+# ---------------------------------------------------------------------------
+
+
+class AdmissionTracker:
+    """Watches every committed TPUJob write and enforces, at EVERY instant:
+
+    - an assignment always covers the job's WHOLE gang request (slices x
+      hosts-per-slice) — a partial grant is the headline violation;
+    - no two live assignments overlap a single host, and none exceeds the
+      modeled capacity;
+    - admission order / preemptions / evictions are recorded for the
+      smoke's determinism assertions and the soak's starvation check.
+    """
+
+    def __init__(self, capacity: str = SCHED_CAPACITY):
+        self.pools = parse_capacity(capacity)
+        self._lock = lockgraph.new_lock("admission-tracker")
+        # key -> raw assignment string currently live
+        self._live: Dict[str, str] = {}  # guarded by self._lock
+        # (pool, slice) -> [(lo, hi, key)]
+        self._used: Dict[Tuple[int, int], List[Tuple[int, int, str]]] = {}  # guarded by self._lock
+        self.admission_order: List[str] = []  # guarded by self._lock
+        self.preempted: List[str] = []  # guarded by self._lock
+        self.evicted: List[str] = []  # guarded by self._lock
+        self.violations: List[str] = []  # guarded by self._lock
+
+    def _release(self, key: str) -> None:  # caller holds self._lock
+        self._live.pop(key, None)
+        for slot, ivals in list(self._used.items()):
+            kept = [iv for iv in ivals if iv[2] != key]
+            if kept:
+                self._used[slot] = kept
+            else:
+                self._used.pop(slot, None)
+
+    def _check_and_book(self, key: str, obj: Dict[str, Any],
+                        raw: str) -> None:  # caller holds self._lock
+        asg = Assignment.from_json(raw)
+        if asg is None:
+            self.violations.append(f"{key}: unparseable assignment {raw!r}")
+            return
+        try:
+            job = TPUJob.from_dict(obj)
+            req = gang_request(job)
+        except Exception:  # noqa: TPL005 - a job mutated into garbage
+            req = None  # mid-run is another invariant's problem
+        if req is not None:
+            if len(asg.slices) != req.num_slices or any(
+                    s.host_hi - s.host_lo != req.hosts_per_slice
+                    for s in asg.slices):
+                self.violations.append(
+                    f"{key}: PARTIAL admission: granted "
+                    f"{[(s.slice_index, s.host_lo, s.host_hi) for s in asg.slices]}"
+                    f" for a {req.num_slices}x{req.hosts_per_slice}-host gang")
+        for s in asg.slices:
+            if s.pool >= len(self.pools) \
+                    or s.slice_index >= self.pools[s.pool].count \
+                    or s.host_hi > self.pools[s.pool].shape.hosts:
+                self.violations.append(
+                    f"{key}: assignment beyond modeled capacity: {s}")
+                continue
+            ivals = self._used.setdefault((s.pool, s.slice_index), [])
+            for lo, hi, other in ivals:
+                if s.host_lo < hi and lo < s.host_hi:
+                    self.violations.append(
+                        f"{key}: hosts [{s.host_lo},{s.host_hi}) of slice "
+                        f"({s.pool},{s.slice_index}) overlap {other} "
+                        f"[{lo},{hi}) — double-booked capacity")
+            ivals.append((s.host_lo, s.host_hi, key))
+        self._live[key] = raw
+        self.admission_order.append(key)
+
+    def hook(self, ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
+        if resource == RESOURCE_PODS:
+            # the other half of all-or-nothing, continuously: a pod may
+            # only ever be BORN to a gang holding a live assignment — a
+            # queued (or released) gang holds zero pods at every instant
+            if ev_type != "ADDED":
+                return
+            meta = obj.get("metadata") or {}
+            labels = meta.get("labels") or {}
+            job_name = labels.get(c.LABEL_JOB_NAME)
+            if not job_name:
+                return
+            key = f"{meta.get('namespace') or 'default'}/{job_name}"
+            with self._lock:
+                if key not in self._live:
+                    self.violations.append(
+                        f"{key}: pod {meta.get('name')} created while the "
+                        "gang holds no assignment (partial/ghost admission)")
+            return
+        if resource != RESOURCE_TPUJOBS:
+            return
+        meta = obj.get("metadata") or {}
+        key = f"{meta.get('namespace') or 'default'}/{meta.get('name')}"
+        ann = meta.get("annotations") or {}
+        conds = ((obj.get("status") or {}).get("conditions")) or []
+        terminal = any(cond.get("status") == "True"
+                       and cond.get("type") in (c.JOB_SUCCEEDED, c.JOB_FAILED)
+                       for cond in conds)
+        raw = ann.get(c.ANNOTATION_SCHED_ASSIGNMENT)
+        with self._lock:
+            if ev_type == "DELETED" or terminal or raw is None:
+                self._release(key)
+            elif self._live.get(key) != raw:
+                self._release(key)
+                self._check_and_book(key, obj, raw)
+            if ann.get(c.ANNOTATION_PREEMPT_TARGET) is not None \
+                    and key not in self.preempted:
+                self.preempted.append(key)
+            if ann.get(c.ANNOTATION_SCHED_EVICTED) is not None \
+                    and key not in self.evicted:
+                self.evicted.append(key)
+
+    def problems(self) -> List[str]:
+        with self._lock:
+            return list(self.violations)
+
+    def order(self) -> List[str]:
+        with self._lock:
+            return list(self.admission_order)
+
+
+# ---------------------------------------------------------------------------
+# the soak
+# ---------------------------------------------------------------------------
+
+
+def _sched_matrix(prefix: str, admin: ClientSet, stop_event: threading.Event,
+                  finish_gate: threading.Event,
+                  ) -> Tuple[List[JobCase], Dict[str, SchedWorkload]]:
+    """Three namespaces' worth of gangs vs a 2-slice fleet (4 host slots,
+    ~11 hosts demanded): whole-fleet multislice, single-slice pinned,
+    unpinned sub-slice, and a master'd gang, across the priority tiers —
+    oversubscribed ~3x so admission order, fair share, aging and
+    preemption all genuinely decide."""
+    shapes = [
+        # (suffix, priority, master, workers, tpu dict)
+        ("a1", "", None, 2, {"accelerator": "v4-16"}),
+        ("a2", "low", None, 2, {"accelerator": "v4-16"}),
+        ("b1", "high", None, 4, {"accelerator": "v4-16", "numSlices": 2}),
+        ("b2", "", None, 1, None),  # unpinned sub-slice
+        ("g1", "low", None, 1, None),
+        ("m1", "", 1, 1, {"accelerator": "v4-16"}),
+    ]
+    cases: List[JobCase] = []
+    workloads: Dict[str, SchedWorkload] = {}
+    for suffix, priority, master, workers, tpu in shapes:
+        name = f"{prefix}-{suffix}"
+        spec: Dict[str, Any] = {
+            "runPolicy": {"backoffLimit": 60},
+            "tpuReplicaSpecs": {
+                "Worker": {"replicas": workers,
+                           "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                           "template": _tmpl()},
+            },
+        }
+        if master:
+            spec["tpuReplicaSpecs"]["Master"] = {
+                "replicas": 1, "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                "template": _tmpl()}
+        if tpu:
+            owner = "Master" if master else "Worker"
+            spec["tpuReplicaSpecs"][owner]["tpu"] = tpu
+        if priority:
+            spec["runPolicy"]["schedulingPolicy"] = {
+                "priorityClass": priority}
+        wl = SchedWorkload(admin, name, has_master=bool(master),
+                           stop_event=stop_event, finish_gate=finish_gate)
+        cases.append(JobCase(job=_job(name, spec), scripts=wl.scripts(),
+                             expect_terminal="Succeeded"))
+        workloads[name] = wl
+    return cases, workloads
+
+
+SCHED_OPT_OVERRIDES = dict(
+    scheduler_capacity=SCHED_CAPACITY,
+    scheduler_tick_s=0.05,
+    scheduler_aging_s=1.0,
+    scheduler_preempt_grace_s=1.0,
+    stall_timeout_s=5.0,
+    stall_check_interval_s=0.5,
+)
+
+
+def _sched_job_problems(admin: ClientSet,
+                        workloads: Dict[str, SchedWorkload],
+                        admissions: AdmissionTracker) -> List[str]:
+    """The scheduler tier's extra invariants (13-15 in the module doc)."""
+    problems: List[str] = admissions.problems()
+    order = admissions.order()
+    for name, wl in sorted(workloads.items()):
+        snap = wl.ledger.snapshot()
+        problems.extend(snap["violations"])
+        key = f"default/{name}"
+        if key not in order:
+            problems.append(f"{name}: NEVER admitted (starved)")
+        if not snap["done"]:
+            # NOT snap["progress"]: a storm kill racing completion can
+            # legitimately regress the post-restore progress reading below
+            # total_steps after done already latched (the recreated pod
+            # restores the last checkpoint, sees done, and exits) — done
+            # is the proof the full step count was executed
+            problems.append(
+                f"{name}: trained only {snap['progress']}/{wl.total_steps} "
+                "steps")
+        try:
+            job = admin.tpujobs.get("default", name)
+        except NotFoundError:
+            problems.append(f"{name}: job vanished")
+            continue
+        ann = job.metadata.annotations or {}
+        for a in (c.ANNOTATION_PREEMPT_TARGET, c.ANNOTATION_SCHED_EVICTED):
+            if ann.get(a) is not None:
+                problems.append(f"{name}: {a} never cleared")
+        queued = st.get_condition(job.status, c.JOB_QUEUED)
+        if queued is not None and queued.status == "True":
+            problems.append(f"{name}: still Queued after convergence")
+    return problems
+
+
+def run_sched_soak(
+    seed: int,
+    config: Optional[ChaosConfig] = None,
+    kills: int = 1,
+    storm_kills: int = 2,
+    timeout: float = 120.0,
+    opt_overrides: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Scheduler soak: the oversubscribed gang matrix under the full API
+    fault schedule + a seeded kubelet preemption storm + controller
+    hard-kills, watchdog armed.  Invariants: the standard chaos set, plus
+    no gang partially admitted at any instant, no assignment overlap, no
+    starvation (every gang admitted and Succeeded, queue drained), every
+    scheduled eviction checkpoint-safe, and zero false Stalled flips.
+
+    Runs under the lock-order sentinel (see ``run_soak``)."""
+    with lockgraph.audit():
+        report = _run_sched_soak_inner(seed, config, kills, storm_kills,
+                                       timeout, opt_overrides)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_sched_soak_inner(
+    seed: int,
+    config: Optional[ChaosConfig],
+    kills: int,
+    storm_kills: int,
+    timeout: float,
+    opt_overrides: Optional[Dict[str, Any]],
+) -> Dict[str, Any]:
+    trainer_stop = threading.Event()
+    finish_gate = threading.Event()
+    finish_gate.set()  # sched jobs complete freely: completions ARE the
+    # capacity churn an admission queue schedules around
+    prefix, _, inner, chaos, admin, tracker, _ = _soak_harness(
+        seed, "g", config, cases=[])
+    cases, workloads = _sched_matrix(prefix, admin, trainer_stop, finish_gate)
+    admissions = AdmissionTracker(SCHED_CAPACITY)
+    inner.hooks.append(admissions.hook)
+    stall_tracker = StallTracker()
+    inner.hooks.append(stall_tracker.hook)
+    scripts = [s for case in cases for s in case.scripts]
+    rng = random.Random(f"{seed}:sched-kill")
+    started = time.monotonic()
+    trace_started0, trace_closed0 = TRACER.counters()
+
+    overrides = {**SCHED_OPT_OVERRIDES, **(opt_overrides or {})}
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts)
+    app = _start_app(chaos, overrides)
+    kubelet.start()
+    storm = PreemptionStorm(admin, seed, kills=storm_kills,
+                            prefix=prefix).start()
+    kill_log: List[Dict[str, float]] = []
+    try:
+        # staggered submission: the low/normal gangs soak the fleet first,
+        # then the whole-fleet high-tier gang arrives — admission pressure
+        # that can ONLY resolve through preemption
+        for case in cases:
+            if not case.job.metadata.name.endswith("-b1"):
+                admin.tpujobs.create(case.job)
+        time.sleep(rng.uniform(0.4, 0.8))
+        big = next(case for case in cases
+                   if case.job.metadata.name.endswith("-b1"))
+        admin.tpujobs.create(big.job)
+        for _ in range(kills):
+            # seeded mid-flight hard kill: an admission, preemption
+            # barrier, or eviction may be mid-protocol — the restarted
+            # scheduler must resume it from the committed annotations
+            time.sleep(rng.uniform(0.6, 1.2))
+            app.hard_kill()
+            headless_s = rng.uniform(0.05, 0.4)
+            time.sleep(headless_s)
+            app = _start_app(chaos, overrides)
+            kill_log.append({"headless_s": round(headless_s, 3)})
+        deadline = started + timeout
+        _converge_or_fail(admin, cases, deadline, seed, f" within {timeout}s")
+        storm.stop()
+        problems = _settle_invariants(admin, app.controller, cases, tracker,
+                                      chaos, deadline)
+        problems += _sched_job_problems(admin, workloads, admissions)
+        problems += stall_tracker.problems()
+        if problems:
+            raise AssertionError(
+                f"seed {seed}: scheduler invariants violated:\n  "
+                + "\n  ".join(problems))
+        report = {
+            "mode": "sched",
+            "seed": seed,
+            "jobs": len(cases),
+            "controller_kills": kills,
+            "kill_schedule": kill_log,
+            "admissions": len(admissions.order()),
+            "preempted": sorted(admissions.preempted),
+            "ledgers": {n: {k: v for k, v in wl.ledger.snapshot().items()
+                            if k != "violations"}
+                        for n, wl in sorted(workloads.items())},
+            "duration_s": round(time.monotonic() - started, 3),
+            "api_faults": len(chaos.injected),
+            "storm_strikes": storm.struck,
+            "invariants": "ok",
+        }
+    finally:
+        trainer_stop.set()
+        finish_gate.set()
+        storm.stop()
+        kubelet.stop()
+        app.shutdown()
+    # controller incarnations died mid-run by design: only the process-wide
+    # root-span ledger must balance (the crash-soak rule)
+    trace_problems, trace_stats = check_trace_ledger(trace_started0,
+                                                     trace_closed0)
+    if trace_problems:
+        raise AssertionError(
+            f"seed {seed}: trace ledger violated across the sched soak:\n  "
+            + "\n  ".join(trace_problems))
+    report["trace"] = trace_stats
+    return report
+
+
+# ---------------------------------------------------------------------------
+# the smoke (tier-1 gate)
+# ---------------------------------------------------------------------------
+
+
+def run_sched_smoke(seed: int = 13, timeout: float = 20.0) -> Dict[str, Any]:
+    """The fast scheduler acceptance gate (``make sched-smoke``): 2-slice
+    capacity, 3 queued gangs, one preemption — asserting admission ORDER
+    (priority beats FIFO), all-or-nothing (a queued gang holds ZERO pods
+    at every instant), and checkpoint-safe eviction (the victim resumes
+    exactly at its barrier checkpoint and still trains to Succeeded).
+
+    Runs under the lock-order sentinel (see ``run_soak``)."""
+    with lockgraph.audit():
+        report = _run_sched_smoke_inner(seed, timeout)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_sched_smoke_inner(seed: int, timeout: float) -> Dict[str, Any]:
+    no_faults = ChaosConfig(
+        error_rate=0.0, timeout_rate=0.0, conflict_rate=0.0, latency_rate=0.0,
+        kill_watch_every=0, compact_every=0, duplicate_event_rate=0.0,
+    )
+    trainer_stop = threading.Event()
+    low_gate = threading.Event()  # holds the victim alive until preempted
+    prefix, _, inner, chaos, admin, tracker, _ = _soak_harness(
+        seed, "q", no_faults, cases=[])
+    admissions = AdmissionTracker(SCHED_CAPACITY)
+    inner.hooks.append(admissions.hook)
+    stall_tracker = StallTracker()
+    inner.hooks.append(stall_tracker.hook)
+
+    def gang(name: str, workers: int, num_slices: int,
+             priority: str, wl: SchedWorkload) -> JobCase:
+        spec: Dict[str, Any] = {
+            "runPolicy": {"backoffLimit": 10},
+            "tpuReplicaSpecs": {"Worker": {
+                "replicas": workers,
+                "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                "tpu": {"accelerator": "v4-16", "numSlices": num_slices},
+                "template": _tmpl()}},
+        }
+        if priority:
+            spec["runPolicy"]["schedulingPolicy"] = {
+                "priorityClass": priority}
+        return JobCase(job=_job(name, spec), scripts=wl.scripts(),
+                       expect_terminal="Succeeded")
+
+    low_name = f"{prefix}-low"
+    mid_name = f"{prefix}-mid"
+    hi_name = f"{prefix}-hi"
+    wl_low = SchedWorkload(admin, low_name, total_steps=20,
+                           stop_event=trainer_stop, finish_gate=low_gate)
+    wl_mid = SchedWorkload(admin, mid_name, total_steps=15,
+                           stop_event=trainer_stop)
+    wl_hi = SchedWorkload(admin, hi_name, total_steps=15,
+                          stop_event=trainer_stop)
+    cases = [
+        gang(low_name, 4, 2, "low", wl_low),  # whole fleet
+        gang(mid_name, 2, 1, "", wl_mid),
+        gang(hi_name, 2, 1, "high", wl_hi),
+    ]
+    started = time.monotonic()
+    deadline = started + timeout
+
+    def _wait(pred, what: str) -> None:
+        if not _wait_for(pred, max(0.1, deadline - time.monotonic())):
+            raise AssertionError(f"sched smoke: timed out waiting for {what}")
+
+    def _pods_of(name: str) -> List[str]:
+        return sorted(p.metadata.name for p in admin.pods.list()
+                      if p.metadata.labels.get(c.LABEL_JOB_NAME) == name)
+
+    scripts = [s for case in cases for s in case.scripts]
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts)
+    # aging long so the test's order is pure tier order; the watchdog armed
+    # (a queued gang must never flip Stalled)
+    app = _start_app(chaos, {**SCHED_OPT_OVERRIDES,
+                             "scheduler_aging_s": 30.0,
+                             "scheduler_preempt_grace_s": 5.0,
+                             "stall_timeout_s": 2.0,
+                             "stall_check_interval_s": 0.2})
+    kubelet.start()
+    try:
+        # 1. the low-tier whole-fleet gang is admitted first (empty fleet)
+        admin.tpujobs.create(cases[0].job)
+        _wait(lambda: len(_pods_of(low_name)) == 4, "the low gang's 4 pods")
+        _wait(lambda: wl_low.ledger.snapshot()["progress"] > 2,
+              "the victim to train")
+        # 2. two more gangs queue behind a full fleet — all-or-nothing is
+        # enforced CONTINUOUSLY by the AdmissionTracker hook (a pod born
+        # to a gang without a live assignment is a violation at commit
+        # time, so no sleep-and-peek is needed here)
+        admin.tpujobs.create(cases[1].job)
+        admin.tpujobs.create(cases[2].job)
+        # 3. the high-tier gang preempts the low one: barrier (workload
+        # acks), eviction, release, admission — then the normal-tier gang
+        # backfills the second slice
+        _wait(lambda: len(_pods_of(hi_name)) == 2, "the high gang's pods")
+        _wait(lambda: len(_pods_of(mid_name)) == 2, "the mid gang's pods")
+        _wait(lambda: _pods_of(low_name) == [], "the victim's eviction")
+        low = admin.tpujobs.get("default", low_name)
+        if not any(cond.type == c.JOB_QUEUED and cond.status == "True"
+                   and cond.reason == st.REASON_JOB_PREEMPTED
+                   for cond in low.status.conditions):
+            raise AssertionError(
+                "sched smoke: the victim is not re-queued as Preempted: "
+                f"{[(x.type, x.status, x.reason) for x in low.status.conditions]}")
+        snap = wl_low.ledger.snapshot()
+        if not snap["barriers"]:
+            raise AssertionError(
+                "sched smoke: the eviction never ran its checkpoint barrier")
+        if wl_low.acked < 1:
+            raise AssertionError(
+                "sched smoke: eviction proceeded without the workload's ack "
+                "(grace timeout, not the checkpoint barrier)")
+        # 4. winners complete; the victim is re-admitted and resumes from
+        # its barrier checkpoint — a scheduled eviction loses NOTHING
+        _wait(lambda: all(_all_converged(admin, [case])
+                          for case in cases[1:]), "the winners' completion")
+        _wait(lambda: len(_pods_of(low_name)) == 4, "the victim's re-admission")
+        low_gate.set()
+        _wait(lambda: _all_converged(admin, cases), "full convergence")
+        problems = _settle_invariants(admin, app.controller, cases, tracker,
+                                      chaos, deadline)
+        problems += _sched_job_problems(
+            admin, {low_name: wl_low, mid_name: wl_mid, hi_name: wl_hi},
+            admissions)
+        problems += stall_tracker.problems()
+        order = [k.split("/", 1)[1] for k in admissions.order()]
+        expect = [low_name, hi_name, mid_name, low_name]
+        if order != expect:
+            problems.append(
+                f"admission order {order} != expected {expect} (priority "
+                "must beat FIFO; the victim re-admits last)")
+        if admissions.preempted != [f"default/{low_name}"]:
+            problems.append(
+                f"preempted {admissions.preempted} != exactly the low gang")
+        restores = wl_low.ledger.snapshot()["restores"]
+        if not restores or restores[0][1] != snap["barriers"][-1]:
+            problems.append(
+                f"victim restored at {restores} != barrier checkpoint "
+                f"{snap['barriers']}")
+        job = admin.tpujobs.get("default", low_name)
+        restarts = sum(rs.restarts
+                       for rs in job.status.replica_statuses.values())
+        if restarts:
+            problems.append(
+                f"{low_name}: {restarts} counted restart(s) — a scheduled "
+                "eviction must not register as a failure strike")
+        if problems:
+            raise AssertionError(
+                "sched smoke invariants violated:\n  " + "\n  ".join(problems))
+        return {
+            "mode": "sched-smoke",
+            "seed": seed,
+            "admission_order": order,
+            "preempted": admissions.preempted,
+            "victim_ledger": {k: v for k, v in
+                              wl_low.ledger.snapshot().items()
+                              if k != "violations"},
+            "duration_s": round(time.monotonic() - started, 3),
+            "invariants": "ok",
+        }
+    finally:
+        trainer_stop.set()
+        low_gate.set()
+        kubelet.stop()
+        app.shutdown()

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Gang-scheduler smoke: 2-slice fleet, 3 queued gangs, one preemption.
+
+The fast scheduler acceptance gate (``make sched-smoke``, wired as a
+``make test`` prerequisite; budget ~5 s):
+
+- a low-tier whole-fleet gang is admitted all-or-nothing, then two more
+  gangs queue behind the full fleet (zero pods for either — the
+  AdmissionTracker hook enforces no-partial-admission at every committed
+  instant);
+- the high-tier gang preempts the victim: preempt-target published, the
+  REAL workload loop checkpoints and acks the barrier, eviction deletes
+  the pods (no failure strikes), capacity releases only once the last pod
+  is gone;
+- admission ORDER is asserted exactly (priority beats FIFO: low, high,
+  mid, then the re-admitted victim), and the victim's restore lands
+  exactly on its barrier checkpoint before training to Succeeded.
+
+No API-transport faults here — the oversubscribed queue under the full
+fault schedule + controller hard-kills runs in ``make soak`` (sched tier);
+this smoke isolates the admission/preemption protocol so a failure points
+straight at it.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from e2e.scheduler import run_sched_smoke
+
+
+def main() -> int:
+    logging.disable(logging.CRITICAL)
+    report = run_sched_smoke(seed=13)
+    assert report["invariants"] == "ok"
+    ledger = report["victim_ledger"]
+    print(f"sched-smoke: OK (admission order "
+          f"{' -> '.join(report['admission_order'])}; 1 preemption, victim "
+          f"restored at barrier checkpoint {ledger['barriers'][-1]}, "
+          f"trained {ledger['progress']} steps, "
+          f"in {report['duration_s']}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

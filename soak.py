@@ -16,11 +16,16 @@ two-candidate warm-standby failover with write-fencing probes
 kill/flap/rejoin churn), and the elastic-resize storm (``run_resize_soak``:
 seeded grow/shrink/flap ``spec.replicas`` rewrites over LIVE jobs plus a
 controller hard-kill; invariants: no progress lost past the last
-checkpoint, never a duplicate pod at any instant, every resize converges)
+checkpoint, never a duplicate pod at any instant, every resize converges),
+and the gang-scheduler storm (``run_sched_soak``: an oversubscribed
+admission queue + seeded preemption under faults and a controller kill;
+no gang ever partially admitted, no starvation past fair share + aging,
+every scheduled eviction checkpoint-safe)
 — the crash-only acceptance gate: all invariants hold across every kill,
 zero writes are accepted from a fenced leader or a deposed shard owner,
 and every job is synced by exactly one owner per shard-lease generation.
-``--resize`` runs just the resize tier on top of the API tier.
+``--resize`` runs just the resize tier on top of the API tier;
+``--sched`` just the scheduler tier.
 
 Usage:
     python soak.py                      # default 5 seeds x 5 jobs = 25 jobs
@@ -46,6 +51,7 @@ from e2e.chaos import (
     run_shard_soak,
     run_soak,
 )
+from e2e.scheduler import run_sched_soak
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -63,6 +69,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--resize", action="store_true",
                         help="also run the elastic-resize storm tier for "
                              "every seed (included in --crash)")
+    parser.add_argument("--sched", action="store_true",
+                        help="also run the gang-scheduler queue/preemption "
+                             "tier for every seed (included in --crash)")
     parser.add_argument("--timeout", type=float, default=60.0,
                         help="per-seed convergence timeout (s)")
     parser.add_argument("--verbose", action="store_true",
@@ -91,6 +100,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         # Floored deadline: convergence is ~3s nominal but the tier runs
         # ~15 threads that a loaded host schedules slowly
         runs.append(("resize", lambda seed: run_resize_soak(
+            seed, timeout=max(args.timeout, 120.0))))
+    if args.crash or args.sched:
+        # gang-scheduler tier: oversubscribed admission queue (6 gangs vs
+        # a 2-slice fleet) + seeded preemption + the full fault schedule +
+        # a controller hard-kill; invariants: no gang partially admitted
+        # at any instant, no starvation past fair share + aging, every
+        # scheduled eviction checkpoint-safe.  Same deadline floor as the
+        # resize tier (many workload threads on a loaded host).
+        runs.append(("sched", lambda seed: run_sched_soak(
             seed, timeout=max(args.timeout, 120.0))))
 
     failures = 0

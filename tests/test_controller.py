@@ -541,10 +541,33 @@ def test_multislice_hostnames_are_per_slice():
     assert w1["MEGASCALE_SLICE_ID"] == "0" and w5["MEGASCALE_SLICE_ID"] == "1"
 
 
-def test_topology_replica_mismatch_fails_cleanly():
-    """Incoherent slice accounting must produce Failed, not a crash loop."""
+def test_topology_replica_mismatch_rejected_at_create():
+    """A never-placeable shape is a 422 at the API boundary (CREATE
+    admission), with a per-field error naming the tpu path."""
+    from tpujob.kube.errors import InvalidError
+
     h = Harness()
-    h.submit(new_tpujob(accelerator="v4-16", workers=4))  # v4-16: 2 hosts, needs 1+1
+    try:
+        h.submit(new_tpujob(accelerator="v4-16", workers=4))  # 2 hosts, 1+4 pods
+    except InvalidError as e:
+        assert "spec.tpuReplicaSpecs[Master].tpu" in str(e)
+        assert "can never be placed" in str(e)
+    else:
+        raise AssertionError("incoherent topology passed CREATE admission")
+    assert h.pod_names() == []
+
+
+def test_topology_replica_mismatch_fails_cleanly():
+    """Incoherent slice accounting that PREDATES the create validator (a
+    CR admitted by an older server) must still produce Failed at sync, not
+    a crash loop."""
+    h = Harness()
+    validators = list(h.server.admission_validators)
+    h.server.admission_validators.clear()  # an old server admitted it
+    try:
+        h.submit(new_tpujob(accelerator="v4-16", workers=4))  # 2 hosts, needs 1+1
+    finally:
+        h.server.admission_validators.extend(validators)
     h.sync()
     job = h.get_job()
     assert h.check_condition(job, c.JOB_FAILED)

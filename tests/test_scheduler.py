@@ -1,0 +1,658 @@
+"""Native gang scheduler: quota math, placement, the admission gate.
+
+Unit matrix for the all-or-nothing admission queue (tpujob/server/
+scheduler.py + tpujob/api/quota.py): tier ordering and aging promotion,
+per-namespace dominant-share accounting, the feasibility check against
+every ``GENERATIONS`` entry in ``api/topology.py`` (v5e-style 2D meshes
+vs v4-style 3D tori included), torus-adjacent placement with the
+no-partial-gang contract, the reconciler's admission gate (queued jobs
+hold zero pods; evictions are not failure strikes), CREATE-time admission
+(never-placeable shapes 422 at the boundary), and the watchdog exemption
+for Pending-phase jobs.
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from jobtestutil import Harness, new_tpujob
+from tpujob.api import constants as c
+from tpujob.api.progress import parse_progress
+from tpujob.api.quota import (
+    GangRequest,
+    TIER_MAX,
+    capacity_chips,
+    effective_tier,
+    feasibility_errors,
+    gang_request,
+    host_grid,
+    namespace_share,
+    parse_capacity,
+    parse_tier,
+    queue_sort_key,
+    snake_order,
+)
+from tpujob.api.topology import GENERATIONS, SliceTopology, TopologyError
+from tpujob.api.types import RunPolicy, TPUJob
+from tpujob.api.validation import (
+    tpujob_create_admission,
+    validate_tpujob_create,
+)
+from tpujob.controller import status as st
+from tpujob.controller.job_base import ControllerConfig
+from tpujob.kube.errors import InvalidError
+from tpujob.server.scheduler import Assignment, CapacityModel, GangScheduler
+
+
+def sched_job(name: str, workers: int = 2, accelerator: str = "v4-16",
+              num_slices: int = 1, priority: str = "",
+              ns: str = "default") -> TPUJob:
+    job = new_tpujob(name=name, ns=ns, master=None, workers=workers,
+                     accelerator=accelerator, num_slices=num_slices)
+    if priority:
+        job.spec.run_policy = RunPolicy.from_dict(
+            {"schedulingPolicy": {"priorityClass": priority}})
+    return job
+
+
+def harness_with_scheduler(capacity: str = "v4-16x2", aging_s: float = 0.0,
+                           preempt_grace_s: float = 0.0,
+                           config: ControllerConfig = None):
+    h = Harness(config=config)
+    sched = GangScheduler(h.controller, capacity, aging_s=aging_s,
+                          preempt_grace_s=preempt_grace_s)
+    h.controller.set_scheduler(sched)
+    return h, sched
+
+
+def step(h, sched, rounds: int = 2):
+    """One settle round: informer catch-up, a scheduler tick, a sync."""
+    for _ in range(rounds):
+        h.controller.factory.sync_all()
+        sched.tick()
+        h.sync()
+
+
+# ---------------------------------------------------------------------------
+# tiers + aging + fair share (the quota math)
+# ---------------------------------------------------------------------------
+
+
+def test_tier_parsing_matrix():
+    assert parse_tier(None) == 1
+    assert parse_tier("") == 1
+    assert parse_tier("low") == 0
+    assert parse_tier("Normal") == 1
+    assert parse_tier("HIGH") == 2
+    assert parse_tier("critical") == TIER_MAX
+    assert parse_tier("tier-0") == 0
+    assert parse_tier("tier-2") == 2
+    assert parse_tier("tier-99") == TIER_MAX  # clamped
+    assert parse_tier("tier-garbage") == 1  # typo'd class = normal
+    assert parse_tier("gold-plated") == 1
+
+
+def test_aging_promotion():
+    assert effective_tier(0, 0.0, 10.0) == 0
+    assert effective_tier(0, 9.9, 10.0) == 0
+    assert effective_tier(0, 10.0, 10.0) == 1
+    assert effective_tier(0, 35.0, 10.0) == TIER_MAX  # capped
+    assert effective_tier(2, 25.0, 10.0) == TIER_MAX
+    assert effective_tier(0, 1e9, 0.0) == 0  # aging disabled
+
+
+def test_queue_order_tier_then_share_then_fifo():
+    def req(ns, name):
+        return GangRequest(namespace=ns, name=name, generation=None,
+                           accelerator=None, num_slices=1,
+                           hosts_per_slice=1, tier=1)
+
+    rows = [
+        ("low-late", queue_sort_key(req("a", "low-late"), 0, 0.0, 5.0)),
+        ("hog-early", queue_sort_key(req("hog", "hog-early"), 1, 0.75, 1.0)),
+        ("fair-late", queue_sort_key(req("b", "fair-late"), 1, 0.0, 3.0)),
+        ("fair-early", queue_sort_key(req("b", "fair-early"), 1, 0.0, 2.0)),
+        ("high-any", queue_sort_key(req("hog", "high-any"), 2, 0.75, 9.0)),
+    ]
+    ordered = [name for name, key in sorted(rows, key=lambda r: r[1])]
+    # tier first, then the namespace furthest under fair share, then FIFO
+    assert ordered == ["high-any", "fair-early", "fair-late", "hog-early",
+                      "low-late"]
+
+
+def test_namespace_share():
+    assert namespace_share(0.0, 32) == 0.0
+    assert namespace_share(16.0, 32) == 0.5
+    assert namespace_share(8.0, 0) == 0.0  # degenerate fleet
+
+
+# ---------------------------------------------------------------------------
+# feasibility against every known TPU generation
+# ---------------------------------------------------------------------------
+
+
+def _two_host_accelerator(gen_name: str) -> str:
+    """An accelerator string of exactly two hosts for the generation."""
+    gen = GENERATIONS[gen_name]
+    chips = gen.chips_per_host * 2
+    return f"{gen_name}-{chips * gen.cores_per_chip}"
+
+
+@pytest.mark.parametrize("gen_name", sorted(GENERATIONS))
+def test_feasibility_every_generation(gen_name):
+    accel = _two_host_accelerator(gen_name)
+    pools = parse_capacity(f"{accel}x2")
+    shape = pools[0].shape
+    assert shape.hosts == 2
+    # the host grid matches the generation's ICI dimensionality: 2D for
+    # v2/v3/v5e-style meshes, 3D for the v4/v5p torus
+    assert len(host_grid(shape)) == GENERATIONS[gen_name].topology_dims
+
+    ok = GangRequest(namespace="d", name="ok", generation=gen_name,
+                     accelerator=accel, num_slices=2, hosts_per_slice=2,
+                     tier=1)
+    assert feasibility_errors(ok, pools) == []
+    sub = GangRequest(namespace="d", name="sub", generation=gen_name,
+                      accelerator=accel, num_slices=1, hosts_per_slice=1,
+                      tier=1)
+    assert feasibility_errors(sub, pools) == []
+    too_many_slices = GangRequest(
+        namespace="d", name="wide", generation=gen_name, accelerator=accel,
+        num_slices=3, hosts_per_slice=2, tier=1)
+    assert feasibility_errors(too_many_slices, pools)
+    too_many_hosts = GangRequest(
+        namespace="d", name="tall", generation=gen_name, accelerator=accel,
+        num_slices=1, hosts_per_slice=3, tier=1)
+    assert feasibility_errors(too_many_hosts, pools)
+    other = "v4" if gen_name != "v4" else "v5e"
+    wrong_gen = GangRequest(
+        namespace="d", name="alien", generation=other,
+        accelerator=_two_host_accelerator(other), num_slices=1,
+        hosts_per_slice=1, tier=1)
+    assert feasibility_errors(wrong_gen, pools)
+
+
+def test_unpinned_job_feasible_on_any_pool():
+    pools = parse_capacity("v4-16x1")  # 2 hosts per slice
+    fits = GangRequest(namespace="d", name="j", generation=None,
+                       accelerator=None, num_slices=1, hosts_per_slice=2,
+                       tier=1)
+    assert feasibility_errors(fits, pools) == []
+    too_big = GangRequest(namespace="d", name="j", generation=None,
+                          accelerator=None, num_slices=1, hosts_per_slice=3,
+                          tier=1)
+    assert feasibility_errors(too_big, pools)
+
+
+def test_parse_capacity_errors():
+    with pytest.raises(TopologyError):
+        parse_capacity("")
+    with pytest.raises(TopologyError):
+        parse_capacity("v4-16")  # no slice count
+    with pytest.raises(TopologyError):
+        parse_capacity("v4-16x0")
+    with pytest.raises(TopologyError):
+        parse_capacity("v99-16x2")
+    pools = parse_capacity("v4-32x2, v5e-16x1")
+    assert [p.accelerator for p in pools] == ["v4-32", "v5e-16"]
+    assert capacity_chips(pools) == 16 * 2 + 16
+
+
+def test_snake_order_is_torus_adjacent():
+    for dims in ((4,), (2, 3), (2, 2, 2), (3, 2, 4)):
+        walk = snake_order(dims)
+        assert len(walk) == len(set(walk))  # every host exactly once
+        for a, b in zip(walk, walk[1:]):
+            diff = [abs(x - y) for x, y in zip(a, b)]
+            assert sum(diff) == 1, (dims, a, b)  # one step, one axis
+
+
+# ---------------------------------------------------------------------------
+# placement: all-or-nothing, torus-adjacent, released exactly
+# ---------------------------------------------------------------------------
+
+
+def test_place_all_or_nothing_never_partial():
+    cap = CapacityModel(parse_capacity("v4-16x2"))
+    one = GangRequest(namespace="d", name="one", generation="v4",
+                      accelerator="v4-16", num_slices=1, hosts_per_slice=2,
+                      tier=1)
+    assert cap.place(one, "d/one") is not None
+    two = GangRequest(namespace="d", name="two", generation="v4",
+                      accelerator="v4-16", num_slices=2, hosts_per_slice=2,
+                      tier=1)
+    before = cap.used_hosts()
+    # only one slice free: the 2-slice gang must not place — and must not
+    # leave a partial reservation behind
+    assert cap.place(two, "d/two") is None
+    assert cap.used_hosts() == before
+
+
+def test_subslice_packing_shares_one_slice():
+    cap = CapacityModel(parse_capacity("v4-32x1"))  # 4 hosts on one slice
+    small = GangRequest(namespace="d", name="s", generation=None,
+                        accelerator=None, num_slices=1, hosts_per_slice=1,
+                        tier=1)
+    placements = [cap.place(small, f"d/s{i}") for i in range(4)]
+    assert all(p is not None for p in placements)
+    intervals = sorted((p.slices[0].host_lo, p.slices[0].host_hi)
+                       for p in placements)
+    assert intervals == [(0, 1), (1, 2), (2, 3), (3, 4)]  # contiguous pack
+    assert cap.place(small, "d/s4") is None  # full
+    cap.release("d/s1")
+    refit = cap.place(small, "d/s5")
+    assert refit is not None and refit.slices[0].host_lo == 1
+
+
+def test_reserve_detects_overlap_and_bounds():
+    pools = parse_capacity("v4-16x1")
+    cap = CapacityModel(pools)
+    a = Assignment(accelerator="v4-16", chips=8, slices=(
+        __import__("tpujob.server.scheduler", fromlist=["SlicePlacement"])
+        .SlicePlacement(pool=0, slice_index=0, host_lo=0, host_hi=2),))
+    assert cap.reserve("d/a", a) == []
+    assert cap.reserve("d/b", a)  # overlap reported
+    beyond = Assignment(accelerator="v4-16", chips=8, slices=(
+        __import__("tpujob.server.scheduler", fromlist=["SlicePlacement"])
+        .SlicePlacement(pool=0, slice_index=9, host_lo=0, host_hi=2),))
+    assert cap.reserve("d/c", beyond)  # exceeds modeled capacity
+
+
+def test_assignment_json_roundtrip_and_garbage():
+    cap = CapacityModel(parse_capacity("v4-16x2"))
+    req = GangRequest(namespace="d", name="j", generation="v4",
+                      accelerator="v4-16", num_slices=2, hosts_per_slice=2,
+                      tier=1)
+    asg = cap.place(req, "d/j")
+    assert Assignment.from_json(asg.to_json()) == asg
+    assert Assignment.from_json("not json") is None
+    assert Assignment.from_json('{"slices": [{"pool": "x"}]}') is None
+
+
+# ---------------------------------------------------------------------------
+# the admission gate (reconciler half)
+# ---------------------------------------------------------------------------
+
+
+def test_queued_job_holds_zero_pods_until_admitted():
+    h, sched = harness_with_scheduler("v4-16x2")
+    h.submit(sched_job("j1"))
+    h.sync()
+    job = h.get_job("j1")
+    assert h.check_condition(job, c.JOB_QUEUED, "TPUJobQueued")
+    assert h.pod_names() == []  # the gate holds the whole gang back
+    step(h, sched)
+    job = h.get_job("j1")
+    queued = st.get_condition(job.status, c.JOB_QUEUED)
+    assert queued.status == "False" and queued.reason == st.REASON_JOB_ADMITTED
+    assert h.pod_names() == ["j1-worker-0", "j1-worker-1"]
+    assert job.metadata.annotations.get(c.ANNOTATION_SCHED_ASSIGNMENT)
+
+
+def test_admissions_are_all_or_nothing_under_pressure():
+    h, sched = harness_with_scheduler("v4-16x1")  # one 2-host slice
+    h.submit(sched_job("fit", workers=2))
+    h.submit(sched_job("wait", workers=2))
+    step(h, sched)
+    pods = h.pod_names()
+    assert pods == ["fit-worker-0", "fit-worker-1"]  # second gang: ZERO pods
+    wait = h.get_job("wait")
+    assert h.check_condition(wait, c.JOB_QUEUED)
+    assert sched.queue_position("default/wait") == 0
+
+
+def test_pending_admission_survives_stale_cache():
+    """Regression: an admission committed but not yet echoed by the
+    informer cache must keep its hosts booked — a second tick against the
+    stale cache must not double-place another gang onto them."""
+    h, sched = harness_with_scheduler("v4-16x1")
+    h.submit(sched_job("a"))
+    h.submit(sched_job("b"))
+    h.controller.factory.sync_all()
+    sched.tick()
+    # NO informer sync: the cache still shows neither assignment
+    sched.tick()
+    h.controller.factory.sync_all()
+    anns = [h.get_job(n).metadata.annotations.get(
+        c.ANNOTATION_SCHED_ASSIGNMENT) for n in ("a", "b")]
+    assert sum(1 for a in anns if a) == 1, anns
+
+
+def test_eviction_is_not_a_failure_strike():
+    h, sched = harness_with_scheduler("v4-16x1")
+    h.submit(sched_job("victim"))
+    step(h, sched)
+    assert len(h.pod_names()) == 2
+    # revoke the admission the way the scheduler does (eviction marker)
+    h.server.patch("tpujobs", "default", "victim", {"metadata": {
+        "annotations": {c.ANNOTATION_SCHED_EVICTED: st.now_iso()}}})
+    h.sync()
+    assert h.pod_names() == []
+    job = h.get_job("victim")
+    queued = st.get_condition(job.status, c.JOB_QUEUED)
+    assert queued.status == "True"
+    assert queued.reason == st.REASON_JOB_PREEMPTED
+    assert not st.has_condition(job.status, c.JOB_RUNNING)
+    assert all(rs.restarts == 0
+               for rs in job.status.replica_statuses.values())
+    assert not st.has_condition(job.status, c.JOB_RESTARTING)
+
+
+def test_unschedulable_shape_gets_durable_failed_condition():
+    h, sched = harness_with_scheduler("v4-16x1")  # 2-host slices, 1 slice
+    h.submit(sched_job("wide", workers=4, num_slices=2))  # needs 2 slices
+    step(h, sched)
+    job = h.get_job("wide")
+    assert h.check_condition(job, c.JOB_FAILED, "TPUJobUnschedulable")
+    assert h.pod_names() == []
+    # the verdict does not wedge the queue: a feasible job still admits
+    h.submit(sched_job("ok", workers=2))
+    step(h, sched)
+    assert h.pod_names() == ["ok-worker-0", "ok-worker-1"]
+
+
+def test_preemption_prefers_lowest_tier_then_lowest_goodput_cost():
+    h, sched = harness_with_scheduler("v4-16x2", preempt_grace_s=0.0)
+    h.submit(sched_job("cheap", priority="low"))
+    h.submit(sched_job("pricey", priority="low"))
+    step(h, sched)
+    assert len(h.pod_names()) == 4  # both admitted (fleet full)
+    # telemetry: 'cheap' has checkpointed everything (0 steps at risk);
+    # 'pricey' would lose 7 steps
+    h.controller.telemetry.ingest(
+        "default/cheap", "default", "cheap", "-", "cheap-worker-0",
+        "step=10 ckpt=10", parse_progress("step=10 ckpt=10"))
+    h.controller.telemetry.ingest(
+        "default/pricey", "default", "pricey", "-", "pricey-worker-0",
+        "step=10 ckpt=3", parse_progress("step=10 ckpt=3"))
+    h.submit(sched_job("boss", priority="high"))
+    h.controller.factory.sync_all()
+    sched.tick()
+    h.controller.factory.sync_all()
+    cheap = h.get_job("cheap")
+    pricey = h.get_job("pricey")
+    assert cheap.metadata.annotations.get(c.ANNOTATION_PREEMPT_TARGET)
+    assert not pricey.metadata.annotations.get(c.ANNOTATION_PREEMPT_TARGET)
+
+
+def test_preemption_full_cycle_readmits_victim_later():
+    h, sched = harness_with_scheduler("v4-16x1", preempt_grace_s=0.0)
+    h.submit(sched_job("low", priority="low"))
+    step(h, sched)
+    h.submit(sched_job("hi", priority="high"))
+    # publish -> (grace 0: barrier passes) -> evict -> release -> admit
+    for _ in range(5):
+        step(h, sched)
+    assert h.pod_names() == ["hi-worker-0", "hi-worker-1"]
+    low = h.get_job("low")
+    assert h.check_condition(low, c.JOB_QUEUED, "TPUJobPreempted")
+    # the winner completes; the victim is re-admitted
+    for i in range(2):
+        h.set_pod_phase("hi", "Worker", i, "Succeeded")
+    for _ in range(4):
+        step(h, sched)
+    # hi's Succeeded pods linger (cleanPodPolicy None), but low's gang is
+    # back: re-admitted into the freed capacity
+    assert {"low-worker-0", "low-worker-1"} <= set(h.pod_names())
+    low = h.get_job("low")
+    assert st.get_condition(low.status, c.JOB_QUEUED).status == "False"
+
+
+def test_aging_promotes_queued_job_past_fresh_higher_tier():
+    """Anti-starvation: a low-tier gang that waited out the aging bound
+    outranks a freshly-queued higher-tier one."""
+    h, sched = harness_with_scheduler("v4-16x1", aging_s=0.05)
+    h.submit(sched_job("blocker"))
+    step(h, sched)
+    h.submit(sched_job("old-low", priority="low"))
+    h.controller.factory.sync_all()
+    sched.tick()  # old-low registers in the queue
+    time.sleep(0.25)  # ages 0 -> 3+ (capped at TIER_MAX)
+    h.submit(sched_job("fresh-high", priority="high"))
+    h.controller.factory.sync_all()
+    sched.tick()
+    view = sched.debug_snapshot()["queue"]
+    assert [row["job"] for row in view] == ["default/old-low",
+                                            "default/fresh-high"]
+    assert view[0]["effective_tier"] == TIER_MAX
+
+
+def test_fair_share_orders_equal_tiers_by_namespace_usage():
+    h, sched = harness_with_scheduler("v4-16x2")
+    h.submit(sched_job("hog-1", ns="hog"))
+    step(h, sched)  # hog namespace now holds half the fleet
+    h.submit(sched_job("hog-2", ns="hog", workers=2))
+    h.submit(sched_job("fair-1", ns="fair", workers=2))
+    h.controller.factory.sync_all()
+    sched.tick()
+    h.controller.factory.sync_all()
+    # one slice was free: the namespace under its fair share got it even
+    # though the hog's job queued first
+    assert h.get_job("fair-1", ns="fair").metadata.annotations.get(
+        c.ANNOTATION_SCHED_ASSIGNMENT)
+    assert not h.get_job("hog-2", ns="hog").metadata.annotations.get(
+        c.ANNOTATION_SCHED_ASSIGNMENT)
+
+
+def test_finished_job_releases_capacity():
+    h, sched = harness_with_scheduler("v4-16x1")
+    h.submit(sched_job("one"))
+    step(h, sched)
+    for i in range(2):
+        h.set_pod_phase("one", "Worker", i, "Succeeded")
+    h.sync()
+    h.submit(sched_job("two"))
+    for _ in range(3):
+        step(h, sched)
+    assert h.get_job("two").metadata.annotations.get(
+        c.ANNOTATION_SCHED_ASSIGNMENT)
+
+
+def test_fleet_snapshot_carries_scheduler_view():
+    h, sched = harness_with_scheduler("v4-16x1")
+    h.submit(sched_job("a"))
+    h.submit(sched_job("b"))
+    step(h, sched)
+    snap = h.controller.fleet_snapshot()
+    assert snap["scheduler"]["capacity"][0]["accelerator"] == "v4-16"
+    assert [row["job"] for row in snap["scheduler"]["queue"]]
+    assert snap["scheduler"]["admissions_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Pending-phase watchdog exemption (satellite): queued jobs never Stalled
+# ---------------------------------------------------------------------------
+
+
+def test_queued_job_never_flips_stalled():
+    """A queued job has no heartbeats by design — even with telemetry
+    state left over from before its preemption, the armed watchdog must
+    never flip it Stalled while it waits in the queue."""
+    config = ControllerConfig(stall_timeout_s=0.01,
+                              stall_check_interval_s=0.01)
+    h, sched = harness_with_scheduler("v4-16x1", config=config)
+    h.submit(sched_job("blocker"))
+    step(h, sched)
+    h.submit(sched_job("queued"))
+    step(h, sched)
+    # telemetry left over from a pre-preemption life, long past deadline
+    h.controller.telemetry.ingest(
+        "default/queued", "default", "queued", "-", "queued-worker-0",
+        "step=5 ckpt=5", parse_progress("step=5 ckpt=5"),
+        now=time.monotonic() - 100.0)
+    time.sleep(0.05)  # stall deadline (0.01s) long expired
+    h.sync(rounds=4)
+    job = h.get_job("queued")
+    assert not st.has_condition(job.status, c.JOB_STALLED)
+    assert h.check_condition(job, c.JOB_QUEUED)
+    # and the exemption is the explicit 'queued' reason, not a side effect
+    pods = h.controller.get_pods_for_job(job)
+    assert h.controller._telemetry_exempt(job, pods) == "queued"
+
+
+def test_active_deadline_suspended_while_queued():
+    """Regression: a preempted job waiting in the queue must not burn its
+    activeDeadlineSeconds — a scheduler eviction would otherwise convert
+    into a deadline Failure (eviction is never a failure)."""
+    h, sched = harness_with_scheduler("v4-16x1")
+    job = sched_job("j")
+    job.spec.run_policy.active_deadline_seconds = 1
+    h.submit(job)
+    step(h, sched)
+    assert h.get_job("j").status.start_time is not None  # admitted + running
+    # revoke the admission: the job re-queues and its deadline clock stops
+    h.server.patch("tpujobs", "default", "j", {"metadata": {
+        "annotations": {c.ANNOTATION_SCHED_EVICTED: st.now_iso()}}})
+    h.sync()
+    assert h.get_job("j").status.start_time is None  # clock suspended
+    time.sleep(1.1)  # well past the 1s deadline
+    h.sync(rounds=4)
+    job = h.get_job("j")
+    assert not st.has_condition(job.status, c.JOB_FAILED), (
+        job.status.to_dict())
+    assert h.check_condition(job, c.JOB_QUEUED)
+
+
+def test_spec_fix_outruns_stale_unschedulable_verdict():
+    """Regression: an unschedulable verdict computed against an old spec
+    generation must not fail a job whose shape was legally fixed — the
+    gate only applies generation-matched verdicts."""
+    h, sched = harness_with_scheduler("v4-16x1")
+    h.submit(new_tpujob(name="big", master=None, workers=5))  # 5 > 2 hosts
+    # the tick records the verdict, but NO sync applies it yet — the race
+    # under test is the spec fix landing between tick and gate
+    h.controller.factory.sync_all()
+    sched.tick()
+    assert sched.unschedulable_errors("default/big") is not None
+    # legal fix: shrink Worker replicas to a placeable count.  The sync
+    # races ahead of the next scheduler tick — the stale verdict must not
+    # apply to the new generation
+    h.server.patch("tpujobs", "default", "big", {
+        "spec": {"tpuReplicaSpecs": {"Worker": {"replicas": 2}}}})
+    h.controller.factory.sync_all()
+    gen = h.get_job("big").metadata.generation
+    assert sched.unschedulable_errors("default/big", gen) is None
+    h.sync()  # the gate consults the generation-matched feed: no Failed
+    assert not st.has_condition(h.get_job("big").status, c.JOB_FAILED)
+    step(h, sched)  # the next tick re-judges and admits
+    assert h.get_job("big").metadata.annotations.get(
+        c.ANNOTATION_SCHED_ASSIGNMENT)
+
+
+def test_grown_gang_is_replaced_not_overcommitted():
+    """Regression: an elastic grow of an admitted UNPINNED gang (UPDATE
+    admission allows it) must re-place the gang through the eviction
+    protocol — not silently run more pods than its committed assignment,
+    overcommitting the modeled fleet."""
+    h, sched = harness_with_scheduler("v4-32x1",  # one 4-host slice
+                                      preempt_grace_s=0.0)
+    h.submit(new_tpujob(name="g", master=None, workers=2))  # unpinned
+    step(h, sched)
+    asg_before = h.get_job("g").metadata.annotations[
+        c.ANNOTATION_SCHED_ASSIGNMENT]
+    h.server.patch("tpujobs", "default", "g", {
+        "spec": {"tpuReplicaSpecs": {"Worker": {"replicas": 4}}}})
+    # re-place cycle: detect grow -> barrier (grace 0) -> evict -> release
+    # -> re-admit at the new shape
+    for _ in range(6):
+        step(h, sched)
+    job = h.get_job("g")
+    asg = Assignment.from_json(
+        job.metadata.annotations[c.ANNOTATION_SCHED_ASSIGNMENT])
+    assert asg.slices[0].host_hi - asg.slices[0].host_lo == 4, asg
+    assert job.metadata.annotations.get(
+        c.ANNOTATION_SCHED_ASSIGNMENT) != asg_before
+    assert len(h.pod_names()) == 4
+    # not a failure strike, like every scheduler eviction
+    assert all(rs.restarts == 0
+               for rs in job.status.replica_statuses.values())
+
+
+def test_terminal_condition_flips_queued_false():
+    h, sched = harness_with_scheduler("v4-16x1")
+    h.submit(sched_job("j"))
+    step(h, sched)
+    for i in range(2):
+        h.set_pod_phase("j", "Worker", i, "Succeeded")
+    h.sync()
+    job = h.get_job("j")
+    assert h.check_condition(job, c.JOB_SUCCEEDED)
+    queued = st.get_condition(job.status, c.JOB_QUEUED)
+    assert queued is None or queued.status == "False"
+
+
+# ---------------------------------------------------------------------------
+# CREATE-time admission (satellite): never-placeable shapes 422 early
+# ---------------------------------------------------------------------------
+
+
+def test_create_admission_rejects_unresolvable_accelerator():
+    job = new_tpujob(accelerator="v4-32", workers=3)
+    job.spec.tpu_replica_specs["Master"].tpu.accelerator = "v4-33"  # odd
+    errs = validate_tpujob_create(job.spec)
+    assert errs and "spec.tpuReplicaSpecs[Master].tpu" in errs[0]
+
+
+def test_create_admission_rejects_topology_chip_mismatch():
+    job = new_tpujob(accelerator="v4-32", workers=3)
+    job.spec.tpu_replica_specs["Master"].tpu.topology = "2x2x2"  # 8 != 16
+    errs = validate_tpujob_create(job.spec)
+    assert errs and "topology" in errs[0]
+
+
+def test_create_admission_rejects_replica_host_mismatch():
+    job = new_tpujob(accelerator="v4-16", workers=4)  # 2 hosts, 5 pods
+    errs = validate_tpujob_create(job.spec)
+    assert errs and "can never be placed" in errs[0]
+
+
+def test_create_admission_is_422_on_the_server():
+    h = Harness()
+    with pytest.raises(InvalidError) as exc:
+        h.submit(new_tpujob(name="bad", accelerator="v4-16", workers=4))
+    assert exc.value.code == 422
+    assert "spec.tpuReplicaSpecs[Master].tpu" in str(exc.value)
+    # nothing committed, no watch event, no queue entry
+    assert h.clients.tpujobs.list() == []
+
+
+def test_create_admission_ignores_garbage_and_updates():
+    # unparseable spec: the reconciler's _fail_malformed owns it
+    tpujob_create_admission("create", c.PLURAL, None,
+                            {"metadata": {"name": "x"}, "spec": "garbage"})
+    # updates are the other validator's territory (old is not None)
+    tpujob_create_admission("update", c.PLURAL,
+                            {"spec": {}}, {"spec": {}})
+    # other resources pass through
+    tpujob_create_admission("create", "pods", None, {"spec": {}})
+
+
+def test_create_admission_accepts_coherent_shapes():
+    assert validate_tpujob_create(
+        new_tpujob(accelerator="v4-32", workers=3).spec) == []
+    assert validate_tpujob_create(
+        new_tpujob(accelerator="v4-32", workers=7, num_slices=2).spec) == []
+    assert validate_tpujob_create(new_tpujob(workers=5).spec) == []  # no tpu
+
+
+# ---------------------------------------------------------------------------
+# gang_request derivation
+# ---------------------------------------------------------------------------
+
+
+def test_gang_request_pinned_and_unpinned():
+    pinned = gang_request(sched_job("p", workers=4, num_slices=2))
+    assert (pinned.generation, pinned.num_slices, pinned.hosts_per_slice) \
+        == ("v4", 2, 2)
+    plain = gang_request(new_tpujob(name="u", master=1, workers=3))
+    assert (plain.generation, plain.num_slices, plain.hosts_per_slice) \
+        == (None, 1, 4)
+    assert plain.chips_on(parse_capacity("v4-16x1")[0]) == 16
+
+
+def test_host_grid_v5e_2d_vs_v4_3d():
+    v4 = host_grid(SliceTopology.resolve("v4-128"))  # 64 chips, 16 hosts
+    assert len(v4) == 3 and len(snake_order(v4)) == 16
+    v5e = host_grid(SliceTopology.resolve("v5e-16"))  # 16 chips, 2 hosts
+    assert len(v5e) == 2 and len(snake_order(v5e)) == 2

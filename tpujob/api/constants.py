@@ -43,6 +43,7 @@ TPU_TOPOLOGY_NODE_SELECTOR = "cloud.google.com/gke-tpu-topology"
 
 # --- condition types (kubeflow/common types.go:101-127 equivalents) ---------
 JOB_CREATED = "Created"
+JOB_QUEUED = "Queued"  # gang scheduler: waiting for all-or-nothing admission
 JOB_RUNNING = "Running"
 JOB_RESTARTING = "Restarting"
 JOB_RESIZING = "Resizing"  # elastic resize (staged drain/join) in flight
@@ -96,3 +97,31 @@ ANNOTATION_CHECKPOINT_ACK = f"{GROUP_NAME}/checkpoint-ack"
 # and the signal the Stalled-job watchdog and the tpujob_job_* metric
 # families are built on.
 ANNOTATION_PROGRESS = f"{GROUP_NAME}/progress"
+
+# --- native gang scheduler: the admission/preemption channel -----------------
+# The scheduler's durable state lives on job annotations, exactly like the
+# elastic-resize staging record lives in status: every decision is resumable
+# across controller crash and shard handoff because the NEXT tick re-derives
+# the capacity model from what is already committed.
+#
+# - SCHED_ASSIGNMENT: JSON placement record written at admission (which
+#   slices, which torus-adjacent host ranges).  Present = the gang HOLDS its
+#   modeled capacity.  All-or-nothing by construction: the record always
+#   covers the whole gang or is absent.
+# - SCHED_EVICTED: eviction marker (ISO timestamp).  assignment+evicted =
+#   the gang is being vacated — the reconciler's admission gate deletes its
+#   pods (not failure strikes) while the scheduler keeps the capacity
+#   reserved until the last pod is gone, so a re-admission can never be
+#   placed onto hosts the victim still occupies.
+# - PREEMPT_TARGET: preemption staged (ISO timestamp of the publish) — the
+#   workload should checkpoint NOW; the scheduler waits for the ack (or the
+#   telemetry checkpoint catching up to the step, or the bounded grace)
+#   before writing the eviction marker.  The PR-9 drain protocol, re-aimed:
+#   publish target, wait the checkpoint barrier, then evict.
+# - PREEMPT_ACK: written by the WORKLOAD (coordinator): the preemption
+#   checkpoint barrier is hit; evict away.  Separate from CHECKPOINT_ACK so
+#   the resize machinery's ack-consumption can never race a preemption.
+ANNOTATION_SCHED_ASSIGNMENT = f"{GROUP_NAME}/sched-assignment"
+ANNOTATION_SCHED_EVICTED = f"{GROUP_NAME}/sched-evicted"
+ANNOTATION_PREEMPT_TARGET = f"{GROUP_NAME}/preempt-target"
+ANNOTATION_PREEMPT_ACK = f"{GROUP_NAME}/preempt-ack"

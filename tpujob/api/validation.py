@@ -46,6 +46,18 @@ VALID_CLEAN_POD_POLICIES = (
 )
 
 
+def slice_coherence_error(topo, total_replicas: int) -> Optional[str]:
+    """The ONE replicas-vs-hosts coherence rule (the slice is shared by the
+    whole job: every host runs exactly one pod), shared by the CREATE-422
+    admission boundary and the reconciler's strict validation so the two
+    layers can never drift apart.  None = coherent."""
+    if total_replicas == topo.num_processes:
+        return None
+    return (f"slice {topo.accelerator} (numSlices={topo.num_slices}) needs "
+            f"exactly {topo.num_processes} host pods but the spec provides "
+            f"{total_replicas}")
+
+
 def validate_tpujob_spec(spec: TPUJobSpec, strict_topology: bool = False) -> List[str]:
     """Return the list of validation errors (empty if valid)."""
     errs: List[str] = []
@@ -103,14 +115,10 @@ def validate_tpujob_spec(spec: TPUJobSpec, strict_topology: bool = False) -> Lis
             except TopologyError as e:
                 errs.append(f"TPUJobSpec is not valid: {rtype} tpu: {e}")
             else:
-                if strict_topology and total_replicas != topo.num_processes:
-                    # the slice is shared by the whole job: every host runs
-                    # exactly one pod (Master on host 0, Workers on the rest)
-                    errs.append(
-                        f"TPUJobSpec is not valid: slice {topo.accelerator} "
-                        f"needs {topo.num_processes} host pods but spec "
-                        f"provides {total_replicas}"
-                    )
+                if strict_topology:
+                    coherence = slice_coherence_error(topo, total_replicas)
+                    if coherence:
+                        errs.append(f"TPUJobSpec is not valid: {coherence}")
 
     if spec.run_policy.clean_pod_policy not in (None,) + VALID_CLEAN_POD_POLICIES:
         errs.append(
@@ -208,6 +216,67 @@ def validate_tpujob_update(old: TPUJobSpec, new: TPUJobSpec) -> List[str]:
     return errs
 
 
+def validate_tpujob_create(spec: TPUJobSpec) -> List[str]:
+    """Per-field error list for CREATE admission (empty = admissible).
+
+    Scope: TOPOLOGY feasibility only — a shape that can never be placed
+    (an unresolvable ``spec.tpu``, or a replica count incoherent with the
+    slice's host count) is rejected before it ever reaches the scheduler's
+    queue or wedges a reconcile loop.  Everything else (container names,
+    policies) stays the reconciler's ``_fail_malformed`` territory: those
+    jobs are structurally processable and their Failed condition is
+    evidence, where an unplaceable topology is a plain client error that
+    deserves a 422 at the API boundary (mirrors
+    :func:`validate_tpujob_update`, which covered only the resize path)."""
+    if spec is None or not spec.tpu_replica_specs:
+        return []  # structurally degenerate: _fail_malformed reports it
+    errs: List[str] = []
+    total_replicas = sum(
+        _replicas_or_default(r)
+        for t, r in spec.tpu_replica_specs.items() if t in VALID_REPLICA_TYPES
+    )
+    for rtype, rspec in spec.tpu_replica_specs.items():
+        if rtype not in VALID_REPLICA_TYPES:
+            continue  # _fail_malformed names the bad type
+        if rspec.tpu is None or not rspec.tpu.accelerator:
+            continue
+        path = f"spec.tpuReplicaSpecs[{rtype}].tpu"
+        try:
+            topo = rspec.tpu.resolve()
+        except TopologyError as e:
+            errs.append(f"{path}: {e}")
+            continue
+        coherence = slice_coherence_error(topo, total_replicas)
+        if coherence:
+            errs.append(
+                f"{path}: {coherence} — this gang can never be placed")
+    return errs
+
+
+def tpujob_create_admission(verb: str, resource: str,
+                            old: Optional[Dict[str, Any]],
+                            new: Dict[str, Any]) -> None:
+    """CREATE admission for ``InMemoryAPIServer.admission_validators``:
+    rejects a TPUJob whose topology shape can never be placed with
+    InvalidError (HTTP 422 on the REST surface).  A spec that does not even
+    parse passes through — the controller's ``_fail_malformed`` tolerance
+    path owns structurally-broken CRs."""
+    if resource != c.PLURAL or old is not None:
+        return
+    try:
+        spec = TPUJobSpec.from_dict(
+            new.get("spec") if isinstance(new.get("spec"), dict) else {})
+    except (TypeError, ValueError):
+        return  # unparseable: the reconciler reports it as Failed
+    errs = validate_tpujob_create(spec)
+    if errs:
+        from tpujob.kube.errors import InvalidError
+
+        name = (new.get("metadata") or {}).get("name")
+        raise InvalidError(
+            f"TPUJob {name} create rejected: " + "; ".join(errs))
+
+
 def tpujob_update_admission(verb: str, resource: str,
                             old: Optional[Dict[str, Any]],
                             new: Dict[str, Any]) -> None:
@@ -238,7 +307,11 @@ def tpujob_update_admission(verb: str, resource: str,
 
 
 def install_tpujob_admission(server) -> None:
-    """Register TPUJob UPDATE admission on an in-memory API server (idempotent)."""
+    """Register TPUJob CREATE + UPDATE admission on an in-memory API server
+    (idempotent)."""
     validators = getattr(server, "admission_validators", None)
-    if validators is not None and tpujob_update_admission not in validators:
-        validators.append(tpujob_update_admission)
+    if validators is None:
+        return
+    for validator in (tpujob_create_admission, tpujob_update_admission):
+        if validator not in validators:
+            validators.append(validator)

@@ -325,6 +325,12 @@ class JobController:
         # under the shard's fencing context.  None = the single-controller
         # world, zero behavior change.
         self.sharder = None
+        # native gang scheduler (PR 11): when set, the reconciler's
+        # admission gate holds a job's pods back until the scheduler
+        # commits its all-or-nothing assignment annotation, and evicts
+        # them (not failure strikes) when the assignment is revoked.
+        # None = no admission queue, zero behavior change.
+        self.scheduler = None
         self._inflight_lock = lockgraph.new_lock("shard-inflight")
         # keys currently mid-sync per shard: the drain barrier the handoff
         # protocol waits on before a shard lease may be released
@@ -358,6 +364,11 @@ class JobController:
         """Attach the shard coordinator BEFORE run(): every enqueue and
         dequeue from then on is filtered to the shards it owns."""
         self.sharder = sharder
+
+    def set_scheduler(self, scheduler) -> None:
+        """Attach the gang scheduler BEFORE run(): from then on the
+        admission gate holds every job's pods until its gang is admitted."""
+        self.scheduler = scheduler
 
     def _shard_of_obj(self, obj: Optional[Dict[str, Any]]) -> Optional[int]:
         """The shard a job object lives in (consistent hash of its UID), or

@@ -505,6 +505,19 @@ class TPUJobController(JobController):
                 f"TPUJob {job.metadata.name} is created.",
             )
 
+        # gang-admission gate (native scheduler): a job whose gang the
+        # scheduler has not admitted holds NO pods — all-or-nothing means
+        # the reconciler never creates a partial gang, and a revoked
+        # admission (preemption) evicts the pods without failure strikes.
+        # The gate runs before the resize pre-pass: an unadmitted job has
+        # nothing to resize.
+        if self.scheduler is not None:
+            with TRACER.span("phase", phase="admission"):
+                gated = self._reconcile_admission(job, old_status, pods,
+                                                  services)
+            if gated is not None:
+                return gated
+
         # elastic resize pre-pass: a spec.replicas change is a STAGED
         # drain/join transition, not a teardown.  Pods being drained are
         # excluded from the normal per-type reconcile below — they must not
@@ -1040,6 +1053,28 @@ class TPUJobController(JobController):
         # grace across incarnations proceeds immediately
         return time.time() - started >= grace + 1.0  # noqa: TPL004
 
+    def _delete_pod_no_strike(self, job: TPUJob, pod: Pod,
+                              rtype: str) -> None:
+        """The shared "delete a pod that is NOT failing" ladder (resize
+        drains, scheduler evictions, watchdog restarts): the expectation is
+        raised up front and cleared on every path where no DELETED event is
+        guaranteed to arrive — already-gone 404 (the event may have
+        preceded the registration), ambiguous 504 (lost response: the
+        retry sync re-derives the remaining set from live state), and a
+        genuinely failed delete, which alone surfaces its error."""
+        ekey = expectation_key(job.key, rtype, "pods")
+        self.expectations.expect(ekey, adds=0, dels=1)
+        try:
+            self.pod_control.delete_pod(
+                pod.metadata.namespace, pod.metadata.name, job)
+        except NotFoundError:
+            self.expectations.observe_del(ekey)
+        except ServerTimeoutError:
+            self.expectations.observe_del(ekey)
+        except Exception:
+            self.expectations.observe_del(ekey)
+            raise
+
     def _delete_drained_pods(self, job: TPUJob, rtype: str, replicas: int,
                              over: List[Pod]) -> None:
         """Delete the drained (highest-index-first) replicas with the usual
@@ -1047,7 +1082,6 @@ class TPUJobController(JobController):
         strikes: no ``restarts`` increment, no Restarting condition, and the
         crash-loop damper entry for the index is dropped so a shrink
         followed by an immediate grow recreates the index promptly."""
-        ekey = expectation_key(job.key, rtype, "pods")
         for pod in sorted(over, key=lambda p: _replica_index(p) or 0,
                           reverse=True):
             index = _replica_index(pod)
@@ -1055,28 +1089,12 @@ class TPUJobController(JobController):
                 self._restart_backoff.pop((job.key, rtype, index), None)
             if pod.metadata.deletion_timestamp:
                 continue  # already terminating: don't re-delete or re-expect
-            self.expectations.expect(ekey, adds=0, dels=1)
             self.flight.record(
                 job.key, "resize",
                 f"drain: deleting {pod.metadata.name} "
                 f"(index {index} >= target {replicas})",
                 {"rtype": rtype, "index": index, "pod": pod.metadata.name})
-            try:
-                self.pod_control.delete_pod(
-                    pod.metadata.namespace, pod.metadata.name, job)
-            except NotFoundError:
-                # already gone: the intended outcome — clear our expectation,
-                # whose DELETED event may have preceded the registration
-                self.expectations.observe_del(ekey)
-            except ServerTimeoutError:
-                # ambiguous 504 (lost response): idempotent — the retry sync
-                # re-derives the remaining drain set from live pods
-                self.expectations.observe_del(ekey)
-            except Exception:
-                # the delete did not happen: clear the expectation so the
-                # retry sync is not gated, and surface the error
-                self.expectations.observe_del(ekey)
-                raise
+            self._delete_pod_no_strike(job, pod, rtype)
 
     def _patch_job_annotations(self, job: TPUJob,
                                annotations: Dict[str, Optional[str]]) -> None:
@@ -1160,6 +1178,138 @@ class TPUJobController(JobController):
              if rolled_back else
              f"resize complete: world size {world} published"),
             {"world": world, "target": target, "rolled_back": rolled_back})
+
+    # ------------------------------------------------------------------
+    # gang-admission gate (native scheduler)
+    # ------------------------------------------------------------------
+
+    def _reconcile_admission(self, job: TPUJob, old_status,
+                             pods: List[Pod],
+                             services: List[Service]) -> Optional[bool]:
+        """The reconciler half of all-or-nothing gang admission.
+
+        Admission state is the scheduler's durable annotation pair:
+        *admitted* = ``sched-assignment`` present without the ``sched-
+        evicted`` marker.  An admitted job proceeds to the normal reconcile
+        (returns None); anything else is held — Queued condition set, every
+        pod evicted (NOT a failure strike: no ``restarts`` increment, no
+        Restarting condition, damper entries popped so a re-admission
+        recreates promptly), status persisted, sync done.  A job the
+        scheduler ruled never-placeable gets a durable Failed condition
+        (``TPUJobUnschedulable``) so an impossible shape cannot wedge the
+        queue."""
+        key = job.key
+        # judged against THIS sync's job object (a pure function of the
+        # modeled pools + the spec): a verdict can never be stale against a
+        # just-fixed spec (Failed is irreversible), and in a sharded fleet
+        # every member fails its own shards' never-placeable jobs without
+        # waiting on the shard-0 decision loop
+        errs = self.scheduler.placement_errors(job)
+        if errs:
+            return self._fail_unschedulable(job, old_status, pods, services,
+                                            errs)
+        ann = job.metadata.annotations or {}
+        admitted = (ann.get(c.ANNOTATION_SCHED_ASSIGNMENT) is not None
+                    and ann.get(c.ANNOTATION_SCHED_EVICTED) is None)
+        if admitted:
+            if st.has_condition(job.status, c.JOB_QUEUED):
+                message = (f"TPUJob {job.metadata.name} admitted: gang "
+                           "placed all-or-nothing "
+                           f"({self.scheduler.request_summary(job)}).")
+                st.mark_condition_false(job.status, c.JOB_QUEUED,
+                                        st.REASON_JOB_ADMITTED, message)
+                self.recorder.event(job, "Normal", st.REASON_JOB_ADMITTED,
+                                    message)
+                self.flight.record(key, "sched", "admitted: gate opened",
+                                   {"kind": "admitted"})
+            return None
+        # -- queued (or being evicted): no pods may run ---------------------
+        preempted = (ann.get(c.ANNOTATION_SCHED_EVICTED) is not None
+                     or bool(pods))
+        reason = (st.REASON_JOB_PREEMPTED if preempted
+                  else st.REASON_JOB_QUEUED)
+        message = (
+            f"TPUJob {job.metadata.name} was preempted; re-queued for "
+            "admission." if preempted else
+            f"TPUJob {job.metadata.name} is queued: waiting for "
+            f"all-or-nothing admission of "
+            f"{self.scheduler.request_summary(job)}.")
+        existing = st.get_condition(job.status, c.JOB_QUEUED)
+        newly = existing is None or existing.status != "True"
+        if newly or (preempted
+                     and existing.reason != st.REASON_JOB_PREEMPTED):
+            # Preempted is sticky for this queued life: once the eviction
+            # markers clear (pods gone, capacity released) the gate must
+            # not downgrade the reason back to plain Queued — the queue
+            # history IS the observability
+            st.update_job_conditions(job.status, c.JOB_QUEUED, reason,
+                                     message)
+        if newly:
+            self.recorder.event(
+                job, "Warning" if preempted else "Normal", reason, message)
+            self.flight.record(key, "sched", message, {"kind": reason})
+        if st.has_condition(job.status, c.JOB_RUNNING):
+            # a preempted job is not running; Queued<->Running exclusion
+            st.mark_condition_false(job.status, c.JOB_RUNNING, reason,
+                                    message)
+        self._evict_pods(job, pods)
+        # a queued job is not RUNNING: its activeDeadlineSeconds clock must
+        # not accrue while it waits for (re-)admission — otherwise a
+        # scheduler eviction converts into a deadline Failure, exactly the
+        # eviction-is-not-a-failure contract this gate exists to keep.
+        # Clearing startTime suspends the clock (the Kueue suspension
+        # semantics); _update_status_single re-stamps it when the admitted
+        # job's pods reconcile, so the deadline counts running time per
+        # admission stint.
+        if job.status.start_time is not None:
+            job.status.start_time = None
+        # a queued job has no heartbeats BY DESIGN: the stall deadline
+        # re-arms every gated sync, so the watchdog can never flip a
+        # Pending-phase job Stalled (it gets one full deadline after
+        # re-admission brings the publisher back)
+        self.telemetry.exempt(key)
+        self._persist_status(job, old_status)
+        return True
+
+    def _evict_pods(self, job: TPUJob, pods: List[Pod]) -> None:
+        """Delete an unadmitted job's pods with the usual expectation
+        bookkeeping.  Scheduler evictions are NOT failure strikes — the
+        drain-deletion stance of the elastic resize applied to whole
+        gangs."""
+        for pod in pods:
+            if pod.metadata.deletion_timestamp:
+                continue  # already terminating: don't re-delete or re-expect
+            label = pod.metadata.labels.get(c.LABEL_REPLICA_TYPE) or ""
+            rtype = next((t for t in job.spec.tpu_replica_specs
+                          if t.lower() == label), label)
+            index = _replica_index(pod)
+            if index is not None and rtype:
+                self._restart_backoff.pop((job.key, rtype, index), None)
+            self.flight.record(
+                job.key, "sched",
+                f"evict: deleting {pod.metadata.name} (gang not admitted)",
+                {"kind": "evict", "pod": pod.metadata.name})
+            self._delete_pod_no_strike(job, pod, rtype)
+
+    def _fail_unschedulable(self, job: TPUJob, old_status, pods, services,
+                            errs: List[str]) -> bool:
+        """Durable verdict for a never-placeable gang: the job can NEVER
+        run on the modeled fleet, so it fails visibly at admission instead
+        of wedging the queue head forever (the malformed-CR stance applied
+        to capacity shapes)."""
+        message = (f"TPUJob {job.metadata.name} is unschedulable: "
+                   + "; ".join(errs))
+        logger_for_job(log, job).info(message)
+        self._delete_pods_and_services(job, pods, services)
+        self.recorder.event(job, "Warning", st.REASON_JOB_UNSCHEDULABLE,
+                            message)
+        if job.status.completion_time is None:
+            job.status.completion_time = st.now_iso()
+        st.update_job_conditions(job.status, c.JOB_FAILED,
+                                 st.REASON_JOB_UNSCHEDULABLE, message)
+        metrics.jobs_failed.inc()
+        self._persist_status(job, old_status)
+        return True
 
     # ------------------------------------------------------------------
     # workload telemetry: heartbeat ingestion + the stall watchdog
@@ -1301,10 +1451,20 @@ class TPUJobController(JobController):
 
     def _telemetry_exempt(self, job: TPUJob, pods: List[Pod]) -> Optional[str]:
         """Why a heartbeat gap is currently unaccountable (None = it counts):
-        resize staging in flight, a counted restart in progress, or replica
+        resize staging in flight, a counted restart in progress, replica
         churn (missing/non-Running pods — preemption, node loss, a watchdog
-        restart itself)."""
+        restart itself), or the job sitting unadmitted in the gang
+        scheduler's queue (a queued job has no heartbeats by design; it
+        must never flip Stalled)."""
         ann = job.metadata.annotations or {}
+        if self.scheduler is not None and (
+                ann.get(c.ANNOTATION_SCHED_ASSIGNMENT) is None
+                or ann.get(c.ANNOTATION_SCHED_EVICTED) is not None):
+            return "queued"
+        if ann.get(c.ANNOTATION_PREEMPT_TARGET) is not None:
+            # paused at the preemption checkpoint barrier: the step is
+            # frozen BY DESIGN until the eviction lands
+            return "preempt"
         if (job.status.resize is not None
                 or ann.get(c.ANNOTATION_TARGET_WORLD_SIZE) is not None
                 or st.has_condition(job.status, c.JOB_RESIZING)):
@@ -1360,26 +1520,15 @@ class TPUJobController(JobController):
         if pod is None or pod.metadata.deletion_timestamp:
             return
         rtype = pod.metadata.labels.get(c.LABEL_REPLICA_TYPE) or ""
-        ekey = expectation_key(job.key, rtype, "pods")
-        self.expectations.expect(ekey, adds=0, dels=1)
         self.flight.record(
             job.key, "progress",
             f"watchdog restart: deleting stuck replica {pod.metadata.name}",
             {"pod": pod.metadata.name, "rtype": rtype})
-        try:
-            self.pod_control.delete_pod(
-                pod.metadata.namespace, pod.metadata.name, job)
-        except NotFoundError:
-            self.expectations.observe_del(ekey)
-        except ServerTimeoutError:
-            # ambiguous 504: either way the episode acted once — idempotent
-            # because restart_fired is set below only on this path too
-            self.expectations.observe_del(ekey)
-        except Exception:
-            # the delete did not happen: clear the expectation and leave
-            # restart_fired unset so the next tick retries it
-            self.expectations.observe_del(ekey)
-            raise
+        # the shared no-strike ladder: an ambiguous 504 still counts the
+        # episode as acted (idempotent — restart_fired is set below only
+        # when the ladder did not raise); a genuinely failed delete raises
+        # and leaves restart_fired unset so the next tick retries
+        self._delete_pod_no_strike(job, pod, rtype)
         self.telemetry.note_restart_fired(job.key)
         metrics.watchdog_restarts.inc()
         self.recorder.event(
@@ -1422,13 +1571,18 @@ class TPUJobController(JobController):
             owned = getattr(self.sharder, "owned_shards", None)
             if callable(owned):
                 shards = sorted(owned())
-        return {
+        out = {
             "identity": identity,
             "shards": shards,
             "stall_timeout_s": self.config.stall_timeout_s,
             "stall_policy": self.config.stall_policy,
             "jobs": self.telemetry.snapshot(),
         }
+        if self.scheduler is not None:
+            # queue positions + admission decisions + capacity utilization:
+            # the scrape-merge twin of the tpujob_scheduler_* series
+            out["scheduler"] = self.scheduler.debug_snapshot()
+        return out
 
     def debug_job_state(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
         """Controller-owned state merged into ``/debug/jobs/<ns>/<name>``:

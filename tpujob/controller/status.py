@@ -34,6 +34,11 @@ REASON_RESIZE_ROLLED_BACK = "TPUJobResizeRolledBack"
 # progress watchdog (workload telemetry plane)
 REASON_JOB_STALLED = "TPUJobStalled"
 REASON_PROGRESS_RESUMED = "TPUJobProgressResumed"
+# native gang scheduler (all-or-nothing admission queue + preemption)
+REASON_JOB_QUEUED = "TPUJobQueued"
+REASON_JOB_ADMITTED = "TPUJobAdmitted"
+REASON_JOB_PREEMPTED = "TPUJobPreempted"
+REASON_JOB_UNSCHEDULABLE = "TPUJobUnschedulable"
 
 
 def get_condition(status: JobStatus, cond_type: str) -> Optional[JobCondition]:
@@ -99,12 +104,13 @@ def set_condition(status: JobStatus, condition: JobCondition) -> None:
         elif condition.type == c.JOB_RESTARTING:
             conditions = _filter_out(conditions, c.JOB_RUNNING)
         elif condition.type in (c.JOB_SUCCEEDED, c.JOB_FAILED):
-            # a finished job is neither running, nor mid-resize, nor stalled:
-            # flip all three to False (history preserved) rather than
-            # dropping them
+            # a finished job is neither running, nor mid-resize, nor stalled,
+            # nor waiting in the admission queue: flip all four to False
+            # (history preserved) rather than dropping them
             for cond in conditions:
                 if cond.type in (c.JOB_RUNNING, c.JOB_RESIZING,
-                                 c.JOB_STALLED) and cond.status == "True":
+                                 c.JOB_STALLED, c.JOB_QUEUED) \
+                        and cond.status == "True":
                     cond.status = "False"
                     cond.last_transition_time = condition.last_transition_time
                     cond.last_update_time = condition.last_update_time

@@ -174,13 +174,14 @@ class InMemoryAPIServer:
         self._enable_gc = enable_gc
         # hooks: callables invoked (event_type, resource, obj_dict) after commit
         self.hooks: List[Callable[[str, str, Dict[str, Any]], None]] = []
-        # admission validators for UPDATE/PATCH: callables
+        # admission validators for CREATE/UPDATE/PATCH: callables
         # (verb, resource, old_obj, new_obj) raising InvalidError to reject
         # the write BEFORE it commits (the ValidatingAdmissionWebhook role —
         # e.g. TPUJob update admission: immutable fields, master replica
-        # count).  Append at setup, before serving traffic; invoked under
-        # the server lock, so validators must be pure (no API calls) and
-        # treat both objects as read-only.
+        # count; CREATE admission: never-placeable topology shapes, with
+        # old_obj=None).  Append at setup, before serving traffic; invoked
+        # under the server lock, so validators must be pure (no API calls)
+        # and treat both objects as read-only.
         self.admission_validators: List[
             Callable[[str, str, Dict[str, Any], Dict[str, Any]], None]] = []
         # pod log store: (ns, pod_name) -> text, fed by the simulated kubelet
@@ -430,6 +431,10 @@ class InMemoryAPIServer:
             store = self._store(resource)
             if key in store.objects:
                 raise AlreadyExistsError(f"{resource} {key[0]}/{key[1]} already exists")
+            # CREATE admission (old=None distinguishes it from updates):
+            # e.g. a TPUJob whose topology shape can never be placed is a
+            # 422 at the boundary, not a Failed condition after the fact
+            self._admit("create", resource, None, obj)
             meta = obj.setdefault("metadata", {})
             meta.setdefault("namespace", key[0])
             meta["uid"] = meta.get("uid") or str(uuid.uuid4())

@@ -169,6 +169,25 @@ class OperatorApp:
             self.coordinator.on_shard_prepare = self.controller.prepare_shard
             self.coordinator.on_shard_acquired = self.controller.on_shard_acquired
             self.coordinator.on_shard_drain = self.controller.drain_shard
+        self.scheduler = None
+        if opt.scheduler_capacity:
+            # native gang scheduler (--sched-capacity): an admission queue
+            # in front of the reconciler — jobs hold no pods until their
+            # whole gang places all-or-nothing against the modeled slice
+            # capacity.  The decision loop starts with the controller (it
+            # needs synced informer caches) and, in a sharded fleet, only
+            # runs ticks on the member owning the scheduler shard.
+            from tpujob.server.scheduler import GangScheduler
+
+            self.scheduler = GangScheduler(
+                self.controller,
+                capacity=opt.scheduler_capacity,
+                tick_s=opt.scheduler_tick_s,
+                aging_s=opt.scheduler_aging_s,
+                enable_preemption=opt.scheduler_preemption,
+                preempt_grace_s=opt.scheduler_preempt_grace_s,
+            )
+            self.controller.set_scheduler(self.scheduler)
         self.monitoring: Optional[MonitoringServer] = None
         self.stop_event = threading.Event()
         self.controller_threads: list = []
@@ -200,6 +219,12 @@ class OperatorApp:
                      if self.coordinator is not None else "")
             self.controller_threads = self.controller.run(
                 self.stop_event, threadiness=self.opt.threadiness)
+            if self.scheduler is not None:
+                # behind the cache-sync barrier like the workers: the first
+                # tick must see the full durable assignment state, never a
+                # half-filled cache that would double-book capacity
+                self.controller_threads.append(
+                    self.scheduler.start(self.stop_event))
 
         def started_leading():
             try:

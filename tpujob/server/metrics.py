@@ -541,6 +541,40 @@ job_stalled = LabeledGauge(
     REGISTRY,
     _JOB_LABELS,
 )
+# Gang-scheduler series (the native admission queue): how deep the queue
+# is, how much admission throughput the fleet sustains, how long gangs wait
+# for their all-or-nothing placement, and how often preemption fired.  Only
+# the instance holding the scheduler duty (shard 0's owner in a sharded
+# fleet) moves these.
+sched_queue_depth = Gauge(
+    "tpujob_scheduler_queue_depth",
+    "Feasible gangs currently waiting in the admission queue (sampled once "
+    "per scheduler tick; infeasible jobs are rejected, not queued)",
+    REGISTRY,
+)
+sched_admissions = Counter(
+    "tpujob_scheduler_admissions_total",
+    "Gangs admitted all-or-nothing against the modeled slice capacity "
+    "(each is one committed sched-assignment annotation)",
+    REGISTRY,
+)
+sched_preemptions = Counter(
+    "tpujob_scheduler_preemptions_total",
+    "Preemptions staged by the scheduler (each publishes a preempt-target "
+    "and runs the bounded checkpoint barrier before eviction)",
+    REGISTRY,
+)
+sched_admission_wait = Histogram(
+    "tpujob_scheduler_admission_wait_seconds",
+    "Time a gang waited in the admission queue before its all-or-nothing "
+    "placement committed",
+    REGISTRY,
+    # admission waits are queue-scale, not cache-hit scale: an oversubscribed
+    # fleet holds gangs for minutes-to-hours behind aging + preemption
+    buckets=(0.05, 0.25, 1.0, 5.0, 15.0, 60.0, 300.0, 900.0, 3600.0,
+             14400.0),
+)
+
 jobs_stalled = Counter(
     "tpujob_operator_stalled_jobs_total",
     "Stalled-condition flips by the progress watchdog (each is one detected "

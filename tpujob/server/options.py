@@ -88,6 +88,20 @@ class ServerOption:
     stall_timeout_s: float = 600.0
     stall_policy: str = "event"  # "event" | "restart"
     stall_check_interval_s: float = 0.0  # <= 0 derives stall_timeout / 4
+    # native gang scheduler: modeled fleet capacity as slice pools, e.g.
+    # "v4-32x4" or "v4-16x2,v5e-16x1".  Non-empty enables the admission
+    # queue: jobs hold NO pods until the scheduler places their whole gang
+    # all-or-nothing; "" disables (the pre-scheduler behavior).
+    scheduler_capacity: str = ""
+    scheduler_tick_s: float = 0.2  # decision-loop cadence
+    # aging promotion: a queued gang's effective tier rises one level per
+    # this many seconds waited (anti-starvation bound; <= 0 disables)
+    scheduler_aging_s: float = 60.0
+    # preempt lower-tier gangs under pressure (checkpoint barrier first)
+    scheduler_preemption: bool = True
+    # how long the preemption checkpoint barrier waits for the workload's
+    # ack before evicting anyway (<= 0 evicts immediately)
+    scheduler_preempt_grace_s: float = 5.0
 
 
 class _LazyVersionAction(argparse.Action):
@@ -246,6 +260,33 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         dest="stall_check_interval_s",
                         help="watchdog re-check cadence in seconds "
                              "(<=0 derives stall-timeout / 4)")
+    parser.add_argument("--sched-capacity", default="",
+                        dest="scheduler_capacity",
+                        help="enable the native gang scheduler with this "
+                             "modeled slice capacity (e.g. 'v4-32x4' or "
+                             "'v4-16x2,v5e-16x1'); jobs then queue for "
+                             "all-or-nothing admission ('' disables)")
+    parser.add_argument("--sched-tick", type=float, default=0.2,
+                        dest="scheduler_tick_s",
+                        help="gang-scheduler decision-loop cadence (s)")
+    parser.add_argument("--sched-aging", type=float, default=60.0,
+                        dest="scheduler_aging_s",
+                        help="aging promotion: a queued gang gains one "
+                             "priority tier per this many seconds waited "
+                             "(anti-starvation bound; <=0 disables)")
+    parser.add_argument("--sched-preemption", dest="scheduler_preemption",
+                        action="store_true", default=True,
+                        help="preempt lower-tier gangs under pressure, "
+                             "checkpoint barrier first (default on)")
+    parser.add_argument("--no-sched-preemption", dest="scheduler_preemption",
+                        action="store_false",
+                        help="disable preemption (queued gangs wait for "
+                             "capacity to free naturally)")
+    parser.add_argument("--sched-preempt-grace", type=float, default=5.0,
+                        dest="scheduler_preempt_grace_s",
+                        help="seconds the preemption checkpoint barrier "
+                             "waits for the workload's ack before evicting "
+                             "anyway (<=0 evicts immediately)")
 
 
 def parse_options(argv: Optional[List[str]] = None) -> ServerOption:

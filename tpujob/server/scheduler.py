@@ -1,0 +1,950 @@
+"""Native gang scheduler: all-or-nothing admission, fair share, preemption.
+
+The upstream operator punts gang scheduling to kube-batch (it only renders a
+PodGroup, ``jobcontroller.go:224-278``) — nothing in the repo decided WHICH
+job runs WHERE or WHEN, so an oversubscribed fleet wedged capacity with
+partially-created gangs and starved low-priority jobs forever.  This module
+is the native replacement: an admission queue in front of the reconciler.
+
+Contract:
+
+- **All-or-nothing admission.**  A job enters the queue the moment it is
+  created (the reconciler's admission gate holds its pods back); the
+  scheduler admits the WHOLE gang against a modeled fleet of TPU slices
+  (``--sched-capacity``, e.g. ``v4-16x2``) or not at all.  The admission is
+  one durable annotation write (``tpujob.dev/sched-assignment``), so there
+  is no instant at which a gang holds part of its capacity.
+- **Topology-aware placement.**  Each gang slice packs onto torus-adjacent
+  hosts of one fleet slice (contiguous intervals of the snake host order,
+  ``api/quota.py``); multislice gangs take distinct slices of one pool.
+  Never-placeable shapes are rejected at admission with a durable Failed
+  condition (written by the reconciler gate) — an infeasible gang cannot
+  wedge the queue.
+- **Priority tiers + fair share + aging.**  Queue order is effective tier
+  (declared tier promoted one level per ``--sched-aging`` waited — the
+  anti-starvation bound), then per-namespace dominant-share (chips of the
+  modeled fleet), then FIFO.
+- **Checkpoint-aware preemption.**  Under pressure a higher-tier gang
+  preempts lower-tier victims chosen by lowest goodput cost (steps past
+  their last checkpoint, from the PR-10 progress tracker).  Eviction is the
+  PR-9 drain protocol re-aimed: publish ``tpujob.dev/preempt-target``, wait
+  the bounded checkpoint barrier (workload ack / telemetry checkpoint
+  catch-up / grace), then mark ``tpujob.dev/sched-evicted`` — the
+  reconciler deletes the pods (NOT failure strikes) and the capacity is
+  released only once the last pod is gone, so a re-admission can never land
+  on hosts the victim still occupies.
+- **Crash/handoff resumability.**  Every decision is an annotation already
+  committed; each tick re-derives the whole capacity model from the
+  informer cache (the PR-9 staging-record stance).  In a sharded fleet the
+  scheduler duty rides shard 0: only the member owning it runs ticks, and
+  every write carries shard 0's fencing token so a deposed scheduler is
+  rejected server-side.
+"""
+from __future__ import annotations
+
+import calendar
+import collections
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpujob.analysis import lockgraph
+from tpujob.api import constants as c
+from tpujob.api.defaults import set_defaults_tpujob
+from tpujob.api.quota import (
+    GangRequest,
+    SlicePoolSpec,
+    TIER_MAX,
+    capacity_chips,
+    effective_tier,
+    feasibility_errors,
+    gang_request,
+    namespace_share,
+    parse_capacity,
+    pool_fits,
+    queue_sort_key,
+)
+from tpujob.api.topology import TopologyError
+from tpujob.api.types import TPUJob
+from tpujob.controller import status as st
+from tpujob.kube.client import RESOURCE_TPUJOBS
+from tpujob.kube.control import gen_labels
+from tpujob.kube.errors import ApiError, NotFoundError
+from tpujob.kube.informers import INDEX_JOB_NAME
+from tpujob.server import metrics
+
+log = logging.getLogger("tpujob.scheduler")
+
+# In a sharded fleet the scheduler duty rides this shard: the member owning
+# it runs the decision loop, and every admission write carries its fencing
+# token (a deposed scheduler's writes die server-side, the PR-8 contract).
+SCHEDULER_SHARD = 0
+
+
+def _parse_wall(ts: Optional[str]) -> Optional[float]:
+    if not ts:
+        return None
+    try:
+        return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+    except ValueError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# placements
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SlicePlacement:
+    pool: int  # index into the capacity pools
+    slice_index: int  # which slice of the pool
+    host_lo: int  # first host (inclusive) in snake order
+    host_hi: int  # last host (exclusive)
+
+
+@dataclass(frozen=True)
+class Assignment:
+    """One admitted gang's placement — the payload of the durable
+    ``tpujob.dev/sched-assignment`` annotation."""
+
+    accelerator: str  # pool accelerator the gang landed on
+    slices: Tuple[SlicePlacement, ...]
+    chips: int  # modeled chip cost (dominant-share accounting)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "accelerator": self.accelerator,
+            "chips": self.chips,
+            "slices": [{"pool": s.pool, "slice": s.slice_index,
+                        "hosts": [s.host_lo, s.host_hi]} for s in self.slices],
+        }, sort_keys=True, separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, raw: str) -> Optional["Assignment"]:
+        try:
+            d = json.loads(raw)
+            slices = tuple(
+                SlicePlacement(pool=int(s["pool"]),
+                               slice_index=int(s["slice"]),
+                               host_lo=int(s["hosts"][0]),
+                               host_hi=int(s["hosts"][1]))
+                for s in d["slices"])
+            return cls(accelerator=str(d.get("accelerator") or ""),
+                       slices=slices, chips=int(d.get("chips") or 0))
+        except (ValueError, KeyError, TypeError):
+            return None
+
+
+class CapacityModel:
+    """Host-interval occupancy over the modeled slice pools.
+
+    Hosts of one slice are numbered along the snake order (``api/quota``),
+    so a contiguous ``[lo, hi)`` interval IS a torus-adjacent host path;
+    allocation is first-fit contiguous per slice.  Single-threaded by
+    design: only the scheduler tick mutates a model, and the preemption
+    planner works on :meth:`clone` copies.
+    """
+
+    def __init__(self, pools: List[SlicePoolSpec]):
+        self.pools = pools
+        # (pool, slice) -> sorted [lo, hi) intervals with their owner keys
+        self._used: Dict[Tuple[int, int], List[Tuple[int, int, str]]] = {}
+
+    def clone(self) -> "CapacityModel":
+        out = CapacityModel(self.pools)
+        out._used = {k: list(v) for k, v in self._used.items()}
+        return out
+
+    def reserve(self, owner: str, asg: Assignment) -> List[str]:
+        """Re-reserve a durable assignment while rebuilding the model from
+        the informer cache.  Returns any conflicts found (an overlap means
+        corrupt state — two committed assignments share hosts — which the
+        tick reports loudly but does not amplify with more writes)."""
+        problems: List[str] = []
+        for s in asg.slices:
+            if s.pool >= len(self.pools) \
+                    or s.slice_index >= self.pools[s.pool].count \
+                    or s.host_hi > self.pools[s.pool].shape.hosts \
+                    or s.host_lo < 0 or s.host_lo >= s.host_hi:
+                problems.append(
+                    f"{owner}: assignment {s} exceeds the modeled capacity")
+                continue
+            ivals = self._used.setdefault((s.pool, s.slice_index), [])
+            for lo, hi, other in ivals:
+                if s.host_lo < hi and lo < s.host_hi:
+                    problems.append(
+                        f"{owner}: hosts [{s.host_lo},{s.host_hi}) of pool "
+                        f"{s.pool} slice {s.slice_index} overlap {other} "
+                        f"[{lo},{hi})")
+            ivals.append((s.host_lo, s.host_hi, owner))
+            ivals.sort()
+        return problems
+
+    def release(self, owner: str) -> None:
+        for key, ivals in list(self._used.items()):
+            kept = [iv for iv in ivals if iv[2] != owner]
+            if kept:
+                self._used[key] = kept
+            else:
+                self._used.pop(key, None)
+
+    def _free_interval(self, pool: int, slice_index: int,
+                       need: int) -> Optional[int]:
+        """First-fit contiguous free interval of ``need`` hosts (snake
+        order = torus-adjacent), or None."""
+        hosts = self.pools[pool].shape.hosts
+        cursor = 0
+        for lo, hi, _ in self._used.get((pool, slice_index), []):
+            if lo - cursor >= need:
+                return cursor
+            cursor = max(cursor, hi)
+        if hosts - cursor >= need:
+            return cursor
+        return None
+
+    def place(self, req: GangRequest, owner: str) -> Optional[Assignment]:
+        """All-or-nothing placement: ``num_slices`` distinct slices of ONE
+        pool, each with a torus-adjacent run of ``hosts_per_slice`` hosts.
+        Mutates the model on success; touches nothing on failure — no gang
+        is ever partially placed."""
+        for pi, pool in enumerate(self.pools):
+            if not pool_fits(req, pool):
+                continue
+            found: List[SlicePlacement] = []
+            for si in range(pool.count):
+                lo = self._free_interval(pi, si, req.hosts_per_slice)
+                if lo is None:
+                    continue
+                found.append(SlicePlacement(
+                    pool=pi, slice_index=si,
+                    host_lo=lo, host_hi=lo + req.hosts_per_slice))
+                if len(found) == req.num_slices:
+                    break
+            if len(found) < req.num_slices:
+                continue
+            asg = Assignment(accelerator=pool.accelerator,
+                             slices=tuple(found),
+                             chips=req.chips_on(pool))
+            for s in found:
+                ivals = self._used.setdefault((s.pool, s.slice_index), [])
+                ivals.append((s.host_lo, s.host_hi, owner))
+                ivals.sort()
+            return asg
+        return None
+
+    def used_hosts(self) -> int:
+        return sum(hi - lo for ivals in self._used.values()
+                   for lo, hi, _ in ivals)
+
+    def total_hosts(self) -> int:
+        return sum(p.count * p.shape.hosts for p in self.pools)
+
+
+# ---------------------------------------------------------------------------
+# the scheduler
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Admitted:
+    key: str
+    namespace: str
+    name: str
+    tier: int
+    assignment: Assignment
+    evicting: bool  # eviction marker set: pods being vacated
+    preempting: bool  # preempt target published, barrier pending
+    ann: Dict[str, str] = field(repr=False, default_factory=dict)
+
+
+class GangScheduler:
+    """The admission decision loop.  One instance rides one controller; the
+    reconciler consults it (``unschedulable_errors``, ``queue_position``)
+    and holds pods back for unadmitted jobs (the admission gate)."""
+
+    def __init__(
+        self,
+        controller,
+        capacity: str,
+        tick_s: float = 0.2,
+        aging_s: float = 60.0,
+        enable_preemption: bool = True,
+        preempt_grace_s: float = 5.0,
+    ):
+        self.controller = controller
+        self.pools = parse_capacity(capacity)
+        self.fleet_chips = capacity_chips(self.pools)
+        self.tick_s = tick_s
+        self.aging_s = aging_s
+        self.enable_preemption = enable_preemption
+        self.preempt_grace_s = preempt_grace_s
+        self._lock = lockgraph.new_lock("gang-scheduler")
+        # never-placeable verdicts keyed to the spec generation they were
+        # computed against, consumed by the reconciler gate (which writes
+        # the durable Failed condition).  Generation-keyed so a legal spec
+        # fix racing the tick can never be failed on a verdict for the OLD
+        # shape — Failed is irreversible.
+        self._unschedulable: Dict[str, Tuple[int, List[str]]] = {}  # guarded by self._lock
+        # per-incarnation queue anchors (durable floor: the Queued
+        # condition's transition timestamp)
+        self._queued_anchor: Dict[str, float] = {}  # guarded by self._lock
+        # per-incarnation preemption barrier anchors (durable floor: the
+        # preempt-target annotation's timestamp)
+        self._preempt_anchor: Dict[str, float] = {}  # guarded by self._lock
+        # admissions written but not yet echoed by the informer cache: the
+        # scheduler's expectations ledger.  A rebuild from a cache that
+        # trails our own committed admission would see those hosts as free
+        # and double-book them — exactly the partial/overlapping placement
+        # the all-or-nothing contract forbids.  Entries retire when the
+        # cache shows the assignment (or the job vanished).
+        self._pending_admissions: Dict[str, Assignment] = {}  # guarded by self._lock
+        # gang requests cached by (uid, generation): the request is a pure
+        # function of the spec, and generation bumps exactly when the spec
+        # changes — so the heavyweight dataclass parse runs once per spec
+        # revision, not once per job per tick (pruned with the other maps)
+        self._req_cache: Dict[Tuple, Tuple[Optional[GangRequest], Optional[str]]] = {}  # guarded by self._lock
+        # release patches already committed, keyed by the assignment value
+        # they released: until the cache echoes the removal, every tick
+        # would otherwise re-issue the same idempotent patch — pure write
+        # amplification under load.  An entry retires when the cache shows
+        # the annotation gone (or a NEW assignment value, a re-admission).
+        self._release_sent: Dict[str, str] = {}  # guarded by self._lock
+        # preempt-target publishes committed but not yet echoed by the
+        # cache: dedups the publish (a re-issue from a stale-cache tick
+        # would wipe an ack the workload just wrote) and marks the victim
+        # in-flight for the preemption planner across the echo window
+        self._preempt_sent: set = set()  # guarded by self._lock
+        # queue positions of the last tick (debug + /debug/fleet)
+        self._queue_view: List[Dict[str, Any]] = []  # guarded by self._lock
+        self._decisions: collections.deque = collections.deque(maxlen=64)  # guarded by self._lock
+        self._tick_durations: collections.deque = collections.deque(maxlen=512)  # guarded by self._lock
+        self.admissions = 0  # guarded by self._lock; lifetime admission count
+        self.preemptions = 0  # guarded by self._lock; lifetime preemption count
+        self._thread: Optional[threading.Thread] = None
+
+    # -- surface consumed by the reconciler gate -----------------------------
+
+    def placement_errors(self, job: TPUJob) -> Optional[List[str]]:
+        """Feasibility verdict for the exact job object the caller holds —
+        a pure function of the modeled pools and the spec, so every fleet
+        member's admission gate judges its own shards' jobs locally
+        (without waiting for, or racing, the shard-0 decision loop), and a
+        verdict can never be stale against the spec it is applied to."""
+        try:
+            req = gang_request(job)
+        except TopologyError:
+            return None  # unresolvable: strict validation fails it
+        return feasibility_errors(req, self.pools) or None
+
+    def unschedulable_errors(self, key: str,
+                             generation: Optional[int] = None
+                             ) -> Optional[List[str]]:
+        """The durable-verdict feed: why this job can never be placed
+        (None = feasible, or not yet examined).  ``generation`` is the
+        spec generation of the job the CALLER is holding: a verdict
+        computed against any other generation answers None — the spec
+        changed under the tick, and the next tick re-judges the new shape
+        (an irreversible Failed must never land on a stale verdict)."""
+        with self._lock:
+            entry = self._unschedulable.get(key)
+            if entry is None:
+                return None
+            gen, errs = entry
+            if generation is not None and gen != generation:
+                return None
+            return list(errs) if errs else None
+
+    def queue_position(self, key: str) -> Optional[int]:
+        with self._lock:
+            for row in self._queue_view:
+                if row["job"] == key:
+                    return row["position"]
+            return None
+
+    def request_summary(self, job: TPUJob) -> str:
+        try:
+            req = gang_request(job)
+        except TopologyError as e:
+            return f"unresolvable shape ({e})"
+        what = req.accelerator or "any-slice"
+        return (f"{req.num_slices} slice(s) of {what} x "
+                f"{req.hosts_per_slice} host(s)")
+
+    # -- run loop ------------------------------------------------------------
+
+    def start(self, stop_event: threading.Event) -> threading.Thread:
+        # start before publish: a shutdown racing construction must never
+        # join a created-but-unstarted Thread (TPL001)
+        thread = threading.Thread(target=self.run, args=(stop_event,),
+                                  daemon=True, name="gang-scheduler")
+        thread.start()
+        self._thread = thread
+        return thread
+
+    def run(self, stop_event: threading.Event) -> None:
+        while not stop_event.wait(self.tick_s):
+            try:
+                self.tick()
+            except Exception:
+                # a scheduler tick must never die permanently: transient
+                # transport faults retry next tick (the coordinator's rule)
+                log.exception("scheduler tick failed; retrying next tick")
+
+    def _active(self) -> bool:
+        """Whether this instance currently holds the scheduler duty: the
+        owner of SCHEDULER_SHARD in a sharded fleet, everyone otherwise
+        (single-leader instances only run the thread while leading)."""
+        sharder = self.controller.sharder
+        if sharder is None:
+            return True
+        return sharder.is_active(SCHEDULER_SHARD)
+
+    # -- the decision tick ---------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One admission pass.  Stateless-by-rebuild: the capacity model,
+        the queue, and every in-flight preemption are re-derived from the
+        informer cache (committed annotations), so a crashed or
+        rebalanced-in scheduler resumes mid-protocol for free."""
+        if not self._active():
+            # the scheduler duty left this member (shard-0 handoff): its
+            # gauge must not keep exporting the last owned tick's depth
+            # next to the new owner's live value — the one-exporter
+            # discipline the tpujob_job_* families follow on handoff.
+            # Every per-decision ledger drops too: another member owns the
+            # protocol now, and replaying OUR stale pending/sent entries
+            # after regaining the duty would evict healthy re-admitted
+            # gangs (a phantom in-flight preemption) or reserve phantom
+            # hosts (a pending admission the interim owner released).  The
+            # durable annotations are the truth the regained duty rebuilds
+            # from.
+            metrics.sched_queue_depth.set(0)
+            with self._lock:
+                self._queue_view = []
+                self._pending_admissions.clear()
+                self._release_sent.clear()
+                self._preempt_sent.clear()
+                self._queued_anchor.clear()
+                self._preempt_anchor.clear()
+            return {"active": False}
+        t0 = time.monotonic()
+        now = t0 if now is None else now
+        shard = (SCHEDULER_SHARD if self.controller.sharder is not None
+                 else None)
+        with self.controller._shard_call_context(shard):
+            report = self._tick_inner(now)
+        dur = time.monotonic() - t0
+        with self._lock:
+            self._tick_durations.append(dur)
+        return report
+
+    def _tick_inner(self, now: float) -> Dict[str, Any]:
+        now_wall = time.time()
+        cap = CapacityModel(self.pools)
+        admitted: List[_Admitted] = []
+        queued: List[Tuple[GangRequest, str, str, str, float]] = []
+        ns_chips: Dict[str, float] = {}
+        seen: set = set()
+        live_req_keys: set = set()
+        conflicts: List[str] = []
+        unschedulable: Dict[str, List[str]] = {}
+
+        for obj in self.controller.job_informer.store.list():
+            meta = obj.get("metadata") or {}
+            name = meta.get("name")
+            if not name:
+                continue
+            ns = meta.get("namespace") or "default"
+            key = f"{ns}/{name}"
+            seen.add(key)
+            ann = meta.get("annotations") or {}
+            raw = ann.get(c.ANNOTATION_SCHED_ASSIGNMENT)
+            if self._finished(obj):
+                if raw is not None:
+                    # a finished gang holds no chips: release its capacity
+                    # (once per assignment value — the echo retires it)
+                    self._release(key, ns, name, raw, "release (job finished)")
+                continue
+            req, ck = self._request_for(obj)
+            live_req_keys.add(ck)
+            if raw is not None:
+                # the cache caught up with (or superseded) any admission we
+                # wrote for this job: the durable record takes over
+                with self._lock:
+                    self._pending_admissions.pop(key, None)
+                asg = Assignment.from_json(raw)
+                if asg is None:
+                    log.warning("%s: corrupt sched-assignment %r; dropping "
+                                "(the gate re-queues the job)", key, raw)
+                    self._patch(ns, name,
+                                {c.ANNOTATION_SCHED_ASSIGNMENT: None},
+                                "drop corrupt assignment")
+                    continue
+                conflicts.extend(cap.reserve(key, asg))
+                preempting = ann.get(c.ANNOTATION_PREEMPT_TARGET) is not None
+                with self._lock:
+                    if preempting:
+                        # the publish echoed: the dedup entry retires
+                        self._preempt_sent.discard(key)
+                    elif key in self._preempt_sent:
+                        # our committed publish, not yet echoed: the victim
+                        # IS in flight (the planner must not re-pick it,
+                        # and the publish must not re-issue and wipe a
+                        # just-written ack)
+                        preempting = True
+                entry = _Admitted(
+                    key=key, namespace=ns, name=name,
+                    tier=req.tier if req is not None else 0,
+                    assignment=asg,
+                    evicting=ann.get(c.ANNOTATION_SCHED_EVICTED) is not None,
+                    preempting=preempting,
+                    ann=ann)
+                admitted.append(entry)
+                if not entry.evicting:
+                    ns_chips[entry.namespace] = (
+                        ns_chips.get(entry.namespace, 0.0) + asg.chips)
+                if (req is not None and not entry.evicting
+                        and not entry.preempting
+                        and self._outgrew(req, asg)):
+                    # an admitted gang's spec GREW past its committed
+                    # placement (an elastic resize of an unpinned gang —
+                    # UPDATE admission allows it, and the PR-9 pre-pass
+                    # would happily create the extra pods): the assignment
+                    # no longer covers the gang, which would silently
+                    # overcommit the modeled fleet.  Re-place it through
+                    # the normal checkpoint-barrier eviction; the re-queued
+                    # job re-admits at its new shape when capacity allows.
+                    if self._patch(ns, name, {
+                            c.ANNOTATION_PREEMPT_TARGET: st.now_iso(),
+                            c.ANNOTATION_PREEMPT_ACK: None},
+                            "re-place (gang grew past its assignment)"):
+                        with self._lock:
+                            self._preempt_sent.add(key)
+                        entry.preempting = True
+                        self._note("re-place", key,
+                                   "spec grew past the committed "
+                                   "assignment; re-queueing at the new "
+                                   "shape")
+                        self.controller.enqueue_job(key)
+                self._advance_eviction(entry, now, now_wall)
+                continue
+            # -- unadmitted: queue or reject ---------------------------------
+            with self._lock:
+                # the cache shows the annotations gone: any release we
+                # sent has echoed — retire the dedup entries
+                self._release_sent.pop(key, None)
+                self._preempt_sent.discard(key)
+                pend = self._pending_admissions.get(key)
+            if pend is not None:
+                # our own committed admission, not yet echoed by the cache:
+                # its hosts are NOT free, and the job is NOT queued
+                conflicts.extend(cap.reserve(key, pend))
+                continue
+            if req is None:
+                continue  # unresolvable/malformed: the sync fails it
+            errs = feasibility_errors(req, self.pools)
+            if errs:
+                unschedulable[key] = (
+                    int(meta.get("generation") or 0), errs)
+                continue
+            queued.append((req, key, ns, name,
+                           self._queued_since(key, obj, now, now_wall)))
+
+        # surface fresh never-placeable verdicts (the reconciler gate writes
+        # the durable condition) and enqueue their syncs
+        with self._lock:
+            new_unsched = [k for k, v in unschedulable.items()
+                           if self._unschedulable.get(k) != v]
+            self._unschedulable = unschedulable
+            # prune per-incarnation anchors of jobs that left the cluster
+            for d in (self._queued_anchor, self._preempt_anchor,
+                      self._pending_admissions, self._release_sent):
+                for k in [k for k in d if k not in seen]:
+                    d.pop(k, None)
+            self._preempt_sent &= seen
+            for k in [k for k in self._req_cache if k not in live_req_keys]:
+                self._req_cache.pop(k, None)
+        for k in new_unsched:
+            self._note("unschedulable", k, "; ".join(unschedulable[k][1]))
+            self.controller.enqueue_job(k)
+
+        # queue order: effective tier desc, fair share asc, FIFO
+        entries = []
+        for req, key, ns, name, since in queued:
+            eff = effective_tier(req.tier, now - since, self.aging_s)
+            share = namespace_share(ns_chips.get(req.namespace, 0.0),
+                                    self.fleet_chips)
+            entries.append((queue_sort_key(req, eff, share, since),
+                            req, key, ns, name, since, eff))
+        entries.sort(key=lambda e: e[0])
+        metrics.sched_queue_depth.set(len(entries))
+
+        view = []
+        admits = 0
+        preempts = 0
+        for pos, (_, req, key, ns, name, since, eff) in enumerate(entries):
+            view.append({
+                "job": key, "position": pos, "tier": req.tier,
+                "effective_tier": eff,
+                "wait_s": round(max(0.0, now - since), 3),
+                "request": (f"{req.num_slices}x{req.hosts_per_slice} hosts"
+                            + (f" ({req.accelerator})"
+                               if req.accelerator else "")),
+            })
+        with self._lock:
+            self._queue_view = view
+
+        blocked = False
+        for _, req, key, ns, name, since, eff in entries:
+            if blocked:
+                break
+            asg = cap.place(req, key)
+            if asg is not None:
+                if self._patch(ns, name, {
+                        c.ANNOTATION_SCHED_ASSIGNMENT: asg.to_json()},
+                        f"admit ({asg.to_json()})"):
+                    admits += 1
+                    wait = max(0.0, now - since)
+                    metrics.sched_admissions.inc()
+                    metrics.sched_admission_wait.observe(wait)
+                    with self._lock:
+                        self.admissions += 1
+                        self._queued_anchor.pop(key, None)
+                        self._pending_admissions[key] = asg
+                    self._note("admit", key,
+                               f"wait {wait:.3f}s tier {req.tier}/{eff}")
+                    self.controller.enqueue_job(key)
+                else:
+                    # the admission write did not commit: the capacity the
+                    # model just booked is NOT durably held — stop the scan
+                    # so no later gang is placed around a phantom booking
+                    blocked = True
+                continue
+            # no room for this gang
+            if self.enable_preemption:
+                victims = self._plan_preemption(req, eff, admitted, cap)
+                if victims:
+                    for v in victims:
+                        # the publish CONSUMES any stale ack in the same
+                        # patch (the PR-9 resize drain's consume-at-publish
+                        # rule): an ack left behind by a previous episode —
+                        # e.g. one that raced the release — must never let
+                        # THIS episode's barrier pass before the workload
+                        # checkpoints
+                        if self._patch(v.namespace, v.name, {
+                                c.ANNOTATION_PREEMPT_TARGET: st.now_iso(),
+                                c.ANNOTATION_PREEMPT_ACK: None},
+                                f"preempt (for {key})"):
+                            preempts += 1
+                            metrics.sched_preemptions.inc()
+                            with self._lock:
+                                self.preemptions += 1
+                                self._preempt_sent.add(v.key)
+                                v.preempting = True
+                            self._note(
+                                "preempt", v.key,
+                                f"tier {v.tier} victim for {key} "
+                                f"(tier {req.tier}/{eff})")
+                            self.controller.enqueue_job(v.key)
+                    # head-of-line while its capacity frees: no backfill
+                    # may steal the hosts the preemption is vacating
+                    blocked = True
+                    continue
+            if eff >= TIER_MAX:
+                # aged to the cap and still unplaceable without victims:
+                # hold the line — backfilling past it is exactly how a big
+                # gang starves behind an endless stream of small ones
+                blocked = True
+
+        return {"active": True, "queued": len(entries), "admitted": admits,
+                "preempted": preempts, "conflicts": conflicts}
+
+    @staticmethod
+    def _outgrew(req: GangRequest, asg: Assignment) -> bool:
+        """Whether the gang's CURRENT request no longer fits inside its
+        committed assignment (a grow; a shrink keeps the over-reservation,
+        the safe direction — capacity is never overcommitted by holding
+        too much)."""
+        if req.num_slices > len(asg.slices):
+            return True
+        return any(s.host_hi - s.host_lo < req.hosts_per_slice
+                   for s in asg.slices)
+
+    @staticmethod
+    def _finished(obj: Dict[str, Any]) -> bool:
+        for cond in ((obj.get("status") or {}).get("conditions")) or []:
+            if cond.get("status") == "True" and cond.get("type") in (
+                    c.JOB_SUCCEEDED, c.JOB_FAILED):
+                return True
+        return False
+
+    def _request_for(self, obj: Dict[str, Any]
+                     ) -> Tuple[Optional[GangRequest], Tuple]:
+        """The job's gang request, cached by (uid, generation): a pure
+        function of the spec, which changes exactly when generation bumps —
+        so the heavyweight dataclass parse runs once per spec revision, not
+        once per job per tick.  None = unresolvable (the reconciler's
+        strict validation fails the job)."""
+        meta = obj.get("metadata") or {}
+        ck = (meta.get("uid") or meta.get("name"),
+              int(meta.get("generation") or 0))
+        with self._lock:
+            hit = self._req_cache.get(ck)
+        if hit is not None:
+            return hit[0], ck
+        try:
+            job = TPUJob.from_dict(obj)
+            set_defaults_tpujob(job)
+            out = (gang_request(job), None)
+        except TopologyError as e:
+            out = (None, str(e))
+        except (TypeError, ValueError):
+            out = (None, "malformed")
+        with self._lock:
+            self._req_cache[ck] = out
+        return out[0], ck
+
+    # -- preemption ----------------------------------------------------------
+
+    def _progress_of(self, key: str) -> Optional[Tuple[float, Optional[float]]]:
+        """The job's newest (step, checkpoint_step), from the local PR-10
+        tracker when this member syncs the job — or, in a sharded fleet
+        where the shard-0 owner's tracker only holds its OWN shards' rows,
+        straight from the heartbeat annotations in the shared pod informer
+        cache (every member watches every pod).  None = no telemetry."""
+        telemetry = getattr(self.controller, "telemetry", None)
+        row = telemetry.row(key) if telemetry is not None else None
+        if row is not None:
+            return (float(row["step"]),
+                    None if row["checkpoint_step"] is None
+                    else float(row["checkpoint_step"]))
+        from tpujob.api.progress import parse_progress
+
+        ns, _, name = key.partition("/")
+        best = None  # ranked like the reconciler: (resize gen, step)
+        for obj in self.controller.pod_informer.store.by_index(
+                INDEX_JOB_NAME, name):
+            meta = obj.get("metadata") or {}
+            if (meta.get("namespace") or "default") != ns:
+                continue
+            raw = (meta.get("annotations") or {}).get(c.ANNOTATION_PROGRESS)
+            if not raw:
+                continue
+            prog = parse_progress(raw)
+            if prog is None:
+                continue
+            rank = (prog.resize_generation, prog.step)
+            if best is None or rank > best[0]:
+                best = (rank, prog)
+        if best is None:
+            return None
+        prog = best[1]
+        return (float(prog.step),
+                None if prog.checkpoint_step is None
+                else float(prog.checkpoint_step))
+
+    def _at_risk(self, key: str) -> float:
+        """Goodput cost of preempting ``key``: steps its workload would
+        lose past the last checkpoint; unknown = infinite, so victims that
+        publish telemetry — and are provably cheap to evict — go first."""
+        prog = self._progress_of(key)
+        if prog is None:
+            return float("inf")
+        return max(0.0, prog[0] - (prog[1] or 0.0))
+
+    def _plan_preemption(self, req: GangRequest, eff_tier: int,
+                         admitted: List[_Admitted],
+                         cap: CapacityModel) -> List[_Admitted]:
+        """Choose the cheapest victim set that makes ``req`` placeable:
+        strictly-lower-tier gangs only, lowest (tier, goodput-at-risk)
+        first.  In-flight evictions/preemptions count as already freeing —
+        a tick must not pick NEW victims for capacity that is already being
+        vacated.  Returns [] when no workable set exists (or none is
+        needed beyond what is already vacating)."""
+        sim = cap.clone()
+        for a in admitted:
+            if a.evicting or a.preempting:
+                sim.release(a.key)
+        if sim.clone().place(req, "probe") is not None:
+            return []  # already freeing enough: wait, don't over-evict
+        candidates = sorted(
+            (a for a in admitted
+             if not a.evicting and not a.preempting and a.tier < eff_tier),
+            key=lambda a: (a.tier, self._at_risk(a.key), a.key))
+        chosen: List[_Admitted] = []
+        for victim in candidates:
+            sim.release(victim.key)
+            chosen.append(victim)
+            if sim.clone().place(req, "probe") is not None:
+                return chosen
+        return []
+
+    def _advance_eviction(self, entry: _Admitted, now: float,
+                          now_wall: float) -> None:
+        """Drive one victim through the publish -> barrier -> evict ->
+        release protocol (each stage is a committed annotation, so a fresh
+        scheduler resumes exactly where the old one died)."""
+        if entry.evicting:
+            # capacity stays reserved until the LAST pod is gone — only
+            # then may the hosts be re-admitted to someone else
+            if not self._live_pods(entry.namespace, entry.name):
+                raw = entry.ann.get(c.ANNOTATION_SCHED_ASSIGNMENT) or ""
+                if self._release(entry.key, entry.namespace, entry.name,
+                                 raw, "release (eviction complete)"):
+                    self._note("release", entry.key, "eviction complete")
+                    self.controller.enqueue_job(entry.key)
+            return
+        if not entry.preempting:
+            return
+        if self._barrier_passed(entry.key, entry.ann, now, now_wall):
+            if self._patch(entry.namespace, entry.name,
+                           {c.ANNOTATION_SCHED_EVICTED: st.now_iso()},
+                           "evict (barrier passed)"):
+                self._note("evict", entry.key, "checkpoint barrier passed")
+                with self._lock:
+                    self._preempt_anchor.pop(entry.key, None)
+                self.controller.enqueue_job(entry.key)
+
+    def _barrier_passed(self, key: str, ann: Dict[str, str],
+                        now: float, now_wall: float) -> bool:
+        """The preemption checkpoint barrier: the workload acked, or its
+        telemetry shows the checkpoint caught up to the step (nothing left
+        to lose), or the bounded grace ran out.  Bounded like the resize
+        drain barrier — a wedged workload cannot block a preemption
+        forever, and the invariant is 'nothing lost past the LAST
+        checkpoint', which holds either way."""
+        if self.preempt_grace_s <= 0:
+            return True
+        published_raw = ann.get(c.ANNOTATION_PREEMPT_TARGET)
+        if published_raw is None:
+            # our publish has not echoed into the cache yet (the entry is
+            # preempting via the _preempt_sent ledger): the workload cannot
+            # possibly have seen the target, so the barrier FAILS CLOSED —
+            # failing open here would evict before the grace window ever
+            # started.  The grace clock starts at the echo.
+            return False
+        if ann.get(c.ANNOTATION_PREEMPT_ACK) is not None:
+            return True
+        prog = self._progress_of(key)
+        if prog is not None and prog[1] is not None and prog[1] >= prog[0]:
+            return True  # checkpoint caught up to the step: nothing to lose
+        # per-incarnation monotonic anchor, with a wall floor on the
+        # published timestamp so a drain already pending across a crash
+        # proceeds immediately (the _drain_barrier_passed pattern)
+        with self._lock:
+            anchor = self._preempt_anchor.setdefault(key, now)
+        if now - anchor >= self.preempt_grace_s:
+            return True
+        published = _parse_wall(published_raw)
+        if published is None:
+            return True  # corrupt anchor: fail open, the barrier bounds loss
+        return now_wall - published >= self.preempt_grace_s + 1.0  # noqa: TPL004 - wall-vs-persisted timestamp math, like the resize drain floor
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _live_pods(self, namespace: str, name: str) -> int:
+        """Pods (terminating included) the job still holds, from the shared
+        informer cache — the release gate for a vacated gang's capacity."""
+        selector = gen_labels(name)
+        count = 0
+        for obj in self.controller.pod_informer.store.by_index(
+                INDEX_JOB_NAME, selector[c.LABEL_JOB_NAME]):
+            meta = obj.get("metadata") or {}
+            if (meta.get("namespace") or "default") == namespace:
+                count += 1
+        return count
+
+    def _queued_since(self, key: str, obj: Dict[str, Any], now: float,
+                      now_wall: float) -> float:
+        """Monotonic queue anchor: earliest of the in-memory first-seen and
+        the durable Queued condition's transition time — so aging survives
+        a scheduler crash/handoff instead of resetting to zero."""
+        wall = None
+        for cond in ((obj.get("status") or {}).get("conditions")) or []:
+            if cond.get("type") == c.JOB_QUEUED \
+                    and cond.get("status") == "True":
+                wall = _parse_wall(cond.get("lastTransitionTime"))
+                break
+        derived = (now if wall is None
+                   else now - max(0.0, now_wall - wall))  # noqa: TPL004 - wall-vs-persisted timestamp math
+        with self._lock:
+            current = self._queued_anchor.get(key)
+            best = derived if current is None else min(current, derived)
+            self._queued_anchor[key] = best
+            return best
+
+    def _release(self, key: str, namespace: str, name: str, raw: str,
+                 what: str) -> bool:
+        """Release a gang's capacity annotations exactly once per
+        assignment value: the committed patch is idempotent, but re-issuing
+        it every tick until the cache echo lands is write amplification
+        the API server pays for."""
+        with self._lock:
+            if self._release_sent.get(key) == raw:
+                return False  # already committed; waiting for the echo
+        if not self._patch(namespace, name, {
+                c.ANNOTATION_SCHED_ASSIGNMENT: None,
+                c.ANNOTATION_SCHED_EVICTED: None,
+                c.ANNOTATION_PREEMPT_TARGET: None,
+                c.ANNOTATION_PREEMPT_ACK: None,
+        }, what):
+            return False
+        with self._lock:
+            self._release_sent[key] = raw
+        return True
+
+    def _patch(self, namespace: str, name: str,
+               annotations: Dict[str, Optional[str]], what: str) -> bool:
+        """One annotation merge-patch through the controller's (fenced,
+        traced) transport; False = did not commit (retried next tick)."""
+        try:
+            self.controller.clients.server.patch(
+                RESOURCE_TPUJOBS, namespace, name,
+                {"metadata": {"annotations": dict(annotations)}})
+            return True
+        except NotFoundError:
+            return False
+        except ApiError as e:
+            log.warning("%s/%s: scheduler %s failed (%s); retrying next "
+                        "tick", namespace, name, what, e)
+            return False
+
+    def _note(self, kind: str, key: str, detail: str) -> None:
+        with self._lock:
+            self._decisions.append({
+                "at": st.now_iso(), "kind": kind, "job": key,
+                "detail": detail})
+        self.controller.flight.record(
+            key, "sched", f"{kind}: {detail}", {"kind": kind})
+
+    # -- observability -------------------------------------------------------
+
+    def tick_latencies(self) -> List[float]:
+        with self._lock:
+            return sorted(self._tick_durations)
+
+    def debug_snapshot(self) -> Dict[str, Any]:
+        """The scheduler half of ``/debug/fleet``: capacity utilization,
+        queue positions, and the recent decision log."""
+        with self._lock:
+            queue = list(self._queue_view)
+            decisions = list(self._decisions)
+            unsched = {k: list(errs)
+                       for k, (_, errs) in self._unschedulable.items()}
+            admissions, preemptions = self.admissions, self.preemptions
+        return {
+            "capacity": [{"accelerator": p.accelerator, "slices": p.count,
+                          "hosts_per_slice": p.shape.hosts,
+                          "chips": p.total_chips} for p in self.pools],
+            "aging_s": self.aging_s,
+            "preemption": self.enable_preemption,
+            "queue": queue,
+            "unschedulable": unsched,
+            "admissions_total": admissions,
+            "preemptions_total": preemptions,
+            "decisions": decisions,
+        }
