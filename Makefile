@@ -11,7 +11,7 @@ SHELL := /bin/bash
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
+.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
@@ -96,9 +96,18 @@ telemetry-smoke:
 sched-smoke:
 	$(PY) scripts/sched_smoke.py
 
+# node-repair smoke (~5 s): kill one heartbeating host under a running
+# 2-slice gang — the node flips durably NotReady (taint recording why), the
+# gang migrates through the checkpoint barrier onto healthy hosts, restores
+# exactly at the barrier checkpoint with zero counted restarts, Stalled
+# never flips, and no pod is ever born onto a NotReady/cordoned host
+# (docs/failure-handling, "node failure & gang migration")
+node-smoke:
+	$(PY) scripts/node_smoke.py
+
 # the tier-1 command from ROADMAP.md, verbatim (modulo $$-escaping for
 # make), so local and CI invocations agree on what "the tests pass" means
-test: lint trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke
+test: lint trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # the operator/controller/kube/api tests only — the model-path suites
@@ -123,10 +132,13 @@ e2e:
 # sharded-control-plane membership storm (3 controllers, member
 # kill/flap/rejoin, exactly-one-owner-per-generation asserted), the
 # elastic-resize storm (grow/shrink/flap spec.replicas over live jobs +
-# a controller kill; no progress lost past the last checkpoint), and the
+# a controller kill; no progress lost past the last checkpoint), the
 # gang-scheduler storm (oversubscribed admission queue + seeded
 # preemption; no gang ever partially admitted, no starvation, every
-# scheduled eviction checkpoint-safe).
+# scheduled eviction checkpoint-safe), and the node storm (hard host
+# death, heartbeat flap inside one grace window, cordon churn, whole-slice
+# outage; no pod born onto a NotReady/cordoned host, migrated gangs
+# restore at the barrier checkpoint with zero counted restarts).
 soak:
 	$(PY) soak.py --seeds 1,2,3,4,5 --crash
 

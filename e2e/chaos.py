@@ -2025,7 +2025,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--mode",
                         choices=("api", "crash", "failover", "shard",
-                                 "resize", "sched"),
+                                 "resize", "sched", "nodes"),
                         default="api",
                         help="api = transport faults only; crash = + seeded "
                              "controller kills; failover = warm-standby "
@@ -2034,7 +2034,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "resize = seeded elastic-resize storms over "
                              "live jobs + faults + a controller kill; "
                              "sched = oversubscribed gang-admission queue + "
-                             "seeded preemption + faults + a controller kill")
+                             "seeded preemption + faults + a controller "
+                             "kill; nodes = seeded NodeStorm (host death, "
+                             "heartbeat flap, cordon churn, slice outage) + "
+                             "gang migration + faults + a controller kill")
     parser.add_argument("--storm-kills", type=int, default=6)
     parser.add_argument("--timeout", type=float, default=60.0)
     parser.add_argument("--verbose", action="store_true")
@@ -2060,6 +2063,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         from e2e.scheduler import run_sched_soak
 
         report = run_sched_soak(args.seed, timeout=args.timeout)
+    elif args.mode == "nodes":
+        # imported here: e2e.nodes imports this module at load time
+        from e2e.nodes import run_node_soak
+
+        report = run_node_soak(args.seed, timeout=args.timeout)
     else:
         report = run_soak(args.seed, storm_kills=args.storm_kills,
                           timeout=args.timeout)

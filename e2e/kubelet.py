@@ -48,11 +48,17 @@ class KubeletSim:
         run_seconds: float = 0.05,
         scripts: Optional[List[PodScript]] = None,
         auto_succeed: bool = True,
+        node_down: Optional[Callable[[str], bool]] = None,
     ):
         self.clients = clients
         self.run_seconds = run_seconds
         self.scripts = scripts or []
         self.auto_succeed = auto_succeed
+        # host-liveness seam (node chaos tier): a pod bound to a host this
+        # predicate reports down never starts or advances — a dead VM has
+        # no kubelet, so a pod born onto it inside the heartbeat grace
+        # window sits Pending until the gang is migrated off the host
+        self.node_down = node_down
         self._started: Dict[str, float] = {}  # uid -> time Running began
         self._consumed: Dict[str, int] = {}  # script match -> codes used
         self._attempts: Dict[str, int] = {}  # pod name -> exec attempts
@@ -193,6 +199,9 @@ class KubeletSim:
                 phase = pod.status.phase
                 if phase in ("Succeeded", "Failed"):
                     continue
+                node = pod.spec.node_name
+                if node and self.node_down is not None and self.node_down(node):
+                    continue  # the host is dead: no kubelet to run the pod
                 script = self._script_for(pod.metadata.name)
                 run_for = script.run_seconds if script else self.run_seconds
                 if uid not in self._started:

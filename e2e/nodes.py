@@ -1,0 +1,784 @@
+"""Node chaos tier: host death, heartbeat flap, cordon churn, slice outage.
+
+PR 11's gang scheduler placed against a *modeled* capacity string, so a dead
+host was invisible.  This tier drives the node inventory end to end: a
+:class:`NodeAgentSim` heartbeats every Node the way per-host agents would, a
+seeded :class:`NodeStorm` injects the host-level failure domain (hard host
+death, a heartbeat flap inside one grace window, cordon/uncordon churn, a
+whole-slice outage with recovery), and the checkpointing trainer workloads
+from the scheduler tier answer the migration checkpoint barrier.
+
+Invariants, on top of the standard chaos + scheduler sets:
+
+16. **no pod is ever born onto a NotReady/cordoned host** — enforced on the
+    committed stream by :class:`NodeBirthTracker` (with a small settle
+    margin for the informer-echo window of a flip that raced a create);
+17. **no gang stays placed across a dead host past grace** — every gang
+    touching a dead/cordoned host is migrated through the checkpoint-
+    barrier eviction, restores exactly at its barrier checkpoint, and
+    counts ZERO restarts (a scheduled migration is not a failure);
+18. **a heartbeat flap inside one grace window changes nothing** — the
+    flapped node never flips NotReady and never appears in a
+    ``migrated-from`` record (the per-node damper backstops storms).
+
+``run_node_smoke`` is the fast tier-1 gate (``make node-smoke``): one
+2-slice gang on a 3-slice fleet, one hard host death — migration completes,
+restore lands on the barrier checkpoint, Stalled never flips, zero counted
+restarts.
+
+Runnable:  python -m e2e.chaos --seed 7 --mode nodes
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from e2e.chaos import (
+    JobCase,
+    StallTracker,
+    _converge_or_fail,
+    _job,
+    _lock_audit_report,
+    _settle_invariants,
+    _soak_harness,
+    _start_app,
+    _tmpl,
+    _wait_for,
+    check_trace_ledger,
+)
+from e2e.kubelet import KubeletSim
+from e2e.scheduler import AdmissionTracker, SchedWorkload
+from tpujob.analysis import lockgraph
+from tpujob.api import constants as c
+from tpujob.api.nodes import node_phase
+from tpujob.controller.status import is_finished
+from tpujob.kube.chaos import ChaosConfig
+from tpujob.kube.client import RESOURCE_NODES, RESOURCE_PODS, ClientSet
+from tpujob.kube.errors import ApiError, ConflictError, NotFoundError
+from tpujob.obs.trace import TRACER
+from tpujob.server.scheduler import Assignment
+
+NODE_SMOKE_CAPACITY = "v4-16x3"  # 3 slices x 2 hosts: one slice of slack
+NODE_SOAK_CAPACITY = "v4-16x4"  # 4 slices x 2 hosts
+
+
+# ---------------------------------------------------------------------------
+# the node agent (per-host heartbeat publisher)
+# ---------------------------------------------------------------------------
+
+
+class NodeAgentSim:
+    """Heartbeats every Node object the way per-host agents would: one
+    annotation bump per node per interval over the agent's own (fault-free)
+    connection.  ``down`` hosts stay silent — the storm's host-death seam."""
+
+    def __init__(self, clients: ClientSet, interval_s: float = 0.1):
+        self.clients = clients
+        self.interval_s = interval_s
+        self._seq = 0
+        self._down: Set[str] = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "NodeAgentSim":
+        # start before publish: a concurrent stop() must never see (and
+        # join) a created-but-unstarted Thread (TPL001)
+        loop = threading.Thread(target=self._loop, daemon=True,
+                                name="node-agent-sim")
+        loop.start()
+        self._thread = loop
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def set_down(self, name: str, down: bool = True) -> None:
+        with self._lock:
+            (self._down.add if down else self._down.discard)(name)
+
+    def is_down(self, name: str) -> bool:
+        with self._lock:
+            return name in self._down
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._seq += 1
+            try:
+                nodes = self.clients.nodes.list()
+            except ApiError:
+                continue
+            for node in nodes:
+                name = node.metadata.name
+                if self.is_down(name):
+                    continue
+                try:
+                    self.clients.server.patch(
+                        RESOURCE_NODES, "default", name,
+                        {"metadata": {"annotations": {
+                            c.ANNOTATION_NODE_HEARTBEAT: str(self._seq)}}})
+                except (ConflictError, NotFoundError, ApiError):
+                    continue  # raced a flip/delete; next beat heals
+
+
+# ---------------------------------------------------------------------------
+# invariant 16: no pod born onto a NotReady/cordoned host
+# ---------------------------------------------------------------------------
+
+
+class NodeBirthTracker:
+    """Committed-stream hook tracking each node's durable exclusion state
+    and flagging any pod BORN onto a host that had been durably
+    NotReady/cordoned for at least ``margin_s`` before the birth (the
+    margin absorbs the informer-echo window of a flip racing a create —
+    the controller gates on its cache, which trails commits by the watch
+    latency)."""
+
+    def __init__(self, margin_s: float = 0.25):
+        self.margin_s = margin_s
+        self._lock = threading.Lock()
+        # node name -> monotonic instant it became excluded (absent = ok)
+        self._excluded_since: Dict[str, float] = {}
+        self._not_ready: Set[str] = set()
+        self.not_ready_flips: List[Tuple[str, float]] = []
+        self.violations: List[str] = []
+
+    def hook(self, ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
+        now = time.monotonic()
+        if resource == RESOURCE_NODES:
+            name = (obj.get("metadata") or {}).get("name") or ""
+            ann = (obj.get("metadata") or {}).get("annotations") or {}
+            not_ready = (ev_type != "DELETED"
+                         and node_phase(obj) == c.NODE_NOT_READY)
+            excluded = not_ready or (
+                ev_type != "DELETED"
+                and ann.get(c.ANNOTATION_NODE_CORDONED) is not None)
+            with self._lock:
+                if not_ready and name not in self._not_ready:
+                    self._not_ready.add(name)
+                    self.not_ready_flips.append((name, now))
+                elif not not_ready:
+                    self._not_ready.discard(name)
+                if excluded:
+                    self._excluded_since.setdefault(name, now)
+                else:
+                    self._excluded_since.pop(name, None)
+            return
+        if resource != RESOURCE_PODS or ev_type != "ADDED":
+            return
+        node = ((obj.get("spec") or {}).get("nodeName")) or ""
+        if not node:
+            return
+        with self._lock:
+            since = self._excluded_since.get(node)
+            if since is not None and now - since >= self.margin_s:
+                self.violations.append(
+                    f"pod {(obj.get('metadata') or {}).get('name')} born "
+                    f"onto {node}, which had been NotReady/cordoned for "
+                    f"{now - since:.3f}s")
+
+    def problems(self) -> List[str]:
+        with self._lock:
+            return list(self.violations)
+
+    def flips_of(self, name: str) -> List[float]:
+        with self._lock:
+            return [t for n, t in self.not_ready_flips if n == name]
+
+
+# ---------------------------------------------------------------------------
+# the storm (host-level failure domain)
+# ---------------------------------------------------------------------------
+
+
+class NodeStorm:
+    """Seeded host-level fault driver over the agent's fault-free
+    connection: hard host death (silence + the host's pods vanish), a
+    heartbeat flap strictly inside one grace window, cordon/uncordon
+    churn, and a whole-slice outage that later recovers."""
+
+    def __init__(self, clients: ClientSet, agent: NodeAgentSim, seed: int,
+                 grace_s: float):
+        self.clients = clients
+        self.agent = agent
+        self.rng = random.Random(f"{seed}:nodestorm")
+        self.grace_s = grace_s
+        self.dead: List[str] = []  # hosts hard-killed (never revived)
+        self.flapped: List[str] = []  # hosts flapped inside one grace
+        self.cordoned: List[str] = []
+        self.outage: List[str] = []  # the whole-slice outage (revived)
+        self.log: List[str] = []
+        # hosts whose VM is gone RIGHT NOW (kill/outage minus revive) —
+        # the KubeletSim node_down seam, so a pod born onto a dead host
+        # inside the grace window sits Pending instead of running on
+        # hardware that no longer exists
+        self._down_lock = threading.Lock()
+        self._down: Set[str] = set()  # guarded by self._down_lock
+        # dead host -> names of then-LIVE gangs whose pods it took down:
+        # with the kubelet seam those gangs cannot converge without a
+        # checkpoint migration, so each entry must later show a
+        # migrated-from record naming the host
+        self.stranded: Dict[str, Set[str]] = {}
+
+    def host_down(self, node: str) -> bool:
+        with self._down_lock:
+            return node in self._down
+
+    def _job_finished(self, namespace: str, name: str) -> bool:
+        try:
+            job = self.clients.tpujobs.get(namespace, name)
+        except ApiError:
+            return True  # unknown: don't demand a migration we can't prove
+        return is_finished(job.status)
+
+    def _kill_pods_on(self, node: str) -> int:
+        killed = 0
+        try:
+            pods = self.clients.pods.list()
+        except ApiError:
+            return 0
+        for p in pods:
+            if p.spec.node_name != node:
+                continue
+            ns = p.metadata.namespace or "default"
+            try:
+                self.clients.pods.delete(ns, p.metadata.name)
+                killed += 1
+            except (NotFoundError, ApiError):
+                continue
+            owner = (p.metadata.labels or {}).get(c.LABEL_JOB_NAME)
+            if owner and not self._job_finished(ns, owner):
+                self.stranded.setdefault(node, set()).add(owner)
+        return killed
+
+    def kill_host(self, node: str) -> int:
+        """Hard host death: the agent goes silent and every pod on the
+        host vanishes (the VM is gone)."""
+        self.agent.set_down(node)
+        with self._down_lock:
+            self._down.add(node)
+        self.dead.append(node)
+        killed = self._kill_pods_on(node)
+        self.log.append(f"kill {node} ({killed} pod(s) lost)")
+        return killed
+
+    def flap(self, node: str) -> None:
+        """Heartbeat gap strictly inside one grace window: must cause
+        ZERO NotReady flips and ZERO migrations.  The pause is a quarter
+        grace so the EFFECTIVE gap (pause + agent beat interval + thread
+        scheduling jitter on a loaded host) stays well under the grace."""
+        self.flapped.append(node)
+        self.agent.set_down(node)
+        self.log.append(f"flap {node} for {0.25 * self.grace_s:.2f}s")
+        time.sleep(0.25 * self.grace_s)
+        self.agent.set_down(node, down=False)
+
+    def cordon(self, node: str, cordoned: bool = True) -> None:
+        value = "storm-cordon" if cordoned else None
+        try:
+            self.clients.server.patch(
+                RESOURCE_NODES, "default", node,
+                {"metadata": {"annotations": {
+                    c.ANNOTATION_NODE_CORDONED: value}}})
+        except ApiError:
+            return
+        if cordoned:
+            self.cordoned.append(node)
+        self.log.append(("cordon " if cordoned else "uncordon ") + node)
+
+    def slice_outage(self, nodes: List[str]) -> None:
+        """Every host of one slice goes silent at once (ICI/power domain
+        failure); :meth:`revive` brings them back."""
+        self.outage = list(nodes)
+        for n in nodes:
+            self.agent.set_down(n)
+            with self._down_lock:
+                self._down.add(n)
+            self._kill_pods_on(n)
+        self.log.append(f"slice outage: {nodes}")
+
+    def revive(self, nodes: List[str]) -> None:
+        for n in nodes:
+            self.agent.set_down(n, down=False)
+            with self._down_lock:
+                self._down.discard(n)
+        self.log.append(f"revive: {nodes}")
+
+
+# ---------------------------------------------------------------------------
+# shared assertions
+# ---------------------------------------------------------------------------
+
+
+def _assignment_of(admin: ClientSet, name: str) -> Optional[Assignment]:
+    try:
+        job = admin.tpujobs.get("default", name)
+    except ApiError:
+        return None
+    raw = (job.metadata.annotations or {}).get(c.ANNOTATION_SCHED_ASSIGNMENT)
+    return Assignment.from_json(raw) if raw else None
+
+
+def _node_job_problems(admin: ClientSet, workloads: Dict[str, SchedWorkload],
+                       admissions: AdmissionTracker, storm: NodeStorm,
+                       births: NodeBirthTracker) -> List[str]:
+    """The node tier's extra invariants (16-18 in the module doc)."""
+    problems: List[str] = admissions.problems()
+    problems += births.problems()
+    for name, wl in sorted(workloads.items()):
+        snap = wl.ledger.snapshot()
+        problems.extend(snap["violations"])
+        if not snap["done"]:
+            problems.append(
+                f"{name}: trained only {snap['progress']}/{wl.total_steps} "
+                "steps")
+        try:
+            job = admin.tpujobs.get("default", name)
+        except NotFoundError:
+            problems.append(f"{name}: job vanished")
+            continue
+        restarts = sum(rs.restarts
+                       for rs in job.status.replica_statuses.values())
+        if restarts:
+            problems.append(
+                f"{name}: {restarts} counted restart(s) — neither a "
+                "scheduled migration nor a node loss is a failure strike")
+        ann = job.metadata.annotations or {}
+        for a in (c.ANNOTATION_PREEMPT_TARGET, c.ANNOTATION_SCHED_EVICTED,
+                  c.ANNOTATION_MIGRATED_FROM):
+            if ann.get(a) is not None:
+                problems.append(f"{name}: {a} never cleared")
+        migrated = [m for m in storm.flapped
+                    if any(m in (rec or "")
+                           for rec in wl.migrated_from_records)]
+        if migrated:
+            problems.append(
+                f"{name}: flapped node(s) {migrated} triggered a migration "
+                "(a flap inside one grace window must change nothing)")
+    for node in storm.flapped:
+        if node in storm.dead or node in storm.outage:
+            continue  # a later hard fault legitimately flips it
+        if births.flips_of(node):
+            problems.append(
+                f"{node}: flipped NotReady despite flapping strictly "
+                "inside one grace window")
+    # a hard-killed host that took down a live gang's pod forces a
+    # scheduled move: the host never revives and (kubelet seam) cannot run
+    # the replacement, so the gang's required convergence is only reachable
+    # through a checkpoint-barrier eviction — either the node migration
+    # (migrated-from names the host) or a capacity preemption that re-placed
+    # the gang while the migration machinery was tearing the fleet apart.
+    # (Outage-stranded gangs may legitimately race the revive instead, so
+    # only storm.dead qualifies.)
+    for node, jobs in sorted(storm.stranded.items()):
+        if node not in storm.dead:
+            continue
+        for job_name in sorted(jobs):
+            wl = workloads.get(job_name)
+            if wl is None:
+                continue
+            migrated = any(node in (rec or "")
+                           for rec in wl.migrated_from_records)
+            if not migrated and not wl.evicted_records:
+                problems.append(
+                    f"{job_name}: host {node} died under the live gang but "
+                    "no migrated-from record names it and no checkpoint "
+                    "eviction ever ran (the gang was left stranded)")
+    for node in storm.dead:
+        try:
+            obj = admin.nodes.get("default", node)
+        except NotFoundError:
+            continue
+        if obj.status.phase != c.NODE_NOT_READY:
+            problems.append(
+                f"{node}: hard-killed host never flipped durably NotReady")
+        elif not (obj.metadata.annotations or {}).get(
+                c.ANNOTATION_NODE_TAINT):
+            problems.append(
+                f"{node}: NotReady without a taint annotation recording why")
+    return problems
+
+
+class _MigrationWatch:
+    """Committed-stream hook recording every migrated-from value each job
+    ever carried (the annotation is cleared on release, so the end state
+    alone cannot prove — or refute — a migration)."""
+
+    def __init__(self, workloads: Dict[str, SchedWorkload]):
+        self.workloads = workloads
+        for wl in workloads.values():
+            wl.migrated_from_records = []  # type: ignore[attr-defined]
+            wl.evicted_records = []  # type: ignore[attr-defined]
+        self._lock = threading.Lock()
+
+    def hook(self, ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
+        if resource != "tpujobs":
+            return
+        meta = obj.get("metadata") or {}
+        wl = self.workloads.get(meta.get("name") or "")
+        if wl is None:
+            return
+        ann = meta.get("annotations") or {}
+        rec = ann.get(c.ANNOTATION_MIGRATED_FROM)
+        evicted = ann.get(c.ANNOTATION_SCHED_EVICTED)
+        with self._lock:
+            if rec and rec not in wl.migrated_from_records:
+                wl.migrated_from_records.append(rec)
+            # every distinct sched-evicted marker = one checkpoint-barrier
+            # eviction episode (migration OR capacity preemption)
+            if evicted and evicted not in wl.evicted_records:
+                wl.evicted_records.append(evicted)
+
+
+# ---------------------------------------------------------------------------
+# the smoke (tier-1 gate)
+# ---------------------------------------------------------------------------
+
+
+NODE_SMOKE_OVERRIDES = dict(
+    scheduler_capacity=NODE_SMOKE_CAPACITY,
+    scheduler_tick_s=0.05,
+    scheduler_aging_s=5.0,
+    scheduler_preempt_grace_s=2.0,
+    node_grace_s=0.6,
+    node_migration_damp_s=0.5,
+    stall_timeout_s=2.0,
+    stall_check_interval_s=0.2,
+)
+
+
+def run_node_smoke(seed: int = 17, timeout: float = 30.0) -> Dict[str, Any]:
+    """The fast node-repair acceptance gate (``make node-smoke``): kill one
+    host under a running 2-slice gang — Stalled never flips, the gang
+    migrates through the checkpoint barrier onto healthy hosts, restores
+    exactly at the barrier checkpoint, and counts zero restarts.
+
+    Runs under the lock-order sentinel (see ``run_soak``)."""
+    with lockgraph.audit():
+        report = _run_node_smoke_inner(seed, timeout)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_node_smoke_inner(seed: int, timeout: float) -> Dict[str, Any]:
+    no_faults = ChaosConfig(
+        error_rate=0.0, timeout_rate=0.0, conflict_rate=0.0, latency_rate=0.0)
+    trainer_stop = threading.Event()
+    finish_gate = threading.Event()  # holds the gang alive until migrated
+    prefix, _, inner, chaos, admin, tracker, _ = _soak_harness(
+        seed, "h", no_faults, cases=[])
+    name = f"{prefix}-gang"
+    wl = SchedWorkload(admin, name, total_steps=25, stop_event=trainer_stop,
+                       finish_gate=finish_gate)
+    admissions = AdmissionTracker(NODE_SMOKE_CAPACITY)
+    stall_tracker = StallTracker()
+    births = NodeBirthTracker()
+    migrations = _MigrationWatch({name: wl})
+    for hook in (admissions.hook, stall_tracker.hook, births.hook,
+                 migrations.hook):
+        inner.hooks.append(hook)
+    case = JobCase(job=_job(name, {
+        "runPolicy": {"backoffLimit": 10},
+        "tpuReplicaSpecs": {"Worker": {
+            "replicas": 4,
+            "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+            "tpu": {"accelerator": "v4-16", "numSlices": 2},
+            "template": _tmpl()}},
+    }), scripts=wl.scripts(), expect_terminal="Succeeded")
+    started = time.monotonic()
+    deadline = started + timeout
+
+    def _wait(pred, what: str) -> None:
+        if not _wait_for(pred, max(0.1, deadline - time.monotonic())):
+            raise AssertionError(f"node smoke: timed out waiting for {what}")
+
+    def _pods() -> List:
+        return [p for p in admin.pods.list()
+                if p.metadata.labels.get(c.LABEL_JOB_NAME) == name]
+
+    agent = NodeAgentSim(admin, interval_s=0.1)
+    storm = NodeStorm(admin, agent, seed,
+                      grace_s=NODE_SMOKE_OVERRIDES["node_grace_s"])
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=case.scripts,
+                         node_down=storm.host_down)
+    app = _start_app(chaos, NODE_SMOKE_OVERRIDES)
+    kubelet.start()
+    agent.start()
+    try:
+        # 0. the --sched-capacity bootstrap synthesizes the inventory and
+        # the agent starts heartbeating it: 6 Ready hosts
+        _wait(lambda: len(admin.nodes.list()) == 6, "the 6-node inventory")
+        admin.tpujobs.create(case.job)
+        _wait(lambda: len(_pods()) == 4, "the gang's 4 pods")
+        _wait(lambda: wl.ledger.snapshot()["progress"] > 3,
+              "the gang to train")
+        asg0 = _assignment_of(admin, name)
+        assert asg0 is not None and len(asg0.slices) == 2
+        bound = sorted({p.spec.node_name for p in _pods()})
+        if len(bound) != 4 or None in bound:
+            raise AssertionError(
+                f"node smoke: pods not host-bound: {bound}")
+        # 1. hard-kill the LAST host of the gang (never the coordinator's,
+        # so the checkpoint barrier runs through the workload ack path)
+        victim = max(bound)
+        coordinator_host = min(bound)
+        assert victim != coordinator_host
+        storm.kill_host(victim)
+        # 2. the heartbeat goes stale past grace: durable NotReady + taint,
+        # then the checkpoint-aware migration (barrier -> evict -> re-queue
+        # -> re-admit on healthy hosts)
+        _wait(lambda: wl.migrated_from_records, "the migration to stage")
+        _wait(lambda: (_assignment_of(admin, name) is not None
+                       and _assignment_of(admin, name) != asg0
+                       and len(_pods()) == 4
+                       and all(p.spec.node_name != victim for p in _pods())),
+              "re-admission on healthy hosts")
+        snap = wl.ledger.snapshot()
+        if not snap["barriers"]:
+            raise AssertionError(
+                "node smoke: the migration never ran its checkpoint barrier")
+        _wait(lambda: wl.ledger.snapshot()["restores"], "the restore")
+        finish_gate.set()
+        _converge_or_fail(admin, [case], deadline, seed, " (node smoke)")
+        problems = _settle_invariants(admin, app.controller, [case], tracker,
+                                      chaos, deadline)
+        problems += _node_job_problems(admin, {name: wl}, admissions, storm,
+                                       births)
+        problems += stall_tracker.problems()
+        restores = wl.ledger.snapshot()["restores"]
+        if restores[0][1] != snap["barriers"][-1]:
+            problems.append(
+                f"restore {restores[0]} != barrier checkpoint "
+                f"{snap['barriers'][-1]} (a scheduled migration loses "
+                "nothing)")
+        fleet = app.controller.fleet_snapshot().get("scheduler") or {}
+        if fleet.get("inventory") != "nodes":
+            problems.append(
+                f"scheduler inventory {fleet.get('inventory')!r} != 'nodes' "
+                "(the capacity model must be Node-backed)")
+        if not fleet.get("migrations_total"):
+            problems.append("migrations_total == 0 after a migration")
+        if problems:
+            raise AssertionError(
+                "node smoke invariants violated:\n  " + "\n  ".join(problems))
+        return {
+            "mode": "node-smoke",
+            "seed": seed,
+            "victim": victim,
+            "migrated_from": list(wl.migrated_from_records),
+            "barrier_checkpoint": snap["barriers"][-1],
+            "restores": restores,
+            "storm": storm.log,
+            "duration_s": round(time.monotonic() - started, 3),
+            "invariants": "ok",
+        }
+    finally:
+        trainer_stop.set()
+        finish_gate.set()
+        agent.stop()
+        kubelet.stop()
+        app.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the soak
+# ---------------------------------------------------------------------------
+
+
+NODE_SOAK_OVERRIDES = dict(
+    scheduler_capacity=NODE_SOAK_CAPACITY,
+    scheduler_tick_s=0.05,
+    scheduler_aging_s=1.0,
+    scheduler_preempt_grace_s=1.0,
+    # grace sized so a flap's EFFECTIVE heartbeat gap (0.25 * grace pause
+    # + 0.1s agent beat + GIL jitter across ~15 soak threads) can never
+    # brush the staleness bound
+    node_grace_s=1.2,
+    node_migration_damp_s=0.5,
+    stall_timeout_s=5.0,
+    stall_check_interval_s=0.5,
+)
+
+
+def run_node_soak(
+    seed: int,
+    config: Optional[ChaosConfig] = None,
+    kills: int = 1,
+    timeout: float = 120.0,
+) -> Dict[str, Any]:
+    """Node chaos soak: three gangs on a 4-slice fleet under the full API
+    fault schedule, a seeded NodeStorm (hard host death, a heartbeat flap
+    inside one grace window, cordon/uncordon churn, a whole-slice outage
+    with recovery) and a controller hard-kill.  Invariants: the standard
+    chaos + scheduler sets, plus no pod born onto a NotReady/cordoned
+    host, no gang left across a dead host (migrated at the barrier
+    checkpoint, zero counted restarts), and the flap changes nothing.
+
+    Runs under the lock-order sentinel (see ``run_soak``)."""
+    with lockgraph.audit():
+        report = _run_node_soak_inner(seed, config, kills, timeout)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_node_soak_inner(seed: int, config: Optional[ChaosConfig],
+                         kills: int, timeout: float) -> Dict[str, Any]:
+    trainer_stop = threading.Event()
+    finish_gate = threading.Event()
+    finish_gate.set()  # completions ARE the capacity churn
+    prefix, _, inner, chaos, admin, tracker, _ = _soak_harness(
+        seed, "n", config, cases=[])
+    shapes = [
+        ("a", "", 4, {"accelerator": "v4-16", "numSlices": 2}),
+        ("b", "high", 2, {"accelerator": "v4-16"}),
+        ("c", "low", 1, None),
+    ]
+    cases: List[JobCase] = []
+    workloads: Dict[str, SchedWorkload] = {}
+    for suffix, priority, workers, tpu in shapes:
+        name = f"{prefix}-{suffix}"
+        spec: Dict[str, Any] = {
+            "runPolicy": {"backoffLimit": 60},
+            "tpuReplicaSpecs": {"Worker": {
+                "replicas": workers,
+                "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                "template": _tmpl()}},
+        }
+        if tpu:
+            spec["tpuReplicaSpecs"]["Worker"]["tpu"] = tpu
+        if priority:
+            spec["runPolicy"]["schedulingPolicy"] = {
+                "priorityClass": priority}
+        # slow enough (~6s nominal) that every gang outlives the storm's
+        # kill + node grace + migration: host death under a live gang must
+        # exercise the checkpoint-barrier migration, not race job
+        # completion past it (the default 30x0.01s trainer finished before
+        # a NotReady flip could ever commit, leaving the migration path
+        # vacuously green)
+        wl = SchedWorkload(admin, name, total_steps=300, tick_s=0.02,
+                           stop_event=trainer_stop, finish_gate=finish_gate)
+        cases.append(JobCase(job=_job(name, spec), scripts=wl.scripts(),
+                             expect_terminal="Succeeded"))
+        workloads[name] = wl
+    admissions = AdmissionTracker(NODE_SOAK_CAPACITY)
+    stall_tracker = StallTracker()
+    births = NodeBirthTracker()
+    migrations = _MigrationWatch(workloads)
+    for hook in (admissions.hook, stall_tracker.hook, births.hook,
+                 migrations.hook):
+        inner.hooks.append(hook)
+    scripts = [s for case in cases for s in case.scripts]
+    rng = random.Random(f"{seed}:node-kill")
+    started = time.monotonic()
+    trace_started0, trace_closed0 = TRACER.counters()
+    grace = NODE_SOAK_OVERRIDES["node_grace_s"]
+
+    agent = NodeAgentSim(admin, interval_s=0.1)
+    storm = NodeStorm(admin, agent, seed, grace_s=grace)
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts,
+                         node_down=storm.host_down)
+    app = _start_app(chaos, NODE_SOAK_OVERRIDES)
+    kubelet.start()
+    agent.start()
+    kill_log: List[Dict[str, float]] = []
+    try:
+        if not _wait_for(lambda: len(admin.nodes.list()) == 8,
+                         timeout=20.0):
+            raise AssertionError(
+                f"seed {seed}: node inventory never bootstrapped")
+        for case in cases:
+            admin.tpujobs.create(case.job)
+        # distinct slice per storm action so the flap's zero-effect
+        # invariant is never polluted by a hard fault on the same host
+        slices = rng.sample(range(4), 4)
+        host = lambda si, h: f"v4-16-p0-s{si}-h{h}"  # noqa: E731
+        time.sleep(rng.uniform(0.4, 0.8))  # let gangs admit and train
+        storm.flap(host(slices[0], rng.randrange(2)))
+        # kill an OCCUPIED host of the kill slice when one exists (a seeded
+        # kill of the fleet's one empty host would leave the stranded-gang
+        # migration invariant vacuous for the whole seed)
+        kill_candidates = [host(slices[1], h) for h in range(2)]
+        try:
+            bound = {p.spec.node_name for p in admin.pods.list()}
+        except ApiError:
+            bound = set()
+        occupied = [n for n in kill_candidates if n in bound]
+        storm.kill_host(rng.choice(occupied or kill_candidates))
+        cordon_target = host(slices[2], rng.randrange(2))
+        storm.cordon(cordon_target)
+        for _ in range(kills):
+            # seeded mid-storm hard kill: a migration barrier, health flip
+            # or re-admission may be mid-protocol — the restarted scheduler
+            # resumes from the committed annotations and re-judges node
+            # health from fresh monotonic anchors
+            time.sleep(rng.uniform(0.3, 0.8))
+            app.hard_kill()
+            headless_s = rng.uniform(0.05, 0.4)
+            time.sleep(headless_s)
+            app = _start_app(chaos, NODE_SOAK_OVERRIDES)
+            kill_log.append({"headless_s": round(headless_s, 3)})
+        outage = [host(slices[3], 0), host(slices[3], 1)]
+        storm.slice_outage(outage)
+        time.sleep(rng.uniform(2.0, 3.0) * grace)
+        storm.revive(outage)
+        storm.cordon(cordon_target, cordoned=False)
+        deadline = started + timeout
+        _converge_or_fail(admin, cases, deadline, seed, f" within {timeout}s")
+        problems = _settle_invariants(admin, app.controller, cases, tracker,
+                                      chaos, deadline)
+        problems += _node_job_problems(admin, workloads, admissions, storm,
+                                       births)
+        problems += stall_tracker.problems()
+        # no gang left across a dead host: at settle every live assignment
+        # avoids the storm's dead hosts (converged jobs released theirs)
+        for case in cases:
+            asg = _assignment_of(admin, case.job.metadata.name)
+            if asg is None:
+                continue
+            from tpujob.server.scheduler import assignment_node
+
+            span = [assignment_node(asg, o)
+                    for o in range(sum(s.host_hi - s.host_lo
+                                       for s in asg.slices))]
+            overlap = sorted(set(span) & set(storm.dead))
+            if overlap:
+                problems.append(
+                    f"{case.job.metadata.name}: assignment still spans "
+                    f"dead host(s) {overlap} at settle")
+        if problems:
+            raise AssertionError(
+                f"seed {seed}: node invariants violated:\n  "
+                + "\n  ".join(problems))
+        report = {
+            "mode": "nodes",
+            "seed": seed,
+            "jobs": len(cases),
+            "controller_kills": kills,
+            "kill_schedule": kill_log,
+            "storm": storm.log,
+            "migrations": {n: list(wl.migrated_from_records)
+                           for n, wl in sorted(workloads.items())
+                           if wl.migrated_from_records},
+            "not_ready_flips": len(births.not_ready_flips),
+            "duration_s": round(time.monotonic() - started, 3),
+            "api_faults": len(chaos.injected),
+            "invariants": "ok",
+        }
+    finally:
+        trainer_stop.set()
+        finish_gate.set()
+        agent.stop()
+        kubelet.stop()
+        app.shutdown()
+    trace_problems, trace_stats = check_trace_ledger(trace_started0,
+                                                     trace_closed0)
+    if trace_problems:
+        raise AssertionError(
+            f"seed {seed}: trace ledger violated across the node soak:\n  "
+            + "\n  ".join(trace_problems))
+    report["trace"] = trace_stats
+    return report

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Node-repair smoke: kill one host under a running 2-slice gang.
+
+The fast fleet-repair acceptance gate (``make node-smoke``, wired as a
+``make test`` prerequisite; budget ~5 s):
+
+- the ``--sched-capacity`` bootstrap synthesizes a 3-slice Node inventory
+  and the per-host agent sim heartbeats it; the scheduler's capacity model
+  is Node-backed (``/debug/fleet`` reports ``inventory: nodes``);
+- one host is hard-killed (heartbeat silence + its pods vanish): after the
+  bounded grace the node flips durably NotReady with a taint recording why,
+  and the gang is migrated through the checkpoint-barrier eviction —
+  publish target, workload ack, evict with NO failure strike, re-admit on
+  healthy hosts only;
+- the restore lands exactly on the barrier checkpoint, the Stalled
+  condition never flips (the churn windows are watchdog-exempt), zero
+  restarts are counted, and no pod is ever born onto a NotReady/cordoned
+  host (committed-stream hook).
+
+No API-transport faults here — the full NodeStorm under the fault schedule
++ controller hard-kills runs in ``make soak`` (nodes tier); this smoke
+isolates the inventory/health/migration protocol so a failure points
+straight at it.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from e2e.nodes import run_node_smoke
+
+
+def main() -> int:
+    logging.disable(logging.CRITICAL)
+    report = run_node_smoke(seed=17)
+    assert report["invariants"] == "ok"
+    print(f"node-smoke: OK (killed {report['victim']}; migrated via "
+          f"{report['migrated_from']}, restored at barrier checkpoint "
+          f"{report['barrier_checkpoint']}, zero counted restarts, "
+          f"in {report['duration_s']}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,7 @@ from e2e.chaos import (
     run_shard_soak,
     run_soak,
 )
+from e2e.nodes import run_node_soak
 from e2e.scheduler import run_sched_soak
 
 
@@ -72,6 +73,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--sched", action="store_true",
                         help="also run the gang-scheduler queue/preemption "
                              "tier for every seed (included in --crash)")
+    parser.add_argument("--nodes", action="store_true",
+                        help="also run the node chaos tier (host death, "
+                             "heartbeat flap, cordon churn, whole-slice "
+                             "outage + gang migration) for every seed "
+                             "(included in --crash)")
     parser.add_argument("--timeout", type=float, default=60.0,
                         help="per-seed convergence timeout (s)")
     parser.add_argument("--verbose", action="store_true",
@@ -109,6 +115,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         # scheduled eviction checkpoint-safe.  Same deadline floor as the
         # resize tier (many workload threads on a loaded host).
         runs.append(("sched", lambda seed: run_sched_soak(
+            seed, timeout=max(args.timeout, 120.0))))
+    if args.crash or args.nodes:
+        # node chaos tier: a seeded NodeStorm (hard host death, heartbeat
+        # flap inside one grace window, cordon/uncordon churn, whole-slice
+        # outage with recovery) over heartbeating Node inventory + the API
+        # fault schedule + a controller hard-kill; invariants: no pod born
+        # onto a NotReady/cordoned host, migrated gangs restore exactly at
+        # the barrier checkpoint with zero counted restarts, the flap
+        # changes nothing.  Same deadline floor as the resize/sched tiers.
+        runs.append(("nodes", lambda seed: run_node_soak(
             seed, timeout=max(args.timeout, 120.0))))
 
     failures = 0

@@ -32,6 +32,7 @@ EXPECTED_GROUP: Dict[str, Tuple[str, str]] = {
     "pods": ("", "v1"),
     "services": ("", "v1"),
     "events": ("", "v1"),
+    "nodes": ("", "v1"),
     "tpujobs": ("tpujob.dev", "v1"),
     "podgroups": ("scheduling.volcano.sh", "v1beta1"),
     "leases": ("coordination.k8s.io", "v1"),
@@ -41,6 +42,7 @@ KIND_OF = {
     "pods": "Pod",
     "services": "Service",
     "events": "Event",
+    "nodes": "Node",
     "tpujobs": "TPUJob",
     "podgroups": "PodGroup",
     "leases": "Lease",
@@ -58,6 +60,7 @@ INITIAL_STATUS = {
     "podgroups": None,
     "pods": {"phase": "Pending"},
     "services": {"loadBalancer": {}},
+    "nodes": {"phase": "Ready"},
 }
 # .status writes through the main resource (POST/PUT/merge-PATCH) are
 # ignored by the apiserver for exactly these resources

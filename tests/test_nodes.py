@@ -1,0 +1,414 @@
+"""Node inventory, health gating, and the CapacityModel property test.
+
+The property test is the satellite contract: random interleavings of
+place / release / cordon / node-death over a rebuilt-each-step
+CapacityModel must never yield a partial placement, a host double-booking,
+or a reservation surviving its node — seeded, with a shrinking
+counterexample printed on failure.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Set, Tuple
+
+import pytest
+
+from tpujob.api import constants as c
+from tpujob.api.nodes import (
+    make_node,
+    node_coord,
+    node_name,
+    synthesize_nodes,
+    validate_node,
+)
+from tpujob.api.quota import GangRequest, parse_capacity
+from tpujob.server.inventory import NodeHealth, build_inventory
+from tpujob.server.scheduler import (
+    Assignment,
+    CapacityModel,
+    assignment_node,
+)
+
+POOLS = parse_capacity("v4-16x3")  # 3 slices x 2 hosts
+
+
+def _req(name: str, num_slices: int = 1, hosts: int = 1,
+         tier: int = 1) -> GangRequest:
+    return GangRequest(namespace="default", name=name, generation="v4",
+                       accelerator="v4-16", num_slices=num_slices,
+                       hosts_per_slice=hosts, tier=tier)
+
+
+# ---------------------------------------------------------------------------
+# api/nodes
+# ---------------------------------------------------------------------------
+
+
+def test_node_name_and_coord_round_trip():
+    obj = make_node("v4-16", 0, 2, 1)
+    assert obj["metadata"]["name"] == "v4-16-p0-s2-h1"
+    assert node_coord(obj) == ("v4-16", (0, 2, 1))
+    assert node_name("v4-16", 0, 2, 1) == obj["metadata"]["name"]
+
+
+def test_synthesize_round_trips_through_inventory():
+    nodes = synthesize_nodes(POOLS)
+    assert len(nodes) == 6  # 3 slices x 2 hosts
+    inv = build_inventory(nodes, NodeHealth(grace_s=1.0))
+    assert len(inv.pools) == 1
+    assert inv.pools[0].accelerator == "v4-16"
+    assert inv.pools[0].count == 3
+    assert inv.pools[0].shape.hosts == 2
+    assert inv.unavailable == set()
+    assert len(inv.ready) == 6
+    assert not inv.has_real_nodes  # all carry the synthesized label
+
+
+def test_validate_node_rejects_garbage():
+    assert validate_node(make_node("v4-16", 0, 0, 0)) == []
+    bad = make_node("v4-16", 0, 0, 0)
+    bad["spec"]["pool"] = -1
+    assert any("spec.pool" in e for e in validate_node(bad))
+    bad2 = make_node("", 0, 0, 0)
+    bad2["spec"]["accelerator"] = ""
+    assert any("accelerator" in e for e in validate_node(bad2))
+
+
+def test_malformed_node_is_invisible_to_placement():
+    nodes = synthesize_nodes(POOLS)
+    nodes[0]["spec"]["hostIndex"] = "garbage"
+    inv = build_inventory(nodes, NodeHealth(grace_s=1.0))
+    # the malformed host's coordinate has no (valid) Node: unavailable
+    assert (0, 0, 0) in inv.unavailable
+
+
+# ---------------------------------------------------------------------------
+# heartbeat health
+# ---------------------------------------------------------------------------
+
+
+def test_never_heartbeated_node_is_judged_by_durable_status():
+    health = NodeHealth(grace_s=0.5)
+    obj = make_node("v4-16", 0, 0, 0)
+    assert health.observe(obj, now=0.0)
+    assert health.observe(obj, now=100.0)  # silence never kills it
+    obj["status"] = {"phase": c.NODE_NOT_READY}
+    assert not health.observe(obj, now=100.0)
+
+
+def test_heartbeat_staleness_flips_after_grace_and_flap_does_not():
+    health = NodeHealth(grace_s=1.0)
+    obj = make_node("v4-16", 0, 0, 0)
+    obj["metadata"]["annotations"] = {c.ANNOTATION_NODE_HEARTBEAT: "1"}
+    assert health.observe(obj, now=0.0)
+    # flap: a gap strictly inside one grace window changes nothing
+    assert health.observe(obj, now=0.9)
+    obj["metadata"]["annotations"][c.ANNOTATION_NODE_HEARTBEAT] = "2"
+    assert health.observe(obj, now=0.95)
+    assert health.stale_for(obj, now=0.95) is None
+    # silence past the grace: stale
+    assert not health.observe(obj, now=2.5)
+    assert health.stale_for(obj, now=2.5) == pytest.approx(1.55)
+    # a fresh lease value resurrects it (liveness beats durable NotReady)
+    obj["metadata"]["annotations"][c.ANNOTATION_NODE_HEARTBEAT] = "3"
+    obj["status"] = {"phase": c.NODE_NOT_READY}
+    assert health.observe(obj, now=2.6)
+
+
+def test_long_cordon_never_masquerades_as_heartbeat_silence():
+    """A cordoned node keeps heartbeating: observing it must keep
+    re-anchoring the lease, so a cordon lasting longer than one grace can
+    never produce a false 'heartbeat stale' verdict (which would flip the
+    live host durably NotReady and break instant uncordon)."""
+    health = NodeHealth(grace_s=1.0)
+    obj = make_node("v4-16", 0, 0, 0)
+    obj["metadata"]["annotations"] = {c.ANNOTATION_NODE_HEARTBEAT: "1"}
+    assert health.observe(obj, now=0.0)
+    obj["metadata"]["annotations"][c.ANNOTATION_NODE_CORDONED] = "ops"
+    # cordoned for 3 grace periods, heartbeat advancing the whole time
+    for i, t in enumerate((0.5, 1.4, 2.3, 3.2)):
+        obj["metadata"]["annotations"][c.ANNOTATION_NODE_HEARTBEAT] = str(i + 2)
+        assert not health.observe(obj, now=t)  # cordoned: excluded
+        assert health.stale_for(obj, now=t) is None  # but never stale
+    # instant reversibility: uncordon and it is Ready right away
+    del obj["metadata"]["annotations"][c.ANNOTATION_NODE_CORDONED]
+    assert health.observe(obj, now=3.3)
+
+
+def test_node_coordinates_are_bounded():
+    """One admitted Node must not be able to size the inventory grid
+    arbitrarily: out-of-bounds indices are a 422 at the boundary and
+    invisible to the parser (pre-admission objects)."""
+    from tpujob.api.nodes import MAX_POOL_INDEX, MAX_SLICE_INDEX
+
+    obj = make_node("v4-16", 0, 0, 0)
+    obj["spec"]["pool"] = MAX_POOL_INDEX + 1
+    assert node_coord(obj) is None
+    assert any("exceeds the maximum" in e for e in validate_node(obj))
+    obj["spec"]["pool"] = 0
+    obj["spec"]["slice"] = MAX_SLICE_INDEX + 1
+    assert node_coord(obj) is None
+    assert any("exceeds the maximum" in e for e in validate_node(obj))
+    obj["spec"]["slice"] = MAX_SLICE_INDEX  # at the bound: fine
+    assert node_coord(obj) is not None
+    # and an in-bounds huge-but-legal claim stays cheap: the grid tops out
+    # at the bounded extent instead of a node-chosen size
+    health = NodeHealth(grace_s=1.0)
+    inv = build_inventory([obj], health)
+    assert len(inv.pools) == 1
+
+
+def test_cordon_excludes_and_durable_not_ready_excludes():
+    health = NodeHealth(grace_s=1.0)
+    nodes = synthesize_nodes(POOLS)
+    nodes[0]["metadata"]["annotations"] = {
+        c.ANNOTATION_NODE_CORDONED: "ops"}
+    nodes[1]["status"] = {"phase": c.NODE_NOT_READY}
+    inv = build_inventory(nodes, health)
+    assert (0, 0, 0) in inv.unavailable  # cordoned
+    assert (0, 0, 1) in inv.unavailable  # durably NotReady
+    assert nodes[0]["metadata"]["name"] in inv.cordoned
+    assert nodes[1]["metadata"]["name"] in inv.not_ready
+
+
+def test_migration_damper_escalates_and_forget_sweeps():
+    health = NodeHealth(grace_s=1.0, damp_s=2.0)
+    assert health.migration_allowed("n", now=0.0)
+    health.note_migration("n", now=0.0)
+    assert not health.migration_allowed("n", now=1.0)
+    assert health.migration_allowed("n", now=2.5)
+    health.note_migration("n", now=2.5)  # second episode: 2x window
+    assert not health.migration_allowed("n", now=5.5)
+    assert health.migration_allowed("n", now=7.0)
+    assert health.forget("n")
+    assert health.migration_allowed("n", now=0.0)
+    assert len(health) == 0
+
+
+def test_health_ledger_is_lru_bounded():
+    health = NodeHealth(grace_s=1.0)
+    for i in range(NodeHealth.MAX_ENTRIES + 64):
+        health.observe(make_node("v4-16", 0, 0, i), now=float(i) * 1e-6)
+    assert len(health) == NodeHealth.MAX_ENTRIES
+
+
+# ---------------------------------------------------------------------------
+# assignment -> node binding
+# ---------------------------------------------------------------------------
+
+
+def test_assignment_node_mapping_is_deterministic():
+    asg = Assignment.from_json(
+        '{"accelerator":"v4-16","chips":16,"slices":['
+        '{"pool":0,"slice":1,"hosts":[0,2]},'
+        '{"pool":0,"slice":2,"hosts":[0,2]}]}')
+    assert [assignment_node(asg, o) for o in range(4)] == [
+        "v4-16-p0-s1-h0", "v4-16-p0-s1-h1",
+        "v4-16-p0-s2-h0", "v4-16-p0-s2-h1"]
+    # out-of-extent ordinals clamp instead of crashing (mid-re-place gangs)
+    assert assignment_node(asg, 99) == "v4-16-p0-s2-h1"
+    assert assignment_node(asg, -1) is None
+
+
+def test_blocked_hosts_counts_coordinates_outside_the_shrunken_grid():
+    """Deleting a pool's highest slice (or a whole pool) shrinks the
+    derived grid, so the vanished hosts never enter ``unavailable`` — a
+    committed assignment still naming them is stranded all the same and
+    must trigger the migration."""
+    asg = Assignment.from_json(
+        '{"accelerator":"v4-16","chips":16,"slices":['
+        '{"pool":0,"slice":2,"hosts":[0,2]}]}')
+    # full grid, all healthy: nothing blocked
+    assert CapacityModel(POOLS).blocked_hosts(asg) == []
+    # the top slice's nodes vanished: grid derives 2 slices, the
+    # assignment's slice-2 hosts are outside it -> blocked
+    shrunk = parse_capacity("v4-16x2")
+    assert CapacityModel(shrunk).blocked_hosts(asg) == [
+        (0, 2, 0), (0, 2, 1)]
+    # the whole pool vanished
+    assert CapacityModel([]).blocked_hosts(asg) == [(0, 2, 0), (0, 2, 1)]
+
+
+def test_place_skips_unavailable_hosts_atomically():
+    cap = CapacityModel(POOLS, unavailable={(0, 0, 0), (0, 1, 1)})
+    asg = cap.place(_req("a", num_slices=2, hosts=2), "default/a")
+    # only slice 2 has two healthy adjacent hosts; a 2x2 gang cannot place
+    assert asg is None
+    assert cap.used_hosts() == 0  # nothing mutated on failure
+    one = cap.place(_req("b", num_slices=1, hosts=2), "default/b")
+    assert one is not None
+    assert all(s.slice_index == 2 for s in one.slices)
+    assert cap.blocked_hosts(one) == []
+
+
+# ---------------------------------------------------------------------------
+# the property test (satellite): random interleavings of
+# reserve/release/cordon/node-death, rebuilt each step like the tick
+# ---------------------------------------------------------------------------
+
+Op = Tuple  # ("place", owner, num_slices, hosts) | ("release", owner)
+# | ("kill", coord) | ("revive", coord)
+
+COORDS = [(0, s, h) for s in range(3) for h in range(2)]
+
+
+def _gen_ops(rng: random.Random, n: int) -> List[Op]:
+    ops: List[Op] = []
+    owners = [f"default/j{i}" for i in range(6)]
+    for _ in range(n):
+        kind = rng.random()
+        if kind < 0.45:
+            ops.append(("place", rng.choice(owners),
+                        rng.choice([1, 1, 1, 2, 3]),
+                        rng.choice([1, 1, 2])))
+        elif kind < 0.6:
+            ops.append(("release", rng.choice(owners)))
+        elif kind < 0.85:
+            ops.append(("kill", rng.choice(COORDS)))
+        else:
+            ops.append(("revive", rng.choice(COORDS)))
+    return ops
+
+
+def _run_ops(ops: List[Op]) -> Optional[str]:
+    """Replay one interleaving the way the tick does — model rebuilt from
+    the live assignment set + unavailable hosts at every step — and return
+    the first invariant violation (None = clean)."""
+    assignments: Dict[str, Assignment] = {}
+    unavailable: Set[Tuple[int, int, int]] = set()
+
+    def rebuild() -> Tuple[CapacityModel, Optional[str]]:
+        cap = CapacityModel(POOLS, unavailable)
+        for owner, asg in assignments.items():
+            conflicts = cap.reserve(owner, asg)
+            if conflicts:
+                return cap, f"double-booking: {conflicts}"
+        return cap, None
+
+    for i, op in enumerate(ops):
+        if op[0] == "place":
+            _, owner, num_slices, hosts = op
+            if owner in assignments:
+                continue
+            cap, err = rebuild()
+            if err:
+                return f"op {i} {op}: {err}"
+            asg = cap.place(_req(owner, num_slices, hosts), owner)
+            if asg is None:
+                continue
+            if (len(asg.slices) != num_slices
+                    or any(s.host_hi - s.host_lo != hosts
+                           for s in asg.slices)):
+                return (f"op {i} {op}: PARTIAL placement {asg}")
+            if cap.blocked_hosts(asg):
+                return (f"op {i} {op}: placed onto unavailable host(s) "
+                        f"{cap.blocked_hosts(asg)}")
+            assignments[owner] = asg
+        elif op[0] == "release":
+            assignments.pop(op[1], None)
+        elif op[0] == "kill":
+            unavailable.add(op[1])
+            # the tick migrates every gang touching a dead/cordoned host:
+            # release it and (maybe) re-place — no reservation may survive
+            # its node
+            cap, err = rebuild()
+            if err:
+                return f"op {i} {op}: {err}"
+            for owner in [o for o, a in assignments.items()
+                          if cap.blocked_hosts(a)]:
+                old = assignments.pop(owner)
+                cap2, err = rebuild()
+                if err:
+                    return f"op {i} {op}: {err}"
+                re_placed = cap2.place(
+                    _req(owner, len(old.slices),
+                         old.slices[0].host_hi - old.slices[0].host_lo),
+                    owner)
+                if re_placed is not None:
+                    if cap2.blocked_hosts(re_placed):
+                        return (f"op {i} {op}: migration re-placed {owner} "
+                                "onto unavailable host(s)")
+                    assignments[owner] = re_placed
+        elif op[0] == "revive":
+            unavailable.discard(op[1])
+        # post-state: nothing survives its node, nothing double-books
+        cap, err = rebuild()
+        if err:
+            return f"op {i} {op}: {err}"
+        for owner, asg in assignments.items():
+            bad = cap.blocked_hosts(asg)
+            if bad:
+                return (f"op {i} {op}: reservation of {owner} survives its "
+                        f"dead node(s) {bad}")
+    return None
+
+
+def _shrink(ops: List[Op]) -> List[Op]:
+    """Greedy 1-minimal shrink: drop ops while the failure persists."""
+    i = 0
+    while i < len(ops):
+        candidate = ops[:i] + ops[i + 1:]
+        if _run_ops(candidate) is not None:
+            ops = candidate
+        else:
+            i += 1
+    return ops
+
+
+# ---------------------------------------------------------------------------
+# scheduler-level pure functions (no controller needed)
+# ---------------------------------------------------------------------------
+
+
+def _bare_scheduler(capacity: str = "v4-16x3"):
+    from types import SimpleNamespace
+
+    from tpujob.server.scheduler import GangScheduler
+
+    return GangScheduler(controller=SimpleNamespace(node_informer=None,
+                                                    sharder=None),
+                         capacity=capacity)
+
+
+def test_never_placeable_is_judged_against_the_bootstrap_shape():
+    sched = _bare_scheduler("v4-16x3")
+    # degrade the LIVE pools (a half-bootstrapped / shrunken inventory)
+    sched.pools = parse_capacity("v4-16x1")
+    # fits the configured fleet: must NOT earn the irreversible verdict
+    assert sched._never_placeable(_req("a", num_slices=2, hosts=2)) is None
+    # infeasible on BOTH: the durable verdict stands
+    assert sched._never_placeable(_req("b", num_slices=4, hosts=2))
+
+
+def test_debug_snapshot_reports_inventory_mode_and_migrations():
+    sched = _bare_scheduler()
+    snap = sched.debug_snapshot()
+    assert snap["inventory"] == "modeled"
+    assert snap["migrations_total"] == 0
+    assert snap["nodes"] is None
+
+
+def test_forget_node_sweeps_ledgers():
+    sched = _bare_scheduler()
+    obj = make_node("v4-16", 0, 0, 0)
+    obj["metadata"]["annotations"] = {c.ANNOTATION_NODE_HEARTBEAT: "1"}
+    with sched._lock:
+        sched.health.observe(obj, now=0.0)
+        sched.health.note_migration(obj["metadata"]["name"], now=0.0)
+    sched._health_sent[obj["metadata"]["name"]] = "NotReady"
+    sched.forget_node(obj["metadata"]["name"])
+    assert len(sched.health) == 0
+    assert not sched._health_sent
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_capacity_model_interleaving_property(seed):
+    rng = random.Random(f"capacity-prop:{seed}")
+    ops = _gen_ops(rng, 60)
+    err = _run_ops(ops)
+    if err is not None:
+        minimal = _shrink(list(ops))
+        pytest.fail(
+            f"seed {seed}: {err}\nshrunk counterexample "
+            f"({len(minimal)} op(s)): {minimal}\n"
+            f"final error: {_run_ops(minimal)}")

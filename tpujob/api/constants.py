@@ -125,3 +125,31 @@ ANNOTATION_SCHED_ASSIGNMENT = f"{GROUP_NAME}/sched-assignment"
 ANNOTATION_SCHED_EVICTED = f"{GROUP_NAME}/sched-evicted"
 ANNOTATION_PREEMPT_TARGET = f"{GROUP_NAME}/preempt-target"
 ANNOTATION_PREEMPT_ACK = f"{GROUP_NAME}/preempt-ack"
+
+# --- node inventory & fleet repair -------------------------------------------
+# Nodes are a first-class resource: each Node object names one TPU host VM
+# (its slice pool, slice index and torus host coordinate) and carries a
+# heartbeat lease.  The scheduler's CapacityModel is rebuilt from the live
+# Node informer cache each tick; `--sched-capacity` becomes a bootstrap
+# fallback that SYNTHESIZES Node objects so modeled fleets keep working.
+#
+# - NODE_HEARTBEAT: the node agent's liveness lease, bumped on the node's own
+#   object.  Staleness is judged on the CONTROLLER's monotonic clock (the
+#   PR-10 watchdog stance); a node that has never heartbeated is judged by
+#   its durable status alone (synthesized fleets never die by silence).
+# - NODE_CORDONED ("tpujob.dev/unschedulable"): operator cordon marker — the
+#   host is excluded from placement and its gangs are migrated, exactly like
+#   a dead host but human-initiated and instantly reversible.
+# - NODE_TAINT: durable record of WHY the node is NotReady/cordoned, written
+#   by the scheduler duty when it flips the node's phase.
+# - MIGRATED_FROM (on TPUJobs): the host(s) a scheduled migration vacated a
+#   gang from — set when the migration's preempt-target publishes, cleared
+#   with the assignment on release.
+ANNOTATION_NODE_HEARTBEAT = f"{GROUP_NAME}/heartbeat"
+ANNOTATION_NODE_CORDONED = f"{GROUP_NAME}/unschedulable"
+ANNOTATION_NODE_TAINT = f"{GROUP_NAME}/taint"
+ANNOTATION_MIGRATED_FROM = f"{GROUP_NAME}/migrated-from"
+# marks Node objects synthesized from the --sched-capacity bootstrap string
+LABEL_NODE_SYNTHESIZED = f"{GROUP_NAME}/synthesized"
+NODE_READY = "Ready"
+NODE_NOT_READY = "NotReady"

@@ -1,0 +1,150 @@
+"""Node inventory helpers: naming, synthesis, coordinates, health grammar.
+
+A Node object names one TPU host VM of the fleet:
+
+- ``spec.accelerator`` / ``spec.pool`` / ``spec.slice`` / ``spec.hostIndex``
+  pin the host to one torus coordinate of one slice of one pool — the same
+  (pool, slice, host) address space the scheduler's :class:`CapacityModel`
+  allocates over, so an :class:`~tpujob.server.scheduler.Assignment` interval
+  maps 1:1 onto Node names;
+- ``metadata.annotations["tpujob.dev/heartbeat"]`` is the node agent's
+  liveness lease (staleness is judged on the controller's monotonic clock);
+- ``metadata.annotations["tpujob.dev/unschedulable"]`` cordons the host;
+- ``status.phase`` (Ready/NotReady) is the DURABLE health verdict the
+  scheduler duty writes after the bounded heartbeat grace, with
+  ``tpujob.dev/taint`` recording why.
+
+Nodes ride the same transport dialect as every other resource (namespaced,
+default namespace) — a real-cluster adapter would map them onto the
+cluster-scoped core/v1 Node surface.
+
+``synthesize_nodes`` is the ``--sched-capacity`` bootstrap: a modeled fleet
+string becomes real Node objects once, so every pre-inventory test/bench/
+soak shape keeps working while the scheduler only ever places against live
+Node state.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpujob.api import constants as c
+from tpujob.api.quota import SlicePoolSpec
+
+NodeCoord = Tuple[int, int, int]  # (pool, slice, host)
+
+# Upper bounds on node-DECLARED coordinates: the inventory materializes
+# pool/slice grids sized by the largest index any Node claims, so an
+# unbounded index would let one admitted object allocate an arbitrarily
+# large grid (and sweep its absent cells) on every scheduler tick.
+# Generous for any real fleet — a v4-4096-scale pool is ~512 hosts.
+MAX_POOL_INDEX = 63
+MAX_SLICE_INDEX = 4095
+MAX_HOST_INDEX = 4095
+_COORD_MAX = {"pool": MAX_POOL_INDEX, "slice": MAX_SLICE_INDEX,
+              "hostIndex": MAX_HOST_INDEX}
+
+
+def node_name(accelerator: str, pool: int, slice_index: int,
+              host: int) -> str:
+    """Canonical Node name for one host coordinate, derivable from an
+    Assignment without consulting the inventory."""
+    return f"{accelerator}-p{pool}-s{slice_index}-h{host}"
+
+
+def make_node(accelerator: str, pool: int, slice_index: int, host: int,
+              synthesized: bool = False) -> Dict[str, Any]:
+    """One Node object dict for the given host coordinate."""
+    labels: Dict[str, str] = {}
+    if synthesized:
+        labels[c.LABEL_NODE_SYNTHESIZED] = "true"
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {
+            "name": node_name(accelerator, pool, slice_index, host),
+            "namespace": "default",
+            "labels": labels,
+        },
+        "spec": {
+            "accelerator": accelerator,
+            "pool": pool,
+            "slice": slice_index,
+            "hostIndex": host,
+        },
+        "status": {"phase": c.NODE_READY},
+    }
+
+
+def synthesize_nodes(pools: List[SlicePoolSpec]) -> List[Dict[str, Any]]:
+    """The ``--sched-capacity`` bootstrap: one Node per host of the modeled
+    fleet, labeled synthesized.  Synthesized nodes carry no heartbeat, so
+    they are judged by durable status alone (Ready) — a modeled host never
+    dies by silence, only by explicit cordon/status writes."""
+    out: List[Dict[str, Any]] = []
+    for pi, pool in enumerate(pools):
+        for si in range(pool.count):
+            for h in range(pool.shape.hosts):
+                out.append(make_node(pool.accelerator, pi, si, h,
+                                     synthesized=True))
+    return out
+
+
+def node_coord(obj: Dict[str, Any]) -> Optional[Tuple[str, NodeCoord]]:
+    """(accelerator, (pool, slice, host)) of one Node object, or None when
+    the spec is malformed — a garbage node is invisible to placement, never
+    a crash."""
+    spec = obj.get("spec") or {}
+    accel = spec.get("accelerator")
+    try:
+        coord = (int(spec["pool"]), int(spec["slice"]),
+                 int(spec["hostIndex"]))
+    except (KeyError, TypeError, ValueError):
+        return None
+    if not accel or any(v < 0 for v in coord):
+        return None
+    if (coord[0] > MAX_POOL_INDEX or coord[1] > MAX_SLICE_INDEX
+            or coord[2] > MAX_HOST_INDEX):
+        return None  # out-of-bounds grid claim (pre-admission object)
+    return str(accel), coord
+
+
+def node_heartbeat(obj: Dict[str, Any]) -> Optional[str]:
+    """The node's heartbeat lease value (an opaque string the agent bumps),
+    or None for a node that has never heartbeated."""
+    ann = (obj.get("metadata") or {}).get("annotations") or {}
+    return ann.get(c.ANNOTATION_NODE_HEARTBEAT)
+
+
+def is_cordoned(obj: Dict[str, Any]) -> bool:
+    ann = (obj.get("metadata") or {}).get("annotations") or {}
+    return ann.get(c.ANNOTATION_NODE_CORDONED) is not None
+
+
+def node_phase(obj: Dict[str, Any]) -> str:
+    """The durable health verdict (defaults Ready: a node with no status
+    yet is schedulable until proven otherwise)."""
+    status = obj.get("status")
+    status = status if isinstance(status, dict) else {}
+    return status.get("phase") or c.NODE_READY
+
+
+def validate_node(obj: Dict[str, Any]) -> List[str]:
+    """Why this Node object is malformed (empty = valid): a node the
+    placement math cannot address must be rejected at the write boundary,
+    not silently skipped forever."""
+    errs: List[str] = []
+    name = (obj.get("metadata") or {}).get("name")
+    if not name:
+        errs.append("metadata.name is required")
+    spec = obj.get("spec") or {}
+    if not spec.get("accelerator"):
+        errs.append("spec.accelerator is required")
+    for fld in ("pool", "slice", "hostIndex"):
+        v = spec.get(fld)
+        if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            errs.append(f"spec.{fld}: expected a non-negative integer, "
+                        f"got {v!r}")
+        elif v > _COORD_MAX[fld]:
+            errs.append(f"spec.{fld}: {v} exceeds the maximum grid index "
+                        f"{_COORD_MAX[fld]}")
+    return errs

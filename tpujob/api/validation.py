@@ -306,12 +306,33 @@ def tpujob_update_admission(verb: str, resource: str,
             f"TPUJob {name} update rejected: " + "; ".join(errs))
 
 
+def node_create_admission(verb: str, resource: str,
+                          old: Optional[Dict[str, Any]],
+                          new: Dict[str, Any]) -> None:
+    """CREATE admission for Node objects: a node the placement math cannot
+    address (missing accelerator, negative/non-integer coordinates) is a
+    422 at the write boundary, not a host silently invisible to every
+    scheduler tick forever."""
+    if resource != "nodes" or old is not None:
+        return
+    from tpujob.api.nodes import validate_node
+
+    errs = validate_node(new)
+    if errs:
+        from tpujob.kube.errors import InvalidError
+
+        name = (new.get("metadata") or {}).get("name")
+        raise InvalidError(
+            f"Node {name} create rejected: " + "; ".join(errs))
+
+
 def install_tpujob_admission(server) -> None:
-    """Register TPUJob CREATE + UPDATE admission on an in-memory API server
-    (idempotent)."""
+    """Register TPUJob CREATE + UPDATE and Node CREATE admission on an
+    in-memory API server (idempotent)."""
     validators = getattr(server, "admission_validators", None)
     if validators is None:
         return
-    for validator in (tpujob_create_admission, tpujob_update_admission):
+    for validator in (tpujob_create_admission, tpujob_update_admission,
+                      node_create_admission):
         if validator not in validators:
             validators.append(validator)

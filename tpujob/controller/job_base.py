@@ -19,6 +19,7 @@ from tpujob.analysis import lockgraph
 from tpujob.api import constants as c
 from tpujob.api.types import TPUJob
 from tpujob.kube.client import (
+    RESOURCE_NODES,
     RESOURCE_PODS,
     RESOURCE_SERVICES,
     RESOURCE_TPUJOBS,
@@ -339,6 +340,13 @@ class JobController:
         self.job_informer = self.factory.informer(RESOURCE_TPUJOBS)
         self.pod_informer = self.factory.informer(RESOURCE_PODS)
         self.service_informer = self.factory.informer(RESOURCE_SERVICES)
+        # node inventory (fleet repair): every member watches every Node so
+        # the scheduler rebuilds its capacity model from the live cache and
+        # the reconciler gates pod creation on host health.  An empty store
+        # costs one quiet watch; the scheduler synthesizes Nodes from
+        # --sched-capacity at bootstrap when none exist.
+        self.node_informer = self.factory.informer(RESOURCE_NODES)
+        self.node_informer.on_delete(self._on_node_delete)
 
         self.pod_informer.on_add(self.add_pod)
         self.pod_informer.on_update(self.update_pod)
@@ -369,6 +377,16 @@ class JobController:
         """Attach the gang scheduler BEFORE run(): from then on the
         admission gate holds every job's pods until its gang is admitted."""
         self.scheduler = scheduler
+
+    def _on_node_delete(self, obj: Dict[str, Any]) -> None:
+        """A Node object left the cluster: sweep its per-node damper and
+        health-anchor ledgers from the scheduler (the LRU-map hygiene the
+        PR-3 token buckets follow) so node churn cannot grow them."""
+        if self.scheduler is None:
+            return
+        name = (obj.get("metadata") or {}).get("name")
+        if name:
+            self.scheduler.forget_node(name)
 
     def _shard_of_obj(self, obj: Optional[Dict[str, Any]]) -> Optional[int]:
         """The shard a job object lives in (consistent hash of its UID), or

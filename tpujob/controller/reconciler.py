@@ -695,6 +695,24 @@ class TPUJobController(JobController):
                     {"rtype": rtype, "indices": delayed,
                      "wait_s": round(wait, 3)})
                 self.queue.add_after(job.key, wait)
+            if ready and self.scheduler is not None:
+                # host-health gate: a replacement must never be BORN onto a
+                # NotReady/cordoned/dead host.  The index's bound host comes
+                # from the committed assignment; an excluded host's index
+                # waits (requeued) for the scheduler's migration to re-place
+                # the gang on healthy hardware.
+                gated = [i for i in ready if self.scheduler.node_excluded(
+                    self.scheduler.node_for(job, rtype, i))]
+                if gated:
+                    ready = [i for i in ready if i not in gated]
+                    self.flight.record(
+                        job.key, "sched",
+                        f"holding replacement pod(s) {gated} [{rtype}]: "
+                        "bound host is NotReady/cordoned (awaiting "
+                        "migration)",
+                        {"kind": "node-gate", "rtype": rtype,
+                         "indices": gated})
+                    self.queue.add_after(job.key, 0.2)
             if ready:
                 # all unthrottled missing replicas of this type launch
                 # concurrently (a v4-32 job's 8 hosts cost ~1 API round
@@ -792,6 +810,13 @@ class TPUJobController(JobController):
         tpu_env.set_cluster_spec(pod, job, rtype, index, port)
         self._set_restart_policy(pod, rspec)
         self._apply_tpu_scheduling(pod, rspec, job)
+        if self.scheduler is not None:
+            # host binding from the gang's committed assignment: the
+            # pod->Node edge host-failure-domain faults (and the "no pod
+            # born onto a NotReady/cordoned host" invariant) hang off
+            node = self.scheduler.node_for(job, rtype, index)
+            if node is not None:
+                pod.spec.node_name = node
 
         # non-coordinator pods wait for the coordinator DNS
         # (pod.go:189-198, util.go:61-87); in master-less jobs worker-0 is
@@ -1226,18 +1251,28 @@ class TPUJobController(JobController):
         # -- queued (or being evicted): no pods may run ---------------------
         preempted = (ann.get(c.ANNOTATION_SCHED_EVICTED) is not None
                      or bool(pods))
-        reason = (st.REASON_JOB_PREEMPTED if preempted
-                  else st.REASON_JOB_QUEUED)
-        message = (
-            f"TPUJob {job.metadata.name} was preempted; re-queued for "
-            "admission." if preempted else
-            f"TPUJob {job.metadata.name} is queued: waiting for "
-            f"all-or-nothing admission of "
-            f"{self.scheduler.request_summary(job)}.")
+        migrated = ann.get(c.ANNOTATION_MIGRATED_FROM)
+        if preempted and migrated:
+            # a scheduled migration off a dead/cordoned host, not a
+            # capacity preemption: the queue history must say which
+            reason = st.REASON_JOB_MIGRATED
+            message = (f"TPUJob {job.metadata.name} is migrating off "
+                       f"unavailable host(s) {migrated}; re-queued for "
+                       "admission on healthy hardware.")
+        elif preempted:
+            reason = st.REASON_JOB_PREEMPTED
+            message = (f"TPUJob {job.metadata.name} was preempted; "
+                       "re-queued for admission.")
+        else:
+            reason = st.REASON_JOB_QUEUED
+            message = (f"TPUJob {job.metadata.name} is queued: waiting for "
+                       f"all-or-nothing admission of "
+                       f"{self.scheduler.request_summary(job)}.")
         existing = st.get_condition(job.status, c.JOB_QUEUED)
         newly = existing is None or existing.status != "True"
         if newly or (preempted
-                     and existing.reason != st.REASON_JOB_PREEMPTED):
+                     and existing.reason not in (st.REASON_JOB_PREEMPTED,
+                                                 st.REASON_JOB_MIGRATED)):
             # Preempted is sticky for this queued life: once the eviction
             # markers clear (pods gone, capacity released) the gate must
             # not downgrade the reason back to plain Queued — the queue

@@ -38,6 +38,7 @@ REASON_PROGRESS_RESUMED = "TPUJobProgressResumed"
 REASON_JOB_QUEUED = "TPUJobQueued"
 REASON_JOB_ADMITTED = "TPUJobAdmitted"
 REASON_JOB_PREEMPTED = "TPUJobPreempted"
+REASON_JOB_MIGRATED = "TPUJobMigrated"  # evicted off a dead/cordoned host
 REASON_JOB_UNSCHEDULABLE = "TPUJobUnschedulable"
 
 

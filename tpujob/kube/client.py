@@ -13,7 +13,7 @@ from typing import Dict, Generic, List, Optional, Type, TypeVar
 from tpujob.api import constants as c
 from tpujob.api.types import TPUJob
 from tpujob.kube.memserver import InMemoryAPIServer, Watch
-from tpujob.kube.objects import Event, K8sObject, Pod, PodGroup, Service
+from tpujob.kube.objects import Event, K8sObject, Node, Pod, PodGroup, Service
 
 T = TypeVar("T", bound=K8sObject)
 
@@ -22,6 +22,7 @@ RESOURCE_PODS = "pods"
 RESOURCE_SERVICES = "services"
 RESOURCE_EVENTS = "events"
 RESOURCE_PODGROUPS = "podgroups"
+RESOURCE_NODES = "nodes"
 
 
 class TypedClient(Generic[T]):
@@ -107,6 +108,17 @@ class EventInterface(TypedClient[Event]):
         super().__init__(server, RESOURCE_EVENTS, Event)
 
 
+class NodeInterface(TypedClient[Node]):
+    """Typed Node client with the status subresource (the durable
+    Ready/NotReady verdict rides /status like every other health write)."""
+
+    def __init__(self, server: InMemoryAPIServer):
+        super().__init__(server, RESOURCE_NODES, Node)
+
+    def update_status(self, node: Node) -> Node:
+        return Node.from_dict(self.server.update_status(self.resource, node.to_dict()))
+
+
 class ClientSet:
     """All typed clients over one transport (the reference builds 4 clientsets
     in ``app/server.go:176-199``; here one transport serves them all).
@@ -129,3 +141,4 @@ class ClientSet:
         self.services = ServiceInterface(server)
         self.podgroups = PodGroupInterface(server)
         self.events = EventInterface(server)
+        self.nodes = NodeInterface(server)

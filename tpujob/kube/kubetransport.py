@@ -53,6 +53,7 @@ API_GROUPS: Dict[str, Tuple[str, str, str]] = {
     "pods": ("/api/v1", "v1", "Pod"),
     "services": ("/api/v1", "v1", "Service"),
     "events": ("/api/v1", "v1", "Event"),
+    "nodes": ("/api/v1", "v1", "Node"),
     c.PLURAL: (f"/apis/{c.GROUP_NAME}/{c.VERSION}", c.API_VERSION, c.KIND),
     "podgroups": (
         "/apis/scheduling.volcano.sh/v1beta1",
@@ -68,7 +69,7 @@ API_GROUPS: Dict[str, Tuple[str, str, str]] = {
 
 # strategic merge patch exists only for built-in types; custom resources
 # take RFC 7386 merge patches
-_CORE_RESOURCES = {"pods", "services", "events"}
+_CORE_RESOURCES = {"pods", "services", "events", "nodes"}
 
 
 class KubeConfigError(ApiError):

@@ -202,6 +202,10 @@ class PodSpec(K8sObject):
     init_containers: List[Container] = field(default_factory=list, metadata={"elem": Container})
     restart_policy: Optional[str] = None  # Always | OnFailure | Never
     scheduler_name: Optional[str] = None
+    # host binding: stamped by the reconciler from the gang's committed
+    # sched-assignment, so host-failure-domain faults (and the "no pod born
+    # onto a NotReady/cordoned host" invariant) have a pod->Node edge
+    node_name: Optional[str] = None
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[Dict[str, Any]] = field(default_factory=list)
     volumes: List[Dict[str, Any]] = field(default_factory=list)
@@ -335,6 +339,46 @@ class PodGroup(K8sObject):
     metadata: ObjectMeta = field(default_factory=ObjectMeta, metadata={"cls": ObjectMeta})
     spec: PodGroupSpec = field(default_factory=PodGroupSpec, metadata={"cls": PodGroupSpec})
     status: Dict[str, Any] = field(default_factory=dict)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# nodes (TPU host inventory)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NodeSpec(K8sObject):
+    """One TPU host VM's fleet coordinate: which slice of which pool it
+    belongs to and where it sits in the slice's torus host order (the
+    address space the scheduler's CapacityModel allocates over)."""
+
+    accelerator: str = ""  # e.g. "v4-16"
+    pool: int = 0  # index into the fleet's slice pools
+    slice: int = 0  # which slice of the pool
+    host_index: int = 0  # torus host coordinate (snake order)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class NodeStatus(K8sObject):
+    """The durable health verdict (Ready/NotReady), written by the
+    scheduler duty after the bounded heartbeat grace; the WHY rides the
+    tpujob.dev/taint annotation."""
+
+    phase: str = "Ready"  # Ready | NotReady
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Node(K8sObject):
+    """A TPU host VM of the fleet inventory (see tpujob.api.nodes)."""
+
+    api_version: str = "v1"
+    kind: str = "Node"
+    metadata: ObjectMeta = field(default_factory=ObjectMeta, metadata={"cls": ObjectMeta})
+    spec: NodeSpec = field(default_factory=NodeSpec, metadata={"cls": NodeSpec})
+    status: NodeStatus = field(default_factory=NodeStatus, metadata={"cls": NodeStatus})
     extra: Dict[str, Any] = field(default_factory=dict)
 
 

@@ -300,7 +300,7 @@ TRACER = Tracer()
 # ---------------------------------------------------------------------------
 
 _KNOWN_RESOURCES = frozenset(
-    {"pods", "services", "events", "tpujobs", "podgroups", "leases"}
+    {"pods", "services", "events", "tpujobs", "podgroups", "leases", "nodes"}
 )
 
 

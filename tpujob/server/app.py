@@ -186,6 +186,8 @@ class OperatorApp:
                 aging_s=opt.scheduler_aging_s,
                 enable_preemption=opt.scheduler_preemption,
                 preempt_grace_s=opt.scheduler_preempt_grace_s,
+                node_grace_s=opt.node_grace_s,
+                node_damp_s=opt.node_migration_damp_s,
             )
             self.controller.set_scheduler(self.scheduler)
         self.monitoring: Optional[MonitoringServer] = None

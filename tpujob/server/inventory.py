@@ -1,0 +1,270 @@
+"""Node inventory: live-fleet capacity + heartbeat health for the scheduler.
+
+The PR-11 gang scheduler placed against a *modeled* ``--sched-capacity``
+string, so a dead host was invisible: the fleet kept assigning gangs onto
+hardware that no longer existed.  This module makes Nodes the source of
+truth:
+
+- :class:`NodeHealth` judges each node's liveness on the CONTROLLER's
+  monotonic clock (the PR-10 watchdog stance): a node whose heartbeat
+  annotation has not changed for the bounded grace is *stale*; a node that
+  has NEVER heartbeated is judged by its durable ``status.phase`` alone
+  (synthesized/modeled hosts never die by silence).  Per-node state is
+  LRU-bounded and swept when the Node object is deleted — the PR-3
+  token-bucket discipline, so a long node-churn soak cannot grow it
+  without bound.
+- :class:`NodeHealth` also owns the per-node **migration damper**: a host
+  may trigger at most one gang-migration episode per damping window (the
+  window doubles per episode, capped), so a flapping node can never drive
+  a migration storm.
+- :func:`build_inventory` folds the Node informer cache into the
+  ``(pools, unavailable-host set)`` pair the
+  :class:`~tpujob.server.scheduler.CapacityModel` is rebuilt from each
+  tick.  A host is unavailable when its node is cordoned
+  (``tpujob.dev/unschedulable``), effectively NotReady, or simply absent
+  from the inventory.
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from tpujob.api import constants as c
+from tpujob.api.nodes import (
+    NodeCoord,
+    is_cordoned,
+    node_coord,
+    node_heartbeat,
+    node_phase,
+)
+from tpujob.api.quota import SlicePoolSpec
+from tpujob.api.topology import SliceTopology, TopologyError
+
+
+@dataclass
+class _NodeEntry:
+    """Per-node monotonic ledger: heartbeat anchor + migration damper."""
+
+    heartbeat: Optional[str] = None  # last observed lease value
+    changed_at: float = 0.0  # monotonic instant the value last changed
+    # migration damper: no new migration episode may be triggered by this
+    # node before this monotonic instant; episodes escalate the window
+    hold_until: float = 0.0
+    episodes: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class NodeHealth:
+    """Monotonic heartbeat-staleness judge + per-node migration damper.
+
+    NOT thread-safe by design: one instance rides one GangScheduler, whose
+    tick is single-threaded; the reconciler-facing reads go through the
+    scheduler's lock.
+    """
+
+    # LRU bound on per-node entries (the PR-3 token-bucket discipline):
+    # churn through more node names than this evicts the oldest — an
+    # evicted-then-reobserved node conservatively restarts its grace.
+    MAX_ENTRIES = 4096
+
+    def __init__(self, grace_s: float, damp_s: float = 0.0):
+        self.grace_s = grace_s
+        # damping window for the FIRST migration episode a node triggers;
+        # <= 0 derives two grace periods
+        self.damp_s = damp_s if damp_s > 0 else 2 * max(grace_s, 0.0)
+        self._entries: "OrderedDict[str, _NodeEntry]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def _entry(self, name: str, now: float) -> _NodeEntry:
+        entry = self._entries.get(name)
+        if entry is None:
+            entry = _NodeEntry(changed_at=now)
+            self._entries[name] = entry
+            while len(self._entries) > self.MAX_ENTRIES:
+                self._entries.popitem(last=False)
+        else:
+            self._entries.move_to_end(name)
+        return entry
+
+    def observe(self, obj: Dict[str, Any], now: Optional[float] = None) -> bool:
+        """Whether the node is effectively READY right now.
+
+        Ready = not cordoned, and either (a) its heartbeat changed within
+        the grace (liveness overrides a stale durable NotReady — the node
+        came back), or (b) it has never heartbeated and its durable status
+        says Ready, or (c) its heartbeat went quiet less than one grace ago
+        and the durable status still says Ready.  The first observation of
+        a node seeds its anchor at "now": a controller restart grants every
+        node one fresh grace (conservative, the damper-rebuild stance),
+        while the durable NotReady verdict of the previous incarnation
+        keeps gating placement meanwhile.
+        """
+        now = time.monotonic() if now is None else now
+        name = (obj.get("metadata") or {}).get("name") or ""
+        # anchor the heartbeat BEFORE the cordon verdict: a cordoned node
+        # keeps heartbeating, and freezing its anchor while cordoned would
+        # let a cordon lasting longer than one grace masquerade as node
+        # silence (a false durable NotReady + "heartbeat stale" taint on a
+        # perfectly alive host, breaking instant uncordon reversibility)
+        hb = node_heartbeat(obj)
+        entry = self._entry(name, now)
+        if hb != entry.heartbeat:
+            entry.heartbeat = hb
+            entry.changed_at = now
+        if is_cordoned(obj):
+            return False
+        if hb is None:
+            # never heartbeated: durable status is the only signal
+            return node_phase(obj) != c.NODE_NOT_READY
+        if now - entry.changed_at < self.grace_s or self.grace_s <= 0:
+            return True  # fresh lease: alive even if status lags NotReady
+        return False  # stale past the bounded grace
+
+    def stale_for(self, obj: Dict[str, Any],
+                  now: Optional[float] = None) -> Optional[float]:
+        """Seconds the node's heartbeat has been stale past observation
+        (None = it has never heartbeated, or is fresh)."""
+        now = time.monotonic() if now is None else now
+        name = (obj.get("metadata") or {}).get("name") or ""
+        entry = self._entries.get(name)
+        if entry is None or entry.heartbeat is None:
+            return None
+        age = now - entry.changed_at
+        return age if age >= self.grace_s else None
+
+    # -- migration damper ----------------------------------------------------
+
+    def migration_allowed(self, name: str,
+                          now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        entry = self._entries.get(name)
+        return entry is None or now >= entry.hold_until
+
+    def note_migration(self, name: str, now: Optional[float] = None) -> None:
+        """One migration episode triggered by this node: open its damping
+        window (doubling per episode, capped at 16x) so a flapping host
+        cannot churn gangs in a storm."""
+        now = time.monotonic() if now is None else now
+        entry = self._entry(name, now)
+        entry.episodes += 1
+        window = self.damp_s * min(2 ** (entry.episodes - 1), 16)
+        entry.hold_until = now + window
+
+    def forget(self, name: str) -> bool:
+        """Sweep the node's ledger when its Node object is deleted (the
+        LRU-map hygiene the PR-3 token buckets follow)."""
+        return self._entries.pop(name, None) is not None
+
+
+@dataclass
+class Inventory:
+    """One tick's view of the fleet: pools indexed by ``spec.pool`` plus
+    the host coordinates placement must not touch."""
+
+    pools: List[SlicePoolSpec]
+    unavailable: Set[NodeCoord]
+    # node names by effective state, for metrics + /debug/fleet
+    ready: List[str]
+    not_ready: List[str]
+    cordoned: List[str]
+    # nodes whose heartbeat is stale past grace but whose durable status
+    # has not flipped yet (the scheduler duty writes the flip)
+    stale: Dict[str, float]
+    # any node NOT carrying the synthesized label (a real inventory)
+    has_real_nodes: bool = False
+
+
+def build_inventory(nodes: List[Dict[str, Any]], health: NodeHealth,
+                    now: Optional[float] = None) -> Inventory:
+    """Fold the Node informer cache into (pools, unavailable hosts).
+
+    Pool list positions are the nodes' declared ``spec.pool`` indices (the
+    address space committed assignments already use), so the mapping stays
+    stable across rebuilds; a pool index with no resolvable nodes yields a
+    zero-slice placeholder.  Coordinates inside a pool's grid with no Node
+    object at all are unavailable — the inventory only ever offers hosts
+    that exist.
+    """
+    now = time.monotonic() if now is None else now
+    # pool index -> (accelerator, {coord}, max slice index)
+    seen: Dict[int, Tuple[str, Set[Tuple[int, int]], int]] = {}
+    ready: List[str] = []
+    not_ready: List[str] = []
+    cordoned: List[str] = []
+    stale: Dict[str, float] = {}
+    excluded: Set[NodeCoord] = set()
+    has_real = False
+    for obj in nodes:
+        meta = obj.get("metadata") or {}
+        name = meta.get("name") or ""
+        parsed = node_coord(obj)
+        if parsed is None:
+            continue  # malformed spec: invisible to placement
+        accel, (pool, si, host) = parsed
+        labels = meta.get("labels") or {}
+        if labels.get(c.LABEL_NODE_SYNTHESIZED) != "true":
+            has_real = True
+        entry = seen.get(pool)
+        if entry is None:
+            seen[pool] = (accel, {(si, host)}, si)
+        else:
+            if entry[0] != accel:
+                continue  # pool index claimed by two accelerators: first wins
+            entry[1].add((si, host))
+            seen[pool] = (entry[0], entry[1], max(entry[2], si))
+        # exclusion honors the DURABLE verdict too: a node whose heartbeat
+        # resumed but whose status still says NotReady stays excluded until
+        # the scheduler duty flips it back Ready — placement and pod birth
+        # follow the committed truth, not one member's local anchors
+        alive = health.observe(obj, now)
+        if is_cordoned(obj):
+            cordoned.append(name)
+        elif not alive or node_phase(obj) == c.NODE_NOT_READY:
+            not_ready.append(name)
+            age = health.stale_for(obj, now)
+            if age is not None:
+                stale[name] = age
+        else:
+            ready.append(name)
+        if (is_cordoned(obj) or not alive
+                or node_phase(obj) == c.NODE_NOT_READY):
+            excluded.add((pool, si, host))
+    pools: List[SlicePoolSpec] = []
+    unavailable: Set[NodeCoord] = set(excluded)
+    if seen:
+        size = max(seen) + 1
+        for pi in range(size):
+            entry = seen.get(pi)
+            if entry is None:
+                pools.append(_empty_pool())
+                continue
+            accel, coords, max_slice = entry
+            try:
+                shape = SliceTopology.resolve(accel)
+            except TopologyError:
+                pools.append(_empty_pool())
+                continue
+            count = max_slice + 1
+            pools.append(SlicePoolSpec(accelerator=accel, count=count,
+                                       shape=shape))
+            for si in range(count):
+                for host in range(shape.hosts):
+                    if (si, host) not in coords:
+                        # no Node object for this coordinate: the host does
+                        # not exist — placement must skip it
+                        unavailable.add((pi, si, host))
+    return Inventory(pools=pools, unavailable=unavailable, ready=ready,
+                     not_ready=not_ready, cordoned=cordoned, stale=stale,
+                     has_real_nodes=has_real)
+
+
+def _empty_pool() -> SlicePoolSpec:
+    """Placeholder for a pool index with no resolvable nodes: zero slices,
+    so nothing places there, while committed assignments naming it still
+    reserve (and report) against a defined index space."""
+    return SlicePoolSpec(accelerator="v4-8", count=0,
+                         shape=SliceTopology.resolve("v4-8"))

@@ -575,6 +575,35 @@ sched_admission_wait = Histogram(
              14400.0),
 )
 
+# Node inventory & fleet repair series.  Naming note (see docs/monitoring):
+# these follow Prometheus conventions — the `_total` suffix appears ONLY on
+# counters (`tpujob_scheduler_migrations_total`,
+# `tpujob_node_health_transitions_total`); gauges carry none
+# (`tpujob_node_count`).  The one legacy exception in this codebase is
+# `tpujob_job_steps_total`, a gauge that predates the convention.
+node_count = LabeledGauge(
+    "tpujob_node_count",
+    "Nodes in the fleet inventory by effective state (ready / not_ready / "
+    "cordoned), sampled once per scheduler tick by the scheduler duty",
+    REGISTRY,
+    ("state",),
+)
+node_transitions = LabeledCounter(
+    "tpujob_node_health_transitions_total",
+    "Durable node health flips committed by the scheduler duty "
+    "(to=not_ready when a heartbeat went stale past the bounded grace, "
+    "to=ready when it resumed)",
+    REGISTRY,
+    ("to",),
+)
+sched_migrations = Counter(
+    "tpujob_scheduler_migrations_total",
+    "Checkpoint-aware gang migrations staged off dead/cordoned hosts (each "
+    "publishes a preempt-target + migrated-from record and runs the bounded "
+    "checkpoint barrier before eviction; zero failure strikes)",
+    REGISTRY,
+)
+
 jobs_stalled = Counter(
     "tpujob_operator_stalled_jobs_total",
     "Stalled-condition flips by the progress watchdog (each is one detected "

@@ -102,6 +102,16 @@ class ServerOption:
     # how long the preemption checkpoint barrier waits for the workload's
     # ack before evicting anyway (<= 0 evicts immediately)
     scheduler_preempt_grace_s: float = 5.0
+    # node inventory: how long a node's heartbeat lease may go unchanged
+    # (controller monotonic clock) before the scheduler duty flips its
+    # durable phase NotReady, excludes it from placement and migrates its
+    # gangs.  Heartbeat flaps INSIDE one grace window never flip anything.
+    node_grace_s: float = 30.0
+    # per-node migration damper: a host may trigger at most one gang-
+    # migration episode per this window (doubling per episode, capped), so
+    # a flapping node can never drive a migration storm.  <= 0 derives two
+    # grace periods.
+    node_migration_damp_s: float = 0.0
 
 
 class _LazyVersionAction(argparse.Action):
@@ -287,6 +297,18 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="seconds the preemption checkpoint barrier "
                              "waits for the workload's ack before evicting "
                              "anyway (<=0 evicts immediately)")
+    parser.add_argument("--node-grace", type=float, default=30.0,
+                        dest="node_grace_s",
+                        help="seconds a node's heartbeat lease may go "
+                             "unchanged before it flips NotReady and its "
+                             "gangs are migrated (flaps inside one grace "
+                             "window never flip anything)")
+    parser.add_argument("--node-migration-damp", type=float, default=0.0,
+                        dest="node_migration_damp_s",
+                        help="per-node migration damping window in seconds "
+                             "(a host triggers at most one migration "
+                             "episode per window, doubling per episode; "
+                             "<=0 derives two node-grace periods)")
 
 
 def parse_options(argv: Optional[List[str]] = None) -> ServerOption:

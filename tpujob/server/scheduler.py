@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import calendar
 import collections
+import functools
 import json
 import logging
 import threading
@@ -67,14 +68,21 @@ from tpujob.api.quota import (
     pool_fits,
     queue_sort_key,
 )
+from tpujob.api.nodes import (
+    is_cordoned,
+    node_name,
+    node_phase,
+    synthesize_nodes,
+)
 from tpujob.api.topology import TopologyError
 from tpujob.api.types import TPUJob
 from tpujob.controller import status as st
-from tpujob.kube.client import RESOURCE_TPUJOBS
+from tpujob.kube.client import RESOURCE_NODES, RESOURCE_TPUJOBS
 from tpujob.kube.control import gen_labels
-from tpujob.kube.errors import ApiError, NotFoundError
+from tpujob.kube.errors import AlreadyExistsError, ApiError, NotFoundError
 from tpujob.kube.informers import INDEX_JOB_NAME
 from tpujob.server import metrics
+from tpujob.server.inventory import Inventory, NodeHealth, build_inventory
 
 log = logging.getLogger("tpujob.scheduler")
 
@@ -139,23 +147,62 @@ class Assignment:
             return None
 
 
+@functools.lru_cache(maxsize=512)
+def _parse_assignment_cached(raw: str) -> Optional[Assignment]:
+    """Memoized Assignment parse for the per-replica ``node_for`` path: the
+    reconciler asks once per missing index (node-gate) and again per pod
+    build, all against the identical annotation string — O(replicas)
+    redundant JSON parses per sync otherwise (the PR-11 gang-request-cache
+    lesson).  Callers must treat the shared instance as read-only."""
+    return Assignment.from_json(raw)
+
+
+def assignment_node(asg: Assignment, ordinal: int) -> Optional[str]:
+    """The Node name the ``ordinal``-th replica of an admitted gang runs
+    on: replicas fill each slice's torus-adjacent host run in order, one
+    host per replica, clamped to the assignment's extent (a gang whose
+    replica count outgrew its placement is mid-re-place; the clamp keeps
+    the binding total until the new assignment commits)."""
+    if not asg.slices or ordinal < 0:
+        return None
+    hps = asg.slices[0].host_hi - asg.slices[0].host_lo
+    if hps <= 0:
+        return None
+    si = min(ordinal // hps, len(asg.slices) - 1)
+    s = asg.slices[si]
+    host = min(s.host_lo + ordinal % hps, s.host_hi - 1)
+    return node_name(asg.accelerator, s.pool, s.slice_index, host)
+
+
 class CapacityModel:
-    """Host-interval occupancy over the modeled slice pools.
+    """Host-interval occupancy over the fleet's slice pools.
 
     Hosts of one slice are numbered along the snake order (``api/quota``),
     so a contiguous ``[lo, hi)`` interval IS a torus-adjacent host path;
-    allocation is first-fit contiguous per slice.  Single-threaded by
+    allocation is first-fit contiguous per slice.  ``unavailable`` is the
+    health gate: host coordinates whose node is NotReady, cordoned, or
+    absent from the inventory — :meth:`place` can never allocate across
+    them, atomically with the all-or-nothing guarantee (the whole gang
+    lands on healthy hosts or nothing is mutated).  Single-threaded by
     design: only the scheduler tick mutates a model, and the preemption
     planner works on :meth:`clone` copies.
     """
 
-    def __init__(self, pools: List[SlicePoolSpec]):
+    def __init__(self, pools: List[SlicePoolSpec],
+                 unavailable: Optional[set] = None):
         self.pools = pools
+        self.unavailable = frozenset(unavailable or ())
         # (pool, slice) -> sorted [lo, hi) intervals with their owner keys
         self._used: Dict[Tuple[int, int], List[Tuple[int, int, str]]] = {}
+        # (pool, slice) -> sorted blocked host indices, from unavailable
+        self._blocked: Dict[Tuple[int, int], List[int]] = {}
+        for pool, si, host in self.unavailable:
+            self._blocked.setdefault((pool, si), []).append(host)
+        for hosts in self._blocked.values():
+            hosts.sort()
 
     def clone(self) -> "CapacityModel":
-        out = CapacityModel(self.pools)
+        out = CapacityModel(self.pools, self.unavailable)
         out._used = {k: list(v) for k, v in self._used.items()}
         return out
 
@@ -195,16 +242,43 @@ class CapacityModel:
     def _free_interval(self, pool: int, slice_index: int,
                        need: int) -> Optional[int]:
         """First-fit contiguous free interval of ``need`` hosts (snake
-        order = torus-adjacent), or None."""
+        order = torus-adjacent) that avoids both reservations and
+        unavailable (dead/cordoned/absent) hosts, or None."""
         hosts = self.pools[pool].shape.hosts
+        occupied = list(self._used.get((pool, slice_index), []))
+        occupied += [(h, h + 1, "") for h in
+                     self._blocked.get((pool, slice_index), ())]
+        occupied.sort()
         cursor = 0
-        for lo, hi, _ in self._used.get((pool, slice_index), []):
+        for lo, hi, _ in occupied:
             if lo - cursor >= need:
                 return cursor
             cursor = max(cursor, hi)
         if hosts - cursor >= need:
             return cursor
         return None
+
+    def _outside(self, pool: int, slice_index: int, host: int) -> bool:
+        """A coordinate beyond the pools' current extents: deleting a
+        pool's HIGHEST slice (or a whole pool) shrinks the derived grid, so
+        its hosts never enter ``unavailable`` — they simply stop existing.
+        An assignment still naming them is stranded all the same."""
+        if pool >= len(self.pools):
+            return True
+        p = self.pools[pool]
+        return slice_index >= p.count or host >= p.shape.hosts
+
+    def blocked_hosts(self, asg: Assignment) -> List[Tuple[int, int, int]]:
+        """Host coordinates of ``asg`` that are currently unavailable
+        (dead/cordoned, or outside the live grid entirely) — the trigger
+        for checkpoint-aware gang migration."""
+        out: List[Tuple[int, int, int]] = []
+        for s in asg.slices:
+            for h in range(s.host_lo, s.host_hi):
+                if ((s.pool, s.slice_index, h) in self.unavailable
+                        or self._outside(s.pool, s.slice_index, h)):
+                    out.append((s.pool, s.slice_index, h))
+        return out
 
     def place(self, req: GangRequest, owner: str) -> Optional[Assignment]:
         """All-or-nothing placement: ``num_slices`` distinct slices of ONE
@@ -274,15 +348,41 @@ class GangScheduler:
         aging_s: float = 60.0,
         enable_preemption: bool = True,
         preempt_grace_s: float = 5.0,
+        node_grace_s: float = 30.0,
+        node_damp_s: float = 0.0,
     ):
         self.controller = controller
-        self.pools = parse_capacity(capacity)
+        # --sched-capacity is the BOOTSTRAP: it synthesizes Node objects on
+        # the first active tick of an empty inventory, and every subsequent
+        # tick rebuilds the capacity model from the live Node informer
+        # cache.  self.pools tracks the currently effective pools (rebound
+        # atomically each tick; placement_errors reads it lock-free).
+        self.bootstrap_capacity = capacity
+        self.bootstrap_pools = parse_capacity(capacity)
+        self.pools = self.bootstrap_pools
         self.fleet_chips = capacity_chips(self.pools)
         self.tick_s = tick_s
         self.aging_s = aging_s
         self.enable_preemption = enable_preemption
         self.preempt_grace_s = preempt_grace_s
+        self.node_grace_s = node_grace_s
         self._lock = lockgraph.new_lock("gang-scheduler")
+        # node heartbeat health + per-node migration damper (LRU-bounded,
+        # swept on node delete).  Guarded by self._lock: the tick's
+        # inventory rebuild and the reconciler's node_excluded gate share it.
+        self.health = NodeHealth(node_grace_s, node_damp_s)  # guarded by self._lock
+        # "modeled" until the Node informer cache shows live inventory;
+        # then "nodes" — surfaced in /debug/fleet's scheduler block
+        self._inventory_mode = "modeled"  # guarded by self._lock
+        self._nodes_bootstrapped = False
+        self._bootstrap_started = False
+        self._capacity_warned = False
+        # node health flips committed but not yet echoed by the cache
+        # (the _release_sent discipline applied to NotReady/Ready writes)
+        self._health_sent: Dict[str, str] = {}  # guarded by self._lock
+        # host coordinates unavailable as of the last tick (for debug)
+        self._last_inventory: Optional[Inventory] = None  # guarded by self._lock
+        self.migrations = 0  # guarded by self._lock; lifetime migration count
         # never-placeable verdicts keyed to the spec generation they were
         # computed against, consumed by the reconciler gate (which writes
         # the durable Failed condition).  Generation-keyed so a legal spec
@@ -330,7 +430,7 @@ class GangScheduler:
 
     def placement_errors(self, job: TPUJob) -> Optional[List[str]]:
         """Feasibility verdict for the exact job object the caller holds —
-        a pure function of the modeled pools and the spec, so every fleet
+        a pure function of the fleet pools and the spec, so every fleet
         member's admission gate judges its own shards' jobs locally
         (without waiting for, or racing, the shard-0 decision loop), and a
         verdict can never be stale against the spec it is applied to."""
@@ -338,7 +438,21 @@ class GangScheduler:
             req = gang_request(job)
         except TopologyError:
             return None  # unresolvable: strict validation fails it
-        return feasibility_errors(req, self.pools) or None
+        return self._never_placeable(req)
+
+    def _never_placeable(self, req: GangRequest) -> Optional[List[str]]:
+        """NEVER-placeable means infeasible on the fleet at FULL health:
+        the verdict is irreversible (a durable Failed condition), so it
+        must hold against both the live Node-derived pools AND the
+        bootstrap shape — a half-bootstrapped or degraded inventory
+        (dead slice, deleted nodes) transiently shrinks the live pools,
+        and failing a gang that fits the configured fleet would convert a
+        recoverable outage into a permanent verdict.  Such gangs queue
+        instead."""
+        errs = feasibility_errors(req, self.pools)
+        if errs and feasibility_errors(req, self.bootstrap_pools):
+            return errs
+        return None
 
     def unschedulable_errors(self, key: str,
                              generation: Optional[int] = None
@@ -403,6 +517,315 @@ class GangScheduler:
             return True
         return sharder.is_active(SCHEDULER_SHARD)
 
+    # -- node inventory ------------------------------------------------------
+
+    def _node_store(self):
+        informer = getattr(self.controller, "node_informer", None)
+        return informer.store if informer is not None else None
+
+    @staticmethod
+    def _zero_node_gauges() -> None:
+        """The one-exporter-per-series handoff discipline (the
+        sched_queue_depth / tpujob_job_* stance): a member that is not the
+        scheduler duty — or whose inventory is empty/modeled — must not
+        keep exporting the last active tick's node counts next to the live
+        owner's, or fleet-wide sums double-count."""
+        for state in ("ready", "not_ready", "cordoned"):
+            metrics.node_count.labels(state=state).set(0)
+
+    def _refresh_inventory(self, now: float):
+        """Rebuild (pools, unavailable hosts) from the live Node informer
+        cache — the tick's view of what hardware actually exists and is
+        healthy.  An empty inventory bootstraps Node objects from the
+        ``--sched-capacity`` string (once) and places against the modeled
+        pools until the cache echoes them, so every pre-inventory shape
+        keeps working unchanged."""
+        store = self._node_store()
+        nodes = store.list() if store is not None else []
+        if store is not None and not self._nodes_bootstrapped:
+            if not nodes:
+                # an empty inventory starts the bootstrap; pre-existing
+                # nodes (a REAL inventory) mean there is nothing to seed
+                self._bootstrap_started = True
+            if self._bootstrap_started:
+                # resume until complete: a chaos-faulted partial bootstrap
+                # must not strand a half-synthesized fleet (the cache going
+                # non-empty is no proof every host was created)
+                self._bootstrap_nodes(nodes)
+            else:
+                self._nodes_bootstrapped = True
+        if not nodes:
+            with self._lock:
+                self._inventory_mode = "modeled"
+                self._last_inventory = None
+            self.pools = self.bootstrap_pools
+            self.fleet_chips = capacity_chips(self.pools)
+            self._zero_node_gauges()
+            return self.pools, set()
+        with self._lock:
+            inv = build_inventory(nodes, self.health, now)
+            self._last_inventory = inv
+            self._inventory_mode = "nodes"
+        if inv.has_real_nodes and self.bootstrap_capacity \
+                and not self._capacity_warned:
+            # one-time (per process) warning: both a capacity string and a
+            # live inventory are configured — the string is only the
+            # bootstrap fallback and the Node objects win from here on
+            self._capacity_warned = True
+            log.warning(
+                "--sched-capacity %r is configured alongside a live Node "
+                "inventory (%d node(s)); the capacity string is a bootstrap "
+                "fallback only — placement follows the Node objects, and "
+                "the string is ignored while any Node exists",
+                self.bootstrap_capacity, len(nodes))
+        if any(p.count for p in inv.pools):
+            self.pools = inv.pools
+        else:
+            self.pools = self.bootstrap_pools  # every node malformed
+        self.fleet_chips = capacity_chips(self.pools)
+        metrics.node_count.labels(state="ready").set(len(inv.ready))
+        metrics.node_count.labels(state="not_ready").set(len(inv.not_ready))
+        metrics.node_count.labels(state="cordoned").set(len(inv.cordoned))
+        self._reconcile_node_health(nodes, inv)
+        return self.pools, inv.unavailable
+
+    def _bootstrap_nodes(self, cached: List[Dict[str, Any]]) -> None:
+        """Synthesize Node objects from the bootstrap capacity string: one
+        Node per modeled host, seeded once against an empty inventory and
+        RESUMED across ticks until every host exists.  Transient write
+        faults retry next tick; an already-exists answer means another
+        member (or a previous incarnation) won the race — both count."""
+        have = {(m.get("metadata") or {}).get("name") for m in cached}
+        done = 0
+        total = 0
+        for obj in synthesize_nodes(self.bootstrap_pools):
+            total += 1
+            if obj["metadata"]["name"] in have:
+                done += 1
+                continue
+            try:
+                self.controller.clients.server.create(RESOURCE_NODES, obj)
+                done += 1
+            except AlreadyExistsError:
+                done += 1
+            except ApiError as e:
+                log.warning("node bootstrap: create %s failed (%s); "
+                            "retrying next tick",
+                            obj["metadata"]["name"], e)
+                return  # partial bootstrap: resumed next tick
+        self._nodes_bootstrapped = done == total
+        if self._nodes_bootstrapped:
+            log.info("node inventory bootstrapped: %d host(s) synthesized "
+                     "from --sched-capacity %r", done,
+                     self.bootstrap_capacity)
+
+    def _reconcile_node_health(self, nodes: List[Dict[str, Any]],
+                               inv: Inventory) -> None:
+        """Flip the durable Ready/NotReady verdict (with the taint
+        annotation recording why) for nodes whose effective health
+        diverged — the scheduler duty's write, deduped per target phase
+        until the cache echoes it."""
+        live_names = set()
+        for obj in nodes:
+            name = (obj.get("metadata") or {}).get("name") or ""
+            live_names.add(name)
+            phase = node_phase(obj)
+            with self._lock:
+                stale_age = self.health.stale_for(obj)
+                sent = self._health_sent.get(name)
+                if sent == phase:
+                    self._health_sent.pop(name, None)  # echo landed
+                    sent = None
+            if stale_age is not None and phase != c.NODE_NOT_READY:
+                if sent == c.NODE_NOT_READY:
+                    continue  # committed, waiting for the echo
+                # confirming UNCACHED read before the irreversible-looking
+                # flip (the adopt path's quorum-recheck stance): a broken
+                # watch/relist can freeze the cached heartbeat and
+                # masquerade as node silence — if the fresh read shows the
+                # lease advanced, observe() re-anchors and no flip happens
+                stale_age = self._confirm_stale(name)
+                if stale_age is None:
+                    continue
+                taint = (f"heartbeat stale for {stale_age:.1f}s "
+                         f"(grace {self.node_grace_s:g}s)")
+                if self._flip_node(name, c.NODE_NOT_READY, taint):
+                    metrics.node_transitions.labels(
+                        to="not_ready").inc()
+                    self._note("node-notready", f"node/{name}", taint)
+            elif phase == c.NODE_NOT_READY and not is_cordoned(obj):
+                with self._lock:
+                    alive = (self.health.observe(obj)
+                             and self.health.stale_for(obj) is None)
+                if not alive or sent == c.NODE_READY:
+                    continue
+                if self._flip_node(name, c.NODE_READY, None):
+                    metrics.node_transitions.labels(to="ready").inc()
+                    self._note("node-ready", f"node/{name}",
+                               "heartbeat resumed; taint cleared")
+        with self._lock:
+            for name in [n for n in self._health_sent
+                         if n not in live_names]:
+                self._health_sent.pop(name, None)
+
+    def _confirm_stale(self, name: str) -> Optional[float]:
+        """Re-read the node uncached and re-judge its heartbeat: the stale
+        age when genuinely silent, None when the fresh read shows the lease
+        advanced (the cache was lying) or the read failed (confirm again
+        next tick — deferring a flip is the safe direction)."""
+        try:
+            fresh = self.controller.clients.server.get(
+                RESOURCE_NODES, "default", name)
+        except ApiError:
+            return None
+        with self._lock:
+            self.health.observe(fresh)  # re-anchors if the lease advanced
+            return self.health.stale_for(fresh)
+
+    def _flip_node(self, name: str, phase: str,
+                   taint: Optional[str]) -> bool:
+        """Commit one durable node-health flip: the taint annotation (the
+        WHY) rides a metadata patch, the phase a status patch.  False =
+        did not commit (retried next tick)."""
+        server = self.controller.clients.server
+        try:
+            server.patch(RESOURCE_NODES, "default", name, {
+                "metadata": {"annotations": {
+                    c.ANNOTATION_NODE_TAINT: taint}}})
+            server.patch_status(RESOURCE_NODES, "default", name,
+                                {"phase": phase})
+        except NotFoundError:
+            return False
+        except ApiError as e:
+            log.warning("node %s: health flip to %s failed (%s); retrying "
+                        "next tick", name, phase, e)
+            return False
+        with self._lock:
+            self._health_sent[name] = phase
+        return True
+
+    def _maybe_migrate(self, entry: _Admitted, asg: Assignment,
+                       cap: CapacityModel, now: float) -> None:
+        """Checkpoint-aware gang migration: a gang with any replica on a
+        dead/cordoned/absent host is driven through the existing
+        checkpoint-barrier eviction (publish target -> ack-or-grace ->
+        evict with no failure strike -> re-queue with an aging head-start
+        -> re-admit on healthy hosts).  Damped per-node so a flapping host
+        can never trigger a migration storm."""
+        blocked = cap.blocked_hosts(asg)
+        if not blocked:
+            return
+        names = sorted({node_name(asg.accelerator, p, s, h)
+                        for p, s, h in blocked})
+        with self._lock:
+            if not any(self.health.migration_allowed(n, now)
+                       for n in names):
+                return  # every trigger host is inside its damping window
+        if not self._patch(entry.namespace, entry.name, {
+                c.ANNOTATION_PREEMPT_TARGET: st.now_iso(),
+                c.ANNOTATION_PREEMPT_ACK: None,
+                c.ANNOTATION_MIGRATED_FROM: ",".join(names)},
+                f"migrate (host(s) {names} unavailable)"):
+            return  # did not commit: retried next tick
+        metrics.sched_migrations.inc()
+        with self._lock:
+            self.migrations += 1
+            self._preempt_sent.add(entry.key)
+            for n in names:
+                self.health.note_migration(n, now)
+            if self.aging_s > 0:
+                # aging head-start: the migrated gang re-queues at its own
+                # tier as if it had already waited one aging period — a
+                # migration must not send a long-running job to the back
+                # of the line behind fresh arrivals
+                head_start = now - self.aging_s
+                cur = self._queued_anchor.get(entry.key)
+                self._queued_anchor[entry.key] = (
+                    head_start if cur is None else min(cur, head_start))
+        entry.preempting = True
+        self._note("migrate", entry.key,
+                   f"host(s) {', '.join(names)} dead/cordoned; migrating "
+                   "through the checkpoint barrier")
+        self.controller.enqueue_job(entry.key)
+
+    # -- reconciler-facing node surface --------------------------------------
+
+    def node_excluded(self, name: Optional[str]) -> bool:
+        """Whether pods must not be (re)created onto this host right now:
+        cordoned, durably NotReady (even if heartbeats just resumed — pods
+        wait for the scheduler duty's Ready flip-back, so birth follows the
+        committed truth), locally heartbeat-stale, or absent from a live
+        inventory.  Judged from the shared node informer cache + this
+        member's OWN monotonic anchors, so every fleet member gates its
+        own creations without waiting on the shard-0 decision loop."""
+        if not name:
+            return False
+        store = self._node_store()
+        if store is None:
+            return False
+        obj = store.get("default", name)
+        with self._lock:
+            if obj is None:
+                # no Node object: with a live inventory the host does not
+                # exist; in modeled mode (pre-bootstrap echo) nothing is
+                # excluded — the pre-inventory behavior
+                return self._inventory_mode == "nodes"
+            if not self.health.observe(obj):
+                return True
+        return (is_cordoned(obj)
+                or node_phase(obj) == c.NODE_NOT_READY)
+
+    def node_dead(self, name: Optional[str]) -> bool:
+        """Whether the host is confirmed dead (NOT merely cordoned): its
+        heartbeat is stale past grace, its durable verdict is NotReady, or
+        its Node object is gone from a live inventory.  Gates the release
+        of a vacated gang's reservation when terminating pods linger on a
+        host that will never confirm their deletion."""
+        if not name:
+            return False
+        store = self._node_store()
+        if store is None:
+            return False
+        obj = store.get("default", name)
+        with self._lock:
+            if obj is None:
+                return self._inventory_mode == "nodes"
+            if is_cordoned(obj):
+                return False  # cordoned is administrative, not dead
+            if self.health.stale_for(obj) is not None:
+                return True
+        return node_phase(obj) == c.NODE_NOT_READY
+
+    def node_for(self, job: TPUJob, rtype: str, index: int) -> Optional[str]:
+        """The host the gang's committed assignment binds this replica to
+        (None = unadmitted or unparsable).  Deterministic: replicas map
+        onto the assignment's torus-adjacent host runs in coordinator-first
+        ordinal order, so the reconciler, the chaos harness and the
+        invariant trackers all agree on the pod->Node edge."""
+        ann = job.metadata.annotations or {}
+        raw = ann.get(c.ANNOTATION_SCHED_ASSIGNMENT)
+        if raw is None:
+            return None
+        asg = _parse_assignment_cached(raw)
+        if asg is None or not asg.slices:
+            return None
+        masters = 0
+        if rtype != c.REPLICA_TYPE_MASTER:
+            mspec = job.spec.tpu_replica_specs.get(c.REPLICA_TYPE_MASTER)
+            if mspec is not None:
+                masters = (mspec.replicas if mspec.replicas is not None
+                           else 1)
+        ordinal = index if rtype == c.REPLICA_TYPE_MASTER else masters + index
+        return assignment_node(asg, ordinal)
+
+    def forget_node(self, name: str) -> None:
+        """Node object deleted: sweep its per-node damper/anchor/flip
+        ledgers (the LRU-map hygiene the PR-3 token buckets follow) so a
+        long node-churn soak cannot grow them without bound."""
+        with self._lock:
+            self.health.forget(name)
+            self._health_sent.pop(name, None)
+
     # -- the decision tick ---------------------------------------------------
 
     def tick(self, now: Optional[float] = None) -> Dict[str, Any]:
@@ -423,6 +846,7 @@ class GangScheduler:
             # durable annotations are the truth the regained duty rebuilds
             # from.
             metrics.sched_queue_depth.set(0)
+            self._zero_node_gauges()
             with self._lock:
                 self._queue_view = []
                 self._pending_admissions.clear()
@@ -430,6 +854,7 @@ class GangScheduler:
                 self._preempt_sent.clear()
                 self._queued_anchor.clear()
                 self._preempt_anchor.clear()
+                self._health_sent.clear()
             return {"active": False}
         t0 = time.monotonic()
         now = t0 if now is None else now
@@ -444,7 +869,8 @@ class GangScheduler:
 
     def _tick_inner(self, now: float) -> Dict[str, Any]:
         now_wall = time.time()
-        cap = CapacityModel(self.pools)
+        pools, unavailable = self._refresh_inventory(now)
+        cap = CapacityModel(pools, unavailable)
         admitted: List[_Admitted] = []
         queued: List[Tuple[GangRequest, str, str, str, float]] = []
         ns_chips: Dict[str, float] = {}
@@ -530,6 +956,8 @@ class GangScheduler:
                                    "assignment; re-queueing at the new "
                                    "shape")
                         self.controller.enqueue_job(key)
+                if not entry.evicting and not entry.preempting:
+                    self._maybe_migrate(entry, asg, cap, now)
                 self._advance_eviction(entry, now, now_wall)
                 continue
             # -- unadmitted: queue or reject ---------------------------------
@@ -546,7 +974,7 @@ class GangScheduler:
                 continue
             if req is None:
                 continue  # unresolvable/malformed: the sync fails it
-            errs = feasibility_errors(req, self.pools)
+            errs = self._never_placeable(req)
             if errs:
                 unschedulable[key] = (
                     int(meta.get("generation") or 0), errs)
@@ -789,9 +1217,13 @@ class GangScheduler:
         release protocol (each stage is a committed annotation, so a fresh
         scheduler resumes exactly where the old one died)."""
         if entry.evicting:
-            # capacity stays reserved until the LAST pod is gone — only
-            # then may the hosts be re-admitted to someone else
-            if not self._live_pods(entry.namespace, entry.name):
+            # capacity stays reserved until the LAST pod is confirmed gone
+            # — only then may the hosts be re-admitted to someone else.
+            # Pods lingering on a CONFIRMED-DEAD host don't block the
+            # release: their node will never ack the deletion, and the
+            # dead host's capacity is unplaceable anyway (health-gated).
+            if not self._live_pods(entry.namespace, entry.name,
+                                   ignore_dead_nodes=True):
                 raw = entry.ann.get(c.ANNOTATION_SCHED_ASSIGNMENT) or ""
                 if self._release(entry.key, entry.namespace, entry.name,
                                  raw, "release (eviction complete)"):
@@ -846,16 +1278,23 @@ class GangScheduler:
 
     # -- plumbing ------------------------------------------------------------
 
-    def _live_pods(self, namespace: str, name: str) -> int:
+    def _live_pods(self, namespace: str, name: str,
+                   ignore_dead_nodes: bool = False) -> int:
         """Pods (terminating included) the job still holds, from the shared
-        informer cache — the release gate for a vacated gang's capacity."""
+        informer cache — the release gate for a vacated gang's capacity.
+        ``ignore_dead_nodes`` skips pods bound to confirmed-dead hosts
+        (the node is the only thing that could confirm them gone)."""
         selector = gen_labels(name)
         count = 0
         for obj in self.controller.pod_informer.store.by_index(
                 INDEX_JOB_NAME, selector[c.LABEL_JOB_NAME]):
             meta = obj.get("metadata") or {}
-            if (meta.get("namespace") or "default") == namespace:
-                count += 1
+            if (meta.get("namespace") or "default") != namespace:
+                continue
+            if ignore_dead_nodes and self.node_dead(
+                    (obj.get("spec") or {}).get("nodeName")):
+                continue
+            count += 1
         return count
 
     def _queued_since(self, key: str, obj: Dict[str, Any], now: float,
@@ -891,6 +1330,7 @@ class GangScheduler:
                 c.ANNOTATION_SCHED_EVICTED: None,
                 c.ANNOTATION_PREEMPT_TARGET: None,
                 c.ANNOTATION_PREEMPT_ACK: None,
+                c.ANNOTATION_MIGRATED_FROM: None,
         }, what):
             return False
         with self._lock:
@@ -936,15 +1376,35 @@ class GangScheduler:
             unsched = {k: list(errs)
                        for k, (_, errs) in self._unschedulable.items()}
             admissions, preemptions = self.admissions, self.preemptions
+            migrations = self.migrations
+            inventory_mode = self._inventory_mode
+            inv = self._last_inventory
+            nodes_block = None
+            if inv is not None:
+                nodes_block = {
+                    "ready": len(inv.ready),
+                    "not_ready": sorted(inv.not_ready),
+                    "cordoned": sorted(inv.cordoned),
+                    "unavailable_hosts": len(inv.unavailable),
+                }
         return {
             "capacity": [{"accelerator": p.accelerator, "slices": p.count,
                           "hosts_per_slice": p.shape.hosts,
                           "chips": p.total_chips} for p in self.pools],
+            # "modeled" = placing against the --sched-capacity bootstrap
+            # pools (no Node objects yet); "nodes" = rebuilt from the live
+            # Node informer cache each tick
+            "inventory": inventory_mode,
+            "nodes": nodes_block,
+            "node_grace_s": self.node_grace_s,
             "aging_s": self.aging_s,
             "preemption": self.enable_preemption,
             "queue": queue,
             "unschedulable": unsched,
             "admissions_total": admissions,
             "preemptions_total": preemptions,
+            "migrations_total": migrations,
+            # bounded (deque maxlen): the decision log can never grow past
+            # its ring across a long node-churn soak
             "decisions": decisions,
         }
