@@ -11,7 +11,7 @@ SHELL := /bin/bash
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
+.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke goodput-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
@@ -105,9 +105,19 @@ sched-smoke:
 node-smoke:
 	$(PY) scripts/node_smoke.py
 
+# goodput smoke (~7 s): one job through queue -> train -> resize -> preempt
+# -> re-admit -> succeed against a live scheduler-enabled controller — the
+# phase ledger's fractions must sum to the wall clock within epsilon, the
+# injected queue/resize/preemption windows must land in the matching
+# tpujob_job_badput_seconds_total{phase} buckets, the scheduler must rank
+# victims by ledger-projected goodput loss, and a finished job's series
+# must be removed (docs/monitoring, "Goodput accounting")
+goodput-smoke:
+	$(PY) scripts/goodput_smoke.py
+
 # the tier-1 command from ROADMAP.md, verbatim (modulo $$-escaping for
 # make), so local and CI invocations agree on what "the tests pass" means
-test: lint trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke
+test: lint trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke goodput-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # the operator/controller/kube/api tests only — the model-path suites
@@ -165,6 +175,7 @@ bench-controller:
 	$(PY) bench_controller.py --jobs 10 --workers 8 --churn 4
 	$(PY) bench_controller.py --jobs 10 --workers 8 --churn 4 --no-suppress --no-coalesce
 	$(PY) bench_controller.py --jobs 10 --workers 8 --watchdog
+	$(PY) bench_controller.py --jobs 10 --workers 8 --goodput
 	$(PY) bench_controller.py --jobs 24 --workers 4 --controllers 4 --threadiness 2
 	$(PY) bench_controller.py --queue 100 --threadiness 4
 

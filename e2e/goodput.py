@@ -1,0 +1,279 @@
+"""Goodput-accounting smoke harness: one job's full badput journey.
+
+The acceptance gate of the goodput plane (``make goodput-smoke``): one
+victim job is driven through queue -> train -> resize -> preempt ->
+re-admit -> succeed against a live scheduler-enabled controller, with real
+heartbeats and barrier acks through the kubelet exec seam.  The run
+asserts:
+
+1. the ledger's phase fractions sum to the job's wall clock within
+   epsilon (every second attributed to exactly one phase, no gap);
+2. the injected schedule lands in the right badput buckets: the queue
+   window behind the blocker reads as ``queued``, the staged drain as
+   ``resizing``, the eviction + requeue as ``preempted``, and training
+   still dominates;
+3. the export surfaces agree: ``tpujob_job_goodput_*`` /
+   ``tpujob_job_badput_seconds_total{phase}`` on the real ``/metrics``
+   listener, the ``goodput`` blocks on ``/debug/jobs`` and
+   ``/debug/fleet``;
+4. the scheduler consumes the LEDGER view for victim costing (source ==
+   "ledger", finite projected loss) — the victim-choice flip itself is
+   pinned deterministically in tests/test_goodput.py;
+5. the finished job's goodput series are removed.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, Optional
+
+from e2e.chaos import (
+    ChaosConfig,
+    JobCase,
+    _job,
+    _settle_invariants,
+    _soak_harness,
+    _start_app,
+    _tmpl,
+    _wait_for,
+)
+from e2e.kubelet import KubeletSim
+from e2e.scheduler import SCHED_OPT_OVERRIDES, SchedWorkload
+from tpujob.api import constants as c
+from tpujob.controller import status as st
+from tpujob.kube.client import ClientSet
+from tpujob.obs import goodput as gp
+from tpujob.server.monitoring import MonitoringServer
+
+NO_FAULTS = ChaosConfig(
+    error_rate=0.0, timeout_rate=0.0, conflict_rate=0.0, latency_rate=0.0,
+    kill_watch_every=0, compact_every=0, duplicate_event_rate=0.0,
+)
+
+CAPACITY = "v4-32x2"  # 2 slices x 4 hosts
+
+
+def _fetch(port: int, path: str):
+    url = f"http://127.0.0.1:{port}{path}"
+    with urllib.request.urlopen(url) as resp:  # noqa: S310 (local)
+        body = resp.read()
+    ctype = resp.headers.get("Content-Type", "")
+    return json.loads(body) if "json" in ctype else body.decode()
+
+
+def _condition(admin: ClientSet, name: str, cond_type: str) -> Optional[str]:
+    job = admin.tpujobs.get("default", name)
+    cond = st.get_condition(job.status, cond_type)
+    return cond.status if cond is not None else None
+
+
+def run_goodput_smoke(seed: int = 17, timeout: float = 120.0) -> Dict[str, Any]:
+    trainer_stop = threading.Event()
+    blocker_gate = threading.Event()
+    vic_gate = threading.Event()
+    prefix, _, inner, chaos, admin, tracker, _ = _soak_harness(
+        seed, "gp", NO_FAULTS, cases=[])
+    blk_name = f"{prefix}-blk"
+    vic_name = f"{prefix}-vic"
+    boss_name = f"{prefix}-boss"
+    vic_key = f"default/{vic_name}"
+
+    def gang(name, workers, tpu, priority, wl):
+        spec: Dict[str, Any] = {
+            "runPolicy": {"backoffLimit": 20},
+            "tpuReplicaSpecs": {"Worker": {
+                "replicas": workers,
+                "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                "template": _tmpl()}},
+        }
+        if tpu:
+            spec["tpuReplicaSpecs"]["Worker"]["tpu"] = tpu
+        if priority:
+            spec["runPolicy"]["schedulingPolicy"] = {"priorityClass": priority}
+        return JobCase(job=_job(name, spec), scripts=wl.scripts(max_workers=8),
+                       expect_terminal="Succeeded")
+
+    whole_fleet = {"accelerator": "v4-32", "numSlices": 2}
+    wl_blk = SchedWorkload(admin, blk_name, total_steps=10,
+                           stop_event=trainer_stop, finish_gate=blocker_gate)
+    wl_vic = SchedWorkload(admin, vic_name, total_steps=25,
+                           checkpoint_every=5, stop_event=trainer_stop,
+                           finish_gate=vic_gate)
+    wl_boss = SchedWorkload(admin, boss_name, total_steps=10,
+                            stop_event=trainer_stop)
+    cases = [
+        gang(blk_name, 8, whole_fleet, "low", wl_blk),
+        # same tier as the blocker, so the injected queue window IS a
+        # queue window: a higher-tier (or aged-up) victim would instead
+        # preempt the whole-fleet blocker and then deadlock the smoke —
+        # the evicted blocker can never re-place while the victim runs
+        gang(vic_name, 3, None, "low", wl_vic),  # unpinned sub-slice
+        gang(boss_name, 8, whole_fleet, "high", wl_boss),
+    ]
+    started = time.monotonic()
+    deadline = started + timeout
+
+    def _wait(pred, what: str) -> None:
+        if not _wait_for(pred, max(0.1, deadline - time.monotonic()),
+                         interval=0.01):
+            raise AssertionError(f"goodput smoke: timed out waiting for {what}")
+
+    def _pods_of(name: str):
+        return sorted(p.metadata.name for p in admin.pods.list()
+                      if p.metadata.labels.get(c.LABEL_JOB_NAME) == name)
+
+    scripts = [s for case in cases for s in case.scripts]
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts)
+    app = _start_app(chaos, {**SCHED_OPT_OVERRIDES,
+                             "scheduler_capacity": CAPACITY,
+                             "scheduler_preempt_grace_s": 2.0,
+                             # slow aging: the queued victim must never
+                             # age ABOVE the blocker's tier inside the
+                             # injected queue window (see the case list)
+                             "scheduler_aging_s": 30.0,
+                             "resize_drain_grace_s": 0.3,
+                             "stall_timeout_s": 5.0,
+                             "stall_check_interval_s": 0.5})
+    mon = MonitoringServer(host="127.0.0.1", port=0,
+                           flight=app.controller.flight,
+                           fleet=app.controller.fleet_snapshot,
+                           debug_state=app.controller.debug_job_state).start()
+    kubelet.start()
+    ledger = app.controller.goodput
+    problems = []
+    windows: Dict[str, float] = {}
+    try:
+        # -- 1. queue behind a whole-fleet blocker ------------------------
+        admin.tpujobs.create(cases[0].job)
+        _wait(lambda: len(_pods_of(blk_name)) == 8, "the blocker's 8 pods")
+        _wait(lambda: wl_blk.ledger.snapshot()["progress"] > 2,
+              "the blocker to train")
+        t_vic_created = time.monotonic()
+        admin.tpujobs.create(cases[1].job)
+        _wait(lambda: ledger.phase_of(vic_key) == gp.PHASE_QUEUED,
+              "the victim to account as queued")
+        time.sleep(0.6)  # the injected queue window
+        windows["queued"] = time.monotonic() - t_vic_created
+
+        # -- 2. blocker finishes; the victim admits and trains ------------
+        blocker_gate.set()
+        _wait(lambda: _condition(admin, blk_name, c.JOB_SUCCEEDED) == "True",
+              "the blocker to finish")
+        _wait(lambda: len(_pods_of(vic_name)) == 3, "the victim's admission")
+        _wait(lambda: wl_vic.ledger.snapshot()["progress"] > 3,
+              "the victim to train")
+        _wait(lambda: ledger.phase_of(vic_key) == gp.PHASE_TRAINING,
+              "the victim to account as training")
+
+        # -- 3. a staged drain: 3 -> 2 workers ----------------------------
+        t_resize = time.monotonic()
+        admin.server.patch("tpujobs", "default", vic_name, {
+            "spec": {"tpuReplicaSpecs": {"Worker": {"replicas": 2}}}})
+        _wait(lambda: ledger.phase_of(vic_key) == gp.PHASE_RESIZING,
+              "the resize window to account")
+        _wait(lambda: (len(_pods_of(vic_name)) == 2
+                       and admin.tpujobs.get(
+                           "default", vic_name).status.resize is None),
+              "the drain to complete")
+        windows["resizing"] = time.monotonic() - t_resize
+        _wait(lambda: wl_vic.ledger.snapshot()["progress"] > 6,
+              "training to resume at the shrunk world")
+
+        # export surfaces mid-flight
+        text = _fetch(mon.port, "/metrics")
+        for family in ("tpujob_job_goodput_ratio",
+                       "tpujob_job_goodput_seconds_total",
+                       "tpujob_job_badput_seconds_total",
+                       "tpujob_fleet_goodput_ratio"):
+            if f"# HELP {family} " not in text:
+                problems.append(f"/metrics missing HELP {family}")
+        if (f'tpujob_job_badput_seconds_total{{namespace="default",'
+                f'job="{vic_name}",shard="-",phase="queued"}}') not in text:
+            problems.append("queued badput series not exported")
+        fleet = _fetch(mon.port, "/debug/fleet")
+        if not fleet.get("goodput") or fleet["goodput"]["jobs"] < 1:
+            problems.append(f"/debug/fleet goodput block missing: {fleet}")
+        view = app.scheduler.goodput_view(vic_key)
+        if view is None or view.source != "ledger":
+            problems.append(f"scheduler does not see a ledger view: {view}")
+        elif view.projected_loss_s == float("inf"):
+            problems.append("ledger view has no telemetry (infinite loss)")
+
+        # -- 4. a high-tier whole-fleet gang preempts the victim ----------
+        t_preempt = time.monotonic()
+        admin.tpujobs.create(cases[2].job)
+        _wait(lambda: ledger.phase_of(vic_key) == gp.PHASE_PREEMPTED,
+              "the preemption to account")
+        _wait(lambda: _pods_of(vic_name) == [], "the victim's eviction")
+        if wl_vic.acked < 1:
+            problems.append("eviction proceeded without the workload's ack")
+        _wait(lambda: _condition(admin, boss_name, c.JOB_SUCCEEDED) == "True",
+              "the preemptor to finish")
+        _wait(lambda: len(_pods_of(vic_name)) == 2, "the re-admission")
+        _wait(lambda: ledger.phase_of(vic_key) == gp.PHASE_TRAINING,
+              "training to account after re-admission")
+        windows["preempted"] = time.monotonic() - t_preempt
+
+        # -- 5. the ledger verdict ----------------------------------------
+        totals = ledger.totals(vic_key)
+        wall = sum(totals.values())
+        age = time.monotonic() - t_vic_created
+        # phase fractions sum to 1 +- eps over the job's wall clock
+        if abs(wall - age) > 0.15 * age + 0.75:
+            problems.append(
+                f"ledger wall {wall:.2f}s != job age {age:.2f}s (gap or "
+                "double count)")
+        if totals.get("queued", 0.0) < windows["queued"] * 0.4:
+            problems.append(
+                f"queued badput {totals.get('queued', 0):.2f}s does not "
+                f"cover the injected {windows['queued']:.2f}s queue window")
+        if totals.get("resizing", 0.0) <= 0:
+            problems.append("resize window attributed zero badput")
+        if totals.get("preempted", 0.0) < 0.2:
+            problems.append(
+                f"preemption window attributed {totals.get('preempted', 0):.2f}s "
+                "badput (expected the barrier + requeue wait)")
+        good = sum(totals.get(p, 0.0) for p in gp.GOODPUT_PHASES)
+        if good <= 0:
+            problems.append("no goodput attributed to a training job")
+        debug = _fetch(mon.port, f"/debug/jobs/default/{vic_name}")
+        if not (debug.get("status") or {}).get("goodput"):
+            problems.append("/debug/jobs missing the goodput block")
+
+        # -- 6. finish: the series are removed ----------------------------
+        vic_gate.set()
+        _wait(lambda: _condition(admin, vic_name, c.JOB_SUCCEEDED) == "True",
+              "the victim to succeed")
+        _wait(lambda: ledger.get(vic_key) is None,
+              "the ledger entry to be dropped")
+        text = _fetch(mon.port, "/metrics")
+        if f'job="{vic_name}"' in text:
+            problems.append("finished job still exporting goodput series")
+
+        problems += _settle_invariants(admin, app.controller, cases, tracker,
+                                       chaos, deadline)
+        if problems:
+            raise AssertionError(
+                "goodput smoke invariants violated:\n  "
+                + "\n  ".join(problems))
+        return {
+            "mode": "goodput-smoke",
+            "seed": seed,
+            "wall_s": round(wall, 3),
+            "goodput_s": round(good, 3),
+            "goodput_ratio": round(good / wall, 4) if wall else None,
+            "badput_s": {k: round(v, 3) for k, v in sorted(totals.items())
+                         if k not in gp.GOODPUT_PHASES and v > 0},
+            "windows_s": {k: round(v, 3) for k, v in windows.items()},
+            "duration_s": round(time.monotonic() - started, 3),
+            "invariants": "ok",
+        }
+    finally:
+        trainer_stop.set()
+        blocker_gate.set()
+        vic_gate.set()
+        kubelet.stop()
+        mon.stop()
+        app.shutdown()

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Goodput smoke: queue -> train -> resize -> preempt -> re-admit ->
+succeed, with every second attributed to the right phase bucket.
+
+The fast acceptance gate of the goodput accounting plane (``make
+goodput-smoke``, wired as a ``make test`` prerequisite):
+
+- one victim job runs the full badput journey against a live
+  scheduler-enabled controller with real heartbeats and barrier acks;
+- the ledger's phase fractions sum to the job's wall clock within epsilon
+  and the injected queue/resize/preemption windows land in the matching
+  ``tpujob_job_badput_seconds_total{phase}`` buckets;
+- ``/metrics``, ``/debug/jobs`` and ``/debug/fleet`` carry the goodput
+  surfaces, the scheduler consumes the ledger-backed GoodputView, and a
+  finished job's series are removed.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from e2e.goodput import run_goodput_smoke
+
+
+def main() -> int:
+    logging.disable(logging.CRITICAL)
+    report = run_goodput_smoke(seed=17)
+    assert report["invariants"] == "ok"
+    print(f"goodput-smoke: OK (goodput ratio {report['goodput_ratio']}, "
+          f"badput {report['badput_s']}, wall {report['wall_s']}s, "
+          f"in {report['duration_s']}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

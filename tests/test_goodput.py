@@ -1,0 +1,641 @@
+"""Goodput accounting plane: the phase ledger, its views, its wiring.
+
+Unit matrix for tpujob/obs/goodput.py + the reconciler/scheduler
+integration: interval-closing attribution (every second in exactly one
+bucket), the coarse seed-from-conditions rebuild (cold restart and shard
+handoff account the full wall clock with no gap and export through exactly
+one member), the queued -> preempted -> re-admitted journey, clock-skewed
+heartbeats (the ``t=`` field is never an input — the controller clock
+wins), finished-job series removal, and the GoodputView projected-loss
+victim costing the gang scheduler consumes (including the victim-choice
+FLIP against raw steps-past-checkpoint, and the heartbeat-annotation
+fallback for jobs with no ledger).
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from jobtestutil import Harness, new_tpujob
+from tpujob.api import constants as c
+from tpujob.api.progress import format_progress
+from tpujob.controller import status as st
+from tpujob.controller.job_base import ControllerConfig
+from tpujob.controller.reconciler import TPUJobController
+from tpujob.kube.client import RESOURCE_PODS, ClientSet
+from tpujob.kube.control import gen_general_name
+from tpujob.obs import goodput as gp
+from tpujob.server import metrics
+from tpujob.server.metrics import REGISTRY, _LabeledFamily
+from tpujob.server.scheduler import GangScheduler
+from tpujob.server.sharding import shard_of_uid, sync_shard
+from tpujob.workloads.distributed import pod_progress_patch
+
+JOB = "good-job"
+KEY = f"default/{JOB}"
+
+
+@pytest.fixture(autouse=True)
+def _isolate_job_series():
+    """Registry is process-global: drop any per-job child this module
+    minted so absence assertions never depend on test order."""
+    yield
+    for fam in vars(metrics).values():
+        if not isinstance(fam, _LabeledFamily) \
+                or not fam.name.startswith("tpujob_job_"):
+            continue
+        fam.remove_matching(
+            lambda k: any(v == JOB or v.endswith("-vic") for v in k))
+
+
+# ---------------------------------------------------------------------------
+# the ledger: interval-closing attribution
+# ---------------------------------------------------------------------------
+
+
+def test_observe_attributes_every_second_to_exactly_one_phase():
+    led = gp.GoodputLedger()
+    t0 = 1000.0
+    assert led.observe(KEY, "default", JOB, "-", gp.PHASE_QUEUED,
+                       now=t0) == gp.EVENT_FIRST
+    assert led.observe(KEY, "default", JOB, "-", gp.PHASE_QUEUED,
+                       now=t0 + 5) is None  # same phase: lazy accrual
+    assert led.observe(KEY, "default", JOB, "-", gp.PHASE_INITIALIZING,
+                       now=t0 + 10) == gp.EVENT_TRANSITION
+    assert led.observe(KEY, "default", JOB, "-", gp.PHASE_TRAINING,
+                       now=t0 + 12, step=0) == gp.EVENT_TRANSITION
+    totals = led.totals(KEY, now=t0 + 30)
+    assert totals == {"queued": 10.0, "initializing": 2.0, "training": 18.0}
+    # fractions sum to exactly the wall clock — the smoke's 1 +- eps bar
+    assert sum(totals.values()) == pytest.approx(30.0)
+    assert led.ratio(KEY, now=t0 + 30) == pytest.approx(18.0 / 30.0)
+    assert led.phase_of(KEY) == gp.PHASE_TRAINING
+
+
+def test_step_rate_accrues_only_in_goodput_phases():
+    led = gp.GoodputLedger()
+    t0 = 0.0
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_TRAINING, now=t0, step=0)
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_TRAINING, now=t0 + 10,
+                step=50)
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_STALLED, now=t0 + 10,
+                step=50)
+    # a step jump observed while stalled (e.g. annotation replay) does not
+    # poison the rate; a crash-restore REGRESSION never subtracts
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_STALLED, now=t0 + 20,
+                step=60)
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_TRAINING, now=t0 + 20,
+                step=10)
+    view = led.view(KEY, step=60, checkpoint_step=20, now=t0 + 20)
+    assert view.source == "ledger"
+    assert view.step_rate == pytest.approx(50.0 / 10.0)
+    assert view.steps_at_risk == 40.0
+
+
+def test_view_projected_loss_math():
+    led = gp.GoodputLedger()
+    t0 = 0.0
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_QUEUED, now=t0)
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_INITIALIZING, now=t0 + 8)
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_TRAINING, now=t0 + 12,
+                step=0)
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_TRAINING, now=t0 + 112,
+                step=1000)  # 10 steps/s
+    view = led.view(KEY, step=1000, checkpoint_step=900, now=t0 + 112)
+    # redo 100 steps at 10/s (10s) + one restore (4s) + one requeue (8s)
+    assert view.projected_loss_s == pytest.approx(10.0 + 4.0 + 8.0)
+    # no telemetry at all = infinite (victims that publish go first)
+    blind = led.view(KEY, step=None, checkpoint_step=None, now=t0 + 112)
+    assert blind.projected_loss_s == float("inf")
+
+
+def test_seeded_prehistory_never_dilutes_the_cost_view():
+    """Regression: a re-seeded entry (controller restart / shard handoff)
+    carries hours of coarse 'training' pre-history but ZERO step
+    observations — the cost view must derive its step rate and restore/
+    requeue averages from precisely-observed intervals only, or a 3h-old
+    job's projected redo cost explodes ~wall/observed-x after every
+    restart and the victim ranking inverts."""
+    led = gp.GoodputLedger()
+    t0 = 10_000.0
+    # rebuilt owner: 3h of pre-history seeded as training (+10m queued)
+    conds = [{"type": c.JOB_CREATED, "status": "True",
+              "lastTransitionTime": "2026-08-04T09:00:00Z"},
+             {"type": c.JOB_RUNNING, "status": "True",
+              "lastTransitionTime": "2026-08-04T09:10:00Z"}]
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_TRAINING, now=t0, step=0,
+                conditions=conds, now_wall=gp._parse_wall(
+                    "2026-08-04T12:00:00Z"))
+    entry = led.get(KEY)
+    assert sum(entry.seeded.values()) == pytest.approx(3 * 3600.0)
+    # 100s of precise observation at 1 step/s
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_TRAINING, now=t0 + 100,
+                step=100)
+    view = led.view(KEY, step=100, checkpoint_step=0, now=t0 + 100)
+    # the rate is the OBSERVED 1 step/s, not 100/(3h+100s) ~ 0.009
+    assert view.step_rate == pytest.approx(1.0)
+    assert view.projected_loss_s == pytest.approx(100.0)
+    # seeded seconds still count for the wall-clock attribution surfaces
+    totals = led.totals(KEY, now=t0 + 100)
+    assert sum(totals.values()) == pytest.approx(3 * 3600.0 + 100.0)
+    row = led.row(KEY, now=t0 + 100)
+    assert row["step_rate"] == pytest.approx(1.0)
+
+
+def test_fleet_rollup_aggregates_match_brute_force():
+    """The export path's O(1) fleet rollup (incremental aggregates) must
+    agree with the brute-force per-entry walk the /debug/fleet block does,
+    through seeds, transitions, and forgets."""
+    led = gp.GoodputLedger()
+    t0 = 5_000.0
+    conds = [{"type": c.JOB_CREATED, "status": "True",
+              "lastTransitionTime": "2026-08-04T10:00:00Z"}]
+    led.observe("d/a", "d", "a", "-", gp.PHASE_QUEUED, now=t0,
+                conditions=conds, now_wall=gp._parse_wall(
+                    "2026-08-04T10:30:00Z"))  # 30m seeded queued
+    led.observe("d/b", "d", "b", "-", gp.PHASE_TRAINING, now=t0 + 1)
+    led.observe("d/a", "d", "a", "-", gp.PHASE_TRAINING, now=t0 + 10)
+    led.observe("d/b", "d", "b", "-", gp.PHASE_RESIZING, now=t0 + 12)
+    led.observe("d/c", "d", "c", "-", gp.PHASE_INITIALIZING, now=t0 + 13)
+
+    def agg(now):
+        n = len(led._jobs)
+        wall = led._agg_closed_wall + n * now - led._agg_start_sum
+        good = (led._agg_closed_good + led._agg_good_n * now
+                - led._agg_good_start_sum)
+        return wall, good
+
+    def brute(now):
+        fl = led.fleet(now=now)
+        return fl["wall_s"], fl["goodput_s"]
+
+    for now in (t0 + 13, t0 + 20):
+        w1, g1 = agg(now)
+        w2, g2 = brute(now)  # fleet() rounds to 3 decimals
+        assert w1 == pytest.approx(w2, abs=2e-3)
+        assert g1 == pytest.approx(g2, abs=2e-3)
+    led.forget("d/b")
+    w1, g1 = agg(t0 + 25)
+    w2, g2 = brute(t0 + 25)
+    assert w1 == pytest.approx(w2, abs=2e-3)
+    assert g1 == pytest.approx(g2, abs=2e-3)
+    led.forget("d/a")
+    led.forget("d/c")
+    # empty ledger: aggregates reset to exactly zero (drift hygiene)
+    assert agg(t0 + 30) == (0.0, 0.0)
+
+
+def test_restore_cost_is_per_admission_not_per_phase_episode():
+    """Regression: a gang-scheduled admission passes through scheduling
+    AND initializing — dividing bring-up seconds by the summed episode
+    counts would halve the modeled restore cost exactly for the jobs the
+    ledger pricing exists to protect."""
+    led = gp.GoodputLedger()
+    t0 = 0.0
+    # two admission stints, each 2s scheduling + 4s initializing
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_SCHEDULING, now=t0)
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_INITIALIZING, now=t0 + 2)
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_TRAINING, now=t0 + 6)
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_PREEMPTED, now=t0 + 16)
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_SCHEDULING, now=t0 + 20)
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_INITIALIZING, now=t0 + 22)
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_TRAINING, now=t0 + 26)
+    view = led.view(KEY, step=10, checkpoint_step=10, now=t0 + 30)
+    assert view.restore_cost_s == pytest.approx(6.0)  # per admission, not 3
+    assert view.requeue_cost_s == pytest.approx(4.0)
+
+
+def test_heartbeat_fallback_preserves_raw_steps_ordering():
+    a = gp.heartbeat_view(100, 90)
+    b = gp.heartbeat_view(50, 0)
+    assert a.source == "heartbeat"
+    assert a.projected_loss_s == 10.0  # 1 step ~ 1 s, no history costs
+    assert b.projected_loss_s == 50.0
+    assert a.projected_loss_s < b.projected_loss_s
+
+
+def test_arm_tick_claims_one_window():
+    led = gp.GoodputLedger()
+    assert led.arm_tick(KEY, 1.0) is False  # no entry yet
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_TRAINING, now=0.0)
+    assert led.arm_tick(KEY, 1.0, now=10.0) is True
+    assert led.arm_tick(KEY, 1.0, now=10.5) is False  # live tick covers it
+    assert led.arm_tick(KEY, 1.0, now=11.0) is True  # due time passed
+
+
+def test_export_and_forget_series_lifecycle():
+    led = gp.GoodputLedger()
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_QUEUED, now=0.0)
+    led.observe(KEY, "default", JOB, "-", gp.PHASE_TRAINING, now=10.0)
+    led.export(KEY, now=30.0)
+    text = REGISTRY.expose()
+    assert (f'tpujob_job_goodput_ratio{{namespace="default",job="{JOB}",'
+            f'shard="-"}}') in text
+    assert "# TYPE tpujob_job_goodput_seconds_total counter" in text
+    assert "# TYPE tpujob_job_badput_seconds_total counter" in text
+    assert (f'tpujob_job_badput_seconds_total{{namespace="default",'
+            f'job="{JOB}",shard="-",phase="queued"}} 10') in text
+    assert metrics.fleet_goodput_ratio.value == pytest.approx(20.0 / 30.0)
+    led.forget(KEY)
+    assert f'job="{JOB}"' not in REGISTRY.expose()
+    assert metrics.fleet_goodput_ratio.value == 0.0
+
+
+# ---------------------------------------------------------------------------
+# seed-from-conditions: the damper-rebuild stance
+# ---------------------------------------------------------------------------
+
+
+def _cond(ctype: str, status: str, reason: str, age_s: float,
+          now_wall: float) -> dict:
+    return {"type": ctype, "status": status, "reason": reason,
+            "lastTransitionTime": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(now_wall - age_s))}
+
+
+def test_seed_reconstructs_full_wall_clock_with_no_gap():
+    now = time.time()
+    conditions = [
+        _cond(c.JOB_CREATED, "True", "TPUJobCreated", 100.0, now),
+        _cond(c.JOB_RUNNING, "True", "TPUJobRunning", 80.0, now),
+        _cond(c.JOB_STALLED, "True", "TPUJobStalled", 30.0, now),
+    ]
+    totals = gp.seed_from_conditions(conditions, now_wall=now)
+    # tail: stalled claims [now-30, now]; middle: ran at some point ->
+    # training claims [created, tail]
+    assert totals["stalled"] == pytest.approx(30.0, abs=1.5)
+    assert totals["training"] == pytest.approx(70.0, abs=1.5)
+    assert sum(totals.values()) == pytest.approx(100.0, abs=1.5)  # no gap
+
+
+def test_seed_attributes_preempted_requeue_by_sticky_reason():
+    now = time.time()
+    conditions = [
+        _cond(c.JOB_CREATED, "True", "TPUJobCreated", 60.0, now),
+        _cond(c.JOB_RUNNING, "False", "TPUJobPreempted", 20.0, now),
+        _cond(c.JOB_QUEUED, "True", st.REASON_JOB_PREEMPTED, 20.0, now),
+    ]
+    totals = gp.seed_from_conditions(conditions, now_wall=now)
+    assert totals["preempted"] == pytest.approx(20.0, abs=1.5)
+    assert totals["training"] == pytest.approx(40.0, abs=1.5)
+
+
+def test_seed_without_created_condition_is_empty():
+    assert gp.seed_from_conditions([], now_wall=time.time()) == {}
+    assert gp.seed_from_conditions(None) == {}
+
+
+# ---------------------------------------------------------------------------
+# reconciler integration
+# ---------------------------------------------------------------------------
+
+
+def _harness(**extra) -> Harness:
+    h = Harness(config=ControllerConfig(
+        settle_window_s=0.0, stall_timeout_s=30.0,
+        stall_check_interval_s=0.05, **extra))
+    h.submit(new_tpujob(name=JOB, master=None, workers=2, backoff_limit=20))
+    h.sync()
+    for i in range(2):
+        h.set_pod_phase(JOB, c.REPLICA_TYPE_WORKER, i, "Running")
+    h.sync()
+    return h
+
+
+def _publish(h: Harness, step: int, index: int = 0, ckpt=None,
+             published_at=None) -> None:
+    name = gen_general_name(JOB, c.REPLICA_TYPE_WORKER, index)
+    h.server.patch(RESOURCE_PODS, "default", name, pod_progress_patch(
+        format_progress(step, samples_per_sec=100.0, checkpoint_step=ckpt,
+                        published_at=published_at)))
+
+
+def test_sync_path_attributes_initializing_then_training():
+    h = Harness(config=ControllerConfig(settle_window_s=0.0))
+    h.submit(new_tpujob(name=JOB, master=None, workers=2, backoff_limit=20))
+    h.sync()
+    # pods exist but are Pending: initialization
+    assert h.controller.goodput.phase_of(KEY) == gp.PHASE_INITIALIZING
+    for i in range(2):
+        h.set_pod_phase(JOB, c.REPLICA_TYPE_WORKER, i, "Running")
+    h.sync()
+    # fully Running, no heartbeats: benefit of the doubt = training
+    assert h.controller.goodput.phase_of(KEY) == gp.PHASE_TRAINING
+    _publish(h, 10, ckpt=5)
+    h.sync()
+    assert h.controller.goodput.phase_of(KEY) == gp.PHASE_TRAINING
+    row = h.controller.goodput.row(KEY)
+    assert row["goodput_ratio"] is not None
+    assert row["badput_s"].get("initializing", 0) >= 0
+
+
+def test_stalled_and_resize_windows_attribute_badput():
+    h = _harness()
+    _publish(h, 10)
+    h.sync()
+    state = h.controller.telemetry.get(KEY)
+    state.last_advance_mono -= 120.0  # age past the stall deadline
+    h.sync()
+    assert st.has_condition(h.get_job(JOB).status, c.JOB_STALLED)
+    assert h.controller.goodput.phase_of(KEY) == gp.PHASE_STALLED
+    # recovery, then a staged drain: the resize window is attributed
+    _publish(h, 11)
+    h.sync()
+    assert h.controller.goodput.phase_of(KEY) == gp.PHASE_TRAINING
+    h.server.patch("tpujobs", "default", JOB, {
+        "spec": {"tpuReplicaSpecs": {"Worker": {"replicas": 1}}}})
+    h.sync(rounds=1)
+    assert h.get_job(JOB).status.resize is not None
+    assert h.controller.goodput.phase_of(KEY) == gp.PHASE_RESIZING
+    totals = h.controller.goodput.totals(KEY)
+    assert totals.get("stalled", 0) > 0
+    assert totals.get("resizing", 0) >= 0
+
+
+def test_clock_skewed_heartbeats_cannot_bend_the_ledger():
+    """The ``t=`` field is informational only: a publisher whose wall
+    clock is hours ahead (or behind) moves no ledger interval — every
+    second is measured on the controller's monotonic clock."""
+    h = _harness()
+    _publish(h, 10, published_at=time.time() + 7200.0)  # 2h in the future
+    h.sync()
+    wall0 = sum(h.controller.goodput.totals(KEY).values())
+    _publish(h, 11, published_at=time.time() - 7200.0)  # 2h in the past
+    h.sync()
+    wall1 = sum(h.controller.goodput.totals(KEY).values())
+    # the ledger advanced by real elapsed seconds (sub-second here), not
+    # by the 4h the skewed timestamps would suggest
+    assert 0 <= wall1 - wall0 < 5.0
+    assert h.controller.goodput.phase_of(KEY) == gp.PHASE_TRAINING
+
+
+def test_finished_job_drops_goodput_series():
+    h = _harness()
+    _publish(h, 10, ckpt=10)
+    h.sync()
+    h.controller.goodput.export(KEY)
+    assert f'job="{JOB}"' in REGISTRY.expose()
+    for i in range(2):
+        h.set_pod_phase(JOB, c.REPLICA_TYPE_WORKER, i, "Succeeded")
+    h.sync()
+    assert h.check_condition(h.get_job(JOB), c.JOB_SUCCEEDED)
+    assert h.controller.goodput.get(KEY) is None
+    assert f'job="{JOB}"' not in REGISTRY.expose()
+
+
+def test_cold_restart_reseeds_from_conditions_no_gap():
+    """A fresh controller (crash + cold restart) re-seeds the ledger's
+    pre-history from the durable condition timestamps: the accounted wall
+    clock has no gap (covers the job's full age) and nothing double-counts
+    — the fresh entry replaces the dead incarnation's series under the
+    same labels."""
+    h = _harness()
+    _publish(h, 10)
+    h.sync()
+    # age the durable anchors: rewrite the condition transitions 100s back
+    job = h.get_job(JOB)
+    aged = []
+    for cond in job.status.conditions:
+        d = cond.to_dict()
+        d["lastTransitionTime"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ",
+            time.gmtime(time.time() - 100.0))
+        aged.append(d)
+    h.server.patch_status("tpujobs", "default", JOB, {"conditions": aged})
+    ctrl2 = TPUJobController(ClientSet(h.server), config=h.controller.config)
+    ctrl2.factory.sync_all()
+    ctrl2.sync_handler(KEY)
+    totals = ctrl2.goodput.totals(KEY)
+    assert totals is not None
+    # no gap: the full ~100s age is accounted (Running existed -> the
+    # middle seeds as training, the optimistic direction)
+    assert sum(totals.values()) == pytest.approx(100.0, abs=3.0)
+    assert totals.get("training", 0) > 90.0
+    ctrl2.goodput.forget(KEY)
+
+
+def test_shard_handoff_drops_ledger_and_series_then_reseeds():
+    h = Harness(config=ControllerConfig(settle_window_s=0.0))
+    job = h.submit(new_tpujob(name=JOB, master=None, workers=1,
+                              backoff_limit=20))
+    shard = shard_of_uid(job.metadata.uid, 4)
+
+    class _FakeSharder:
+        num_shards = 4
+        identity = "member-a"
+        active = {shard}
+
+        def shard_of_uid(self, uid):
+            return shard_of_uid(uid, 4)
+
+        def is_active(self, s):
+            return s in self.active
+
+        def sync_shard_context(self, s):
+            return sync_shard(s)
+
+        def owned_shards(self):
+            return set(self.active)
+
+    h.controller.set_sharder(_FakeSharder())
+    h.sync(key=KEY)
+    h.set_pod_phase(JOB, c.REPLICA_TYPE_WORKER, 0, "Running")
+    h.sync(key=KEY)
+    entry = h.controller.goodput.get(KEY)
+    assert entry is not None and entry.shard_label == str(shard)
+    h.controller.goodput.export(KEY)
+    assert f'shard="{shard}"' in REGISTRY.expose()
+    # handoff: the drain barrier drops the ledger AND its series — the new
+    # owner re-seeds from durable status, one exporter per job at any time
+    assert h.controller.drain_shard(shard) is True
+    assert h.controller.goodput.get(KEY) is None
+    assert f'job="{JOB}"' not in REGISTRY.expose()
+
+
+def test_queued_preempted_readmitted_journey():
+    """The satellite journey: a job that queues, admits, trains, is
+    preempted (sticky reason), and re-admits accounts each leg in the
+    right bucket."""
+    h = Harness(config=ControllerConfig(settle_window_s=0.0))
+    sched = GangScheduler(h.controller, "v4-16x1", aging_s=0.0,
+                          preempt_grace_s=0.0)
+    h.controller.set_scheduler(sched)
+
+    def step(rounds=2):
+        for _ in range(rounds):
+            h.controller.factory.sync_all()
+            sched.tick()
+            h.sync()
+
+    h.submit(new_tpujob(name=JOB, master=None, workers=2, backoff_limit=20))
+    h.sync()
+    assert h.controller.goodput.phase_of(KEY) == gp.PHASE_QUEUED
+    step()
+    # admitted: pods exist (Pending) -> scheduling/initializing leg
+    assert h.controller.goodput.phase_of(KEY) in (
+        gp.PHASE_SCHEDULING, gp.PHASE_INITIALIZING)
+    for i in range(2):
+        h.set_pod_phase(JOB, c.REPLICA_TYPE_WORKER, i, "Running")
+    h.sync()
+    assert h.controller.goodput.phase_of(KEY) == gp.PHASE_TRAINING
+    # evict the gang the way the scheduler does
+    h.server.patch("tpujobs", "default", JOB, {"metadata": {
+        "annotations": {c.ANNOTATION_SCHED_EVICTED: st.now_iso()}}})
+    h.sync()
+    assert h.controller.goodput.phase_of(KEY) == gp.PHASE_PREEMPTED
+    job = h.get_job(JOB)
+    assert st.get_condition(job.status, c.JOB_QUEUED).reason \
+        == st.REASON_JOB_PREEMPTED
+    # release + re-admission: the requeue wait stays attributed PREEMPTED
+    # (sticky reason) until the gang is re-admitted and training again
+    for _ in range(4):
+        step()
+    for i in range(2):
+        h.set_pod_phase(JOB, c.REPLICA_TYPE_WORKER, i, "Running")
+    h.sync()
+    assert h.controller.goodput.phase_of(KEY) == gp.PHASE_TRAINING
+    totals = h.controller.goodput.totals(KEY)
+    assert totals.get("queued", 0) > 0
+    assert totals.get("preempted", 0) > 0
+    eps = h.controller.goodput.get(KEY).episodes
+    assert eps.get("training", 0) >= 2  # one per admission stint
+
+
+def test_gate_path_arms_the_metrics_refresh_tick():
+    """Regression: a deep-queued job may see no events for hours — the
+    admission gate must arm the goodput refresh tick (one live chain, the
+    arm_tick contract) or the queue-badput series freeze between syncs."""
+    h = Harness(config=ControllerConfig(settle_window_s=0.0))
+    sched = GangScheduler(h.controller, "v4-16x1", aging_s=0.0,
+                          preempt_grace_s=0.0)
+    h.controller.set_scheduler(sched)
+    h.submit(new_tpujob(name=JOB, master=None, workers=2, backoff_limit=20))
+    h.sync()
+    assert h.controller.goodput.phase_of(KEY) == gp.PHASE_QUEUED
+    entry = h.controller.goodput.get(KEY)
+    assert entry.tick_due_mono is not None  # the chain is armed
+    # a second gated sync inside the window must NOT stack another chain
+    due = entry.tick_due_mono
+    h.sync()
+    assert h.controller.goodput.get(KEY).tick_due_mono == due
+
+
+# ---------------------------------------------------------------------------
+# the scheduler's victim costing
+# ---------------------------------------------------------------------------
+
+
+def _sched_job(name, priority=""):
+    from tpujob.api.types import RunPolicy
+
+    job = new_tpujob(name=name, master=None, workers=2,
+                     accelerator="v4-16", num_slices=1)
+    if priority:
+        job.spec.run_policy = RunPolicy.from_dict(
+            {"schedulingPolicy": {"priorityClass": priority}})
+    return job
+
+
+def test_victim_choice_flips_on_projected_goodput_loss():
+    """THE acceptance flip: raw steps-past-checkpoint would evict the
+    victim with fewer at-risk steps; the ledger-projected loss knows that
+    victim's step rate is 100x slower (its redo costs 100x the seconds)
+    and evicts the other gang instead."""
+    h = Harness(config=ControllerConfig(settle_window_s=0.0))
+    sched = GangScheduler(h.controller, "v4-16x2", aging_s=0.0,
+                          preempt_grace_s=0.0)
+    h.controller.set_scheduler(sched)
+
+    def step(rounds=2):
+        for _ in range(rounds):
+            h.controller.factory.sync_all()
+            sched.tick()
+            h.sync()
+
+    h.submit(_sched_job("fast-vic", priority="low"))
+    h.submit(_sched_job("slow-vic", priority="low"))
+    step()
+    assert len(h.pod_names()) == 4  # both admitted, fleet full
+    led = h.controller.goodput
+    t0 = time.monotonic() - 200.0
+    # fast-vic: 100 steps at risk but 10 steps/s -> redo 10s
+    led.observe("default/fast-vic", "default", "fast-vic", "-",
+                gp.PHASE_TRAINING, now=t0, step=0)
+    led.observe("default/fast-vic", "default", "fast-vic", "-",
+                gp.PHASE_TRAINING, now=t0 + 100, step=1000)
+    # slow-vic: 10 steps at risk but 0.1 steps/s -> redo 100s
+    led.observe("default/slow-vic", "default", "slow-vic", "-",
+                gp.PHASE_TRAINING, now=t0, step=0)
+    led.observe("default/slow-vic", "default", "slow-vic", "-",
+                gp.PHASE_TRAINING, now=t0 + 100, step=10)
+    h.controller.telemetry.ingest(
+        "default/fast-vic", "default", "fast-vic", "-", "fast-vic-worker-0",
+        "step=1000 ckpt=900", __import__(
+            "tpujob.api.progress", fromlist=["parse_progress"]
+        ).parse_progress("step=1000 ckpt=900"))
+    h.controller.telemetry.ingest(
+        "default/slow-vic", "default", "slow-vic", "-", "slow-vic-worker-0",
+        "step=10 ckpt=0", __import__(
+            "tpujob.api.progress", fromlist=["parse_progress"]
+        ).parse_progress("step=10 ckpt=0"))
+    # raw ordering would pick slow-vic (10 < 100 steps at risk); projected
+    # loss picks fast-vic (10s < 100s)
+    assert sched._victim_cost("default/fast-vic") \
+        < sched._victim_cost("default/slow-vic")
+    h.submit(_sched_job("boss", priority="high"))
+    h.controller.factory.sync_all()
+    sched.tick()
+    h.controller.factory.sync_all()
+    fast = h.get_job("fast-vic")
+    slow = h.get_job("slow-vic")
+    assert fast.metadata.annotations.get(c.ANNOTATION_PREEMPT_TARGET)
+    assert not slow.metadata.annotations.get(c.ANNOTATION_PREEMPT_TARGET)
+
+
+def test_goodput_view_heartbeat_fallback_is_the_one_parser():
+    """Satellite: a telemetry-less member (shard-0 owner costing another
+    member's job) builds its view from the pod heartbeat annotations
+    through the ONE fallback parser — and the barrier's ckpt>=step
+    shortcut consumes the same view."""
+    h = Harness(config=ControllerConfig(settle_window_s=0.0,
+                                        enable_goodput=False))
+    sched = GangScheduler(h.controller, "v4-16x1", preempt_grace_s=5.0)
+    h.controller.set_scheduler(sched)
+
+    def step(rounds=2):
+        for _ in range(rounds):
+            h.controller.factory.sync_all()
+            sched.tick()
+            h.sync()
+
+    h.submit(_sched_job("vic"))
+    step()
+    # heartbeat ONLY on the pod annotation (no tracker row: simulate the
+    # other-member case by clearing the local tracker)
+    pod = gen_general_name("vic", c.REPLICA_TYPE_WORKER, 0)
+    h.server.patch(RESOURCE_PODS, "default", pod, pod_progress_patch(
+        format_progress(40, checkpoint_step=40)))
+    h.controller.factory.sync_all()
+    h.controller.telemetry.forget("default/vic")
+    view = sched.goodput_view("default/vic")
+    assert view is not None and view.source == "heartbeat"
+    assert view.step == 40.0 and view.checkpoint_step == 40.0
+    assert view.projected_loss_s == 0.0
+    # the barrier shortcut rides the same view: ckpt caught up -> passes
+    ann = {c.ANNOTATION_PREEMPT_TARGET: st.now_iso()}
+    assert sched._barrier_passed("default/vic", ann, time.monotonic(),
+                                 time.time()) is True
+
+
+def test_debug_surfaces_carry_goodput_blocks():
+    h = _harness()
+    _publish(h, 10, ckpt=5)
+    h.sync()
+    state = h.controller.debug_job_state("default", JOB)
+    assert state["goodput"] is not None
+    assert state["goodput"]["phase"] == gp.PHASE_TRAINING
+    assert state["goodput"]["wall_s"] >= 0
+    fleet = h.controller.fleet_snapshot()
+    assert fleet["goodput"]["jobs"] >= 1
+    assert "badput_s" in fleet["goodput"]

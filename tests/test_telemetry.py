@@ -111,9 +111,13 @@ def test_every_labeled_family_exposes_help_and_type():
     fams = _labeled_families()
     assert fams, "no labeled families registered"
     names = {f.name for f in fams}
-    for want in ("tpujob_job_steps_total", "tpujob_job_samples_per_second",
+    for want in ("tpujob_job_steps", "tpujob_job_steps_total",
+                 "tpujob_job_samples_per_second",
                  "tpujob_job_checkpoint_age_seconds",
-                 "tpujob_job_heartbeat_age_seconds", "tpujob_job_stalled"):
+                 "tpujob_job_heartbeat_age_seconds", "tpujob_job_stalled",
+                 "tpujob_job_goodput_ratio",
+                 "tpujob_job_goodput_seconds_total",
+                 "tpujob_job_badput_seconds_total"):
         assert want in names, f"missing family {want}"
     text = REGISTRY.expose()
     for fam in fams:
@@ -126,7 +130,8 @@ def test_label_value_escaping_in_every_job_family():
     labels = dict(namespace="default", job=hostile, shard="-")
     escaped = 'job="we\\"ird\\njob\\\\x"'
     try:
-        for fam in (metrics.job_steps, metrics.job_samples_per_second,
+        for fam in (metrics.job_steps, metrics.job_steps_deprecated,
+                    metrics.job_samples_per_second,
                     metrics.job_checkpoint_age, metrics.job_heartbeat_age,
                     metrics.job_stalled):
             fam.labels(**labels).set(1.0)
@@ -138,8 +143,28 @@ def test_label_value_escaping_in_every_job_family():
     finally:
         for fam in _labeled_families():
             if fam.name.startswith("tpujob_job_"):
-                fam.remove(**labels)
+                fam.remove_matching(lambda k: hostile in k)
     assert escaped not in REGISTRY.expose()
+
+
+def test_steps_gauge_rename_emits_both_series():
+    """Satellite: the correctly-named ``tpujob_job_steps`` gauge emits next
+    to the deprecated ``tpujob_job_steps_total`` twin (kept one release),
+    with identical values, and removal drops both."""
+    h = _harness()
+    _publish(h, 42, ckpt=40)
+    h.sync()
+    labels = dict(namespace="default", job=JOB, shard="-")
+    assert metrics.job_steps.labels(**labels).value == 42
+    assert metrics.job_steps_deprecated.labels(**labels).value == 42
+    text = REGISTRY.expose()
+    assert "# TYPE tpujob_job_steps gauge" in text
+    assert "# TYPE tpujob_job_steps_total gauge" in text  # still a gauge
+    assert "# HELP tpujob_job_steps_total DEPRECATED" in text
+    h.controller.telemetry.forget(KEY)
+    for line in REGISTRY.expose().splitlines():
+        if line.startswith(("tpujob_job_steps{", "tpujob_job_steps_total{")):
+            assert f'job="{JOB}"' not in line, line
 
 
 def test_family_remove_semantics():
@@ -174,12 +199,8 @@ def _isolate_job_series():
     never depend on which tests ran before."""
     yield
     for fam in _labeled_families():
-        if not fam.name.startswith("tpujob_job_"):
-            continue
-        with fam._lock:
-            stale = [k for k in fam._children if JOB in k]
-            for k in stale:
-                fam._children.pop(k, None)
+        if fam.name.startswith("tpujob_job_"):
+            fam.remove_matching(lambda k: JOB in k)
 
 
 def _harness(stall: float = 30.0, policy: str = "event",
@@ -457,10 +478,11 @@ def test_terminal_job_drops_telemetry_and_flips_stalled_false():
 
 
 def test_telemetry_disabled_ignores_heartbeats():
-    h = _harness(enable_telemetry=False)
+    h = _harness(enable_telemetry=False, enable_goodput=False)
     _publish(h, 10)
     h.sync()
     assert h.controller.telemetry.get(KEY) is None
+    assert h.controller.goodput.get(KEY) is None
     assert f'job="{JOB}"' not in REGISTRY.expose()
 
 

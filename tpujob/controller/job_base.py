@@ -165,6 +165,15 @@ class ControllerConfig:
     # watchdog re-check cadence (requeued like ActiveDeadline); <= 0 derives
     # stall_timeout_s / 4 clamped to [0.05s, 60s]
     stall_check_interval_s: float = 0.0
+    # --- goodput accounting plane (the per-job phase ledger) ---
+    # attribute every second of each job's life to a phase (queued /
+    # scheduling / initializing / training / checkpointing / stalled /
+    # resizing / migrating / preempted / restarting) and export the
+    # tpujob_job_goodput_* / tpujob_job_badput_* families + the
+    # GoodputView the gang scheduler's victim choice consumes.  False
+    # disables the whole plane (the bench_controller --goodput control);
+    # the scheduler then falls back to raw steps-past-checkpoint.
+    enable_goodput: bool = True
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def stall_check_interval(self) -> float:

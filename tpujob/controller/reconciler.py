@@ -13,7 +13,6 @@ cluster-spec injection in place of the NCCL wiring:
 """
 from __future__ import annotations
 
-import calendar
 import json
 import logging
 import time
@@ -43,6 +42,7 @@ from tpujob.controller.joblogger import (
 )
 from tpujob.controller.job_base import JobController, _DedupWarner, expectation_key
 from tpujob.kube.client import RESOURCE_TPUJOBS
+from tpujob.obs import goodput as gp
 from tpujob.kube.control import gen_general_name, gen_labels, gen_pod_group_name
 from tpujob.kube.errors import ConflictError, NotFoundError, ServerTimeoutError
 from tpujob.kube.objects import (
@@ -67,19 +67,15 @@ _time_warner = _DedupWarner(interval=60.0)
 
 
 def _parse_time(ts: Optional[str]) -> Optional[float]:
-    """Parse a status timestamp, treating garbage as unset: one corrupted
-    ``start_time``/``completion_time`` write must degrade the affected
-    feature (deadline/TTL), not turn every subsequent sync of the job into
-    a permanent ValueError crash-loop."""
-    if not ts:
-        return None
-    try:
-        return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
-    except ValueError:
+    """st.parse_iso plus the reconciler's rate-limited warning: a corrupt
+    ``start_time``/``completion_time`` degrades the affected feature
+    (deadline/TTL) but should still be heard about once a minute."""
+    t = st.parse_iso(ts)
+    if t is None and ts:
         _time_warner.warning(
             log, ("unparseable-timestamp", ts),
             "unparseable status timestamp %r; treating as unset", ts)
-        return None
+    return t
 
 
 def get_port_from_job(job: TPUJob, rtype: str) -> int:
@@ -162,6 +158,15 @@ class TPUJobController(JobController):
         # owner) re-seeds from the annotations still on the cluster and
         # grants one full stall deadline, the damper-rebuild stance.
         self.telemetry = ProgressTracker()
+        # goodput accounting plane: the per-job phase ledger attributing
+        # every second of a job's life to one of the ten phases, from
+        # signals this sync already derived (conditions, annotations, pods,
+        # heartbeat events) — controller-monotonic-anchored, reconstructed
+        # not durable (a cold start / rebalanced-in owner re-seeds the
+        # pre-history coarsely from the condition timestamps), and dropped
+        # with the telemetry state across the shard drain barrier so
+        # exactly one member ever accounts for a job.
+        self.goodput = gp.GoodputLedger()
         # the status snapshot THIS sync was computed from, stashed for the
         # write path's diff (job key -> JobStatus; same single-writer-per-
         # key safety as _restart_deltas).  The patch diff must use the
@@ -376,6 +381,7 @@ class TPUJobController(JobController):
         # future job recreated under the same namespace/name
         self._resize_started_mono.pop(key, None)  # same hygiene
         self.telemetry.forget(key)  # drops the tpujob_job_* series too
+        self.goodput.forget(key)  # drops the goodput series too
         for rtype in (c.REPLICA_TYPE_MASTER, c.REPLICA_TYPE_WORKER):
             self.expectations.delete(expectation_key(key, rtype, "pods"))
             self.expectations.delete(expectation_key(key, rtype, "services"))
@@ -479,6 +485,7 @@ class TPUJobController(JobController):
             # only grows, and the terminal transition already flipped any
             # Stalled condition False (status.set_condition semantics)
             self.telemetry.forget(key)
+            self.goodput.forget(key)  # a finished job accounts no phases
             self._delete_pods_and_services(job, pods, services)
             self._cleanup_ttl(job)
             if self.config.enable_gang_scheduling:
@@ -562,7 +569,14 @@ class TPUJobController(JobController):
         # status write).  A pure heartbeat tick changes no status field and
         # stays a suppressed write.
         with TRACER.span("phase", phase="telemetry"):
-            self._reconcile_telemetry(job, pods)
+            state, events = self._reconcile_telemetry(job, pods)
+
+        # goodput accounting: attribute the seconds since the last
+        # observation to the phase this sync derived.  After telemetry (the
+        # ingest events distinguish training from checkpointing), before
+        # persistence (phase derivation reads THIS sync's conditions).
+        with TRACER.span("phase", phase="goodput"):
+            self._reconcile_goodput(job, pods, state, events)
 
         self._persist_status(job, old_status)
         return True
@@ -1303,6 +1317,21 @@ class TPUJobController(JobController):
         # Pending-phase job Stalled (it gets one full deadline after
         # re-admission brings the publisher back)
         self.telemetry.exempt(key)
+        # goodput: a gated job accounts badput by its STICKY queue reason
+        # (the requeue wait after an eviction is part of the preemption's
+        # cost, not generic queueing — exactly what the scheduler's
+        # projected-loss view charges a repeat victim for)
+        cond = st.get_condition(job.status, c.JOB_QUEUED)
+        creason = cond.reason if cond is not None else reason
+        self._observe_goodput(job, gp.QUEUE_REASON_PHASES.get(
+            creason, gp.PHASE_QUEUED))
+        # a deep-queued job may see no events for hours: arm the metrics-
+        # refresh tick here too (same one-live-chain contract as the
+        # normal path) or its queue-badput series freeze between syncs
+        if self.config.enable_goodput:
+            interval = self.config.stall_check_interval()
+            if self.goodput.arm_tick(key, interval):
+                self.queue.add_after(key, interval)
         self._persist_status(job, old_status)
         return True
 
@@ -1350,9 +1379,13 @@ class TPUJobController(JobController):
     # workload telemetry: heartbeat ingestion + the stall watchdog
     # ------------------------------------------------------------------
 
-    def _reconcile_telemetry(self, job: TPUJob, pods: List[Pod]) -> None:
+    def _reconcile_telemetry(
+        self, job: TPUJob, pods: List[Pod]
+    ) -> Tuple[Optional[JobProgress], List[str]]:
         """Ingest the job's workload progress heartbeat and run the
-        Stalled-job watchdog.
+        Stalled-job watchdog.  Returns ``(state, ingest events)`` for the
+        goodput phase derivation downstream (``(None, [])`` when the plane
+        is off, the job publishes nothing, or this member does not own it).
 
         Ingestion reads the ``tpujob.dev/progress`` annotation off the pods
         this sync already claimed from the informer cache — zero extra API
@@ -1373,7 +1406,7 @@ class TPUJobController(JobController):
         conservatively restarts from re-ingestion.
         """
         if not self.config.enable_telemetry:
-            return
+            return None, []
         key = job.key
         if st.is_finished(job.status):
             # the job went terminal THIS sync: the terminal transition just
@@ -1381,9 +1414,11 @@ class TPUJobController(JobController):
             # and the lost-write repair below must not read that flip as a
             # lost stall write and resurrect it onto a finished job
             self.telemetry.forget(key)
-            return
+            self.goodput.forget(key)
+            return None, []
         if self.sharder is not None and not self._owns_key(key):
-            return  # a draining shard's wedged sync must not resurrect state
+            # a draining shard's wedged sync must not resurrect state
+            return None, []
         best: Optional[Tuple] = None
         best_pod: Optional[Pod] = None
         best_raw = ""
@@ -1418,7 +1453,7 @@ class TPUJobController(JobController):
         else:
             state = self.telemetry.get(key)
             if state is None:
-                return  # not a telemetry-publishing job
+                return None, []  # not a telemetry-publishing job
         if EVENT_FIRST in events:
             self.flight.record(
                 key, "progress",
@@ -1483,6 +1518,7 @@ class TPUJobController(JobController):
         if self.telemetry.arm_tick(key, interval):
             self.queue.add_after(key, interval)
         self.telemetry.export(key)
+        return state, events
 
     def _telemetry_exempt(self, job: TPUJob, pods: List[Pod]) -> Optional[str]:
         """Why a heartbeat gap is currently unaccountable (None = it counts):
@@ -1571,12 +1607,106 @@ class TPUJobController(JobController):
             f"Progress watchdog deleted stuck replica {pod.metadata.name} "
             f"of TPUJob {job.metadata.name}.")
 
+    # ------------------------------------------------------------------
+    # goodput accounting: the phase ledger (tpujob/obs/goodput)
+    # ------------------------------------------------------------------
+
+    def _goodput_shard_label(self, job: TPUJob) -> str:
+        if self.sharder is not None and job.metadata.uid:
+            shard = self.sharder.shard_of_uid(job.metadata.uid)
+            if shard is not None:
+                return str(shard)
+        return "-"
+
+    def _observe_goodput(self, job: TPUJob, phase: str,
+                         step: Optional[float] = None) -> None:
+        """Fold one derived phase into the job's ledger and refresh its
+        series.  Conditions ride along so a FRESH entry (cold start, shard
+        handoff, first sync) seeds the job's pre-history from the durable
+        timestamps instead of opening a gap."""
+        if not self.config.enable_goodput:
+            return
+        key = job.key
+        if self.sharder is not None and not self._owns_key(key):
+            return  # the owner accounts; a draining shard must not resurrect
+        event = self.goodput.observe(
+            key, job.metadata.namespace or "default", job.metadata.name,
+            self._goodput_shard_label(job), phase, step=step,
+            conditions=job.status.conditions)
+        if event == gp.EVENT_TRANSITION:
+            self.flight.record(
+                key, "goodput", f"phase -> {phase}", {"phase": phase})
+        self.goodput.export(key)
+
+    def _reconcile_goodput(self, job: TPUJob, pods: List[Pod],
+                           state: Optional[JobProgress],
+                           events: List[str]) -> None:
+        """The normal-path half of goodput accounting (the admission gate
+        observes its queued/preempted/migrating phases before returning).
+        Also arms the metrics-refresh tick for ledger-only jobs — a job
+        that never publishes heartbeats never arms the telemetry tick, and
+        its ratio gauge would otherwise freeze between pod events."""
+        if not self.config.enable_goodput or st.is_finished(job.status):
+            return
+        phase = self._goodput_phase(job, pods, state, events)
+        step = float(state.progress.step) if state is not None else None
+        self._observe_goodput(job, phase, step=step)
+        if state is None:
+            interval = self.config.stall_check_interval()
+            if self.goodput.arm_tick(job.key, interval):
+                self.queue.add_after(job.key, interval)
+
+    def _goodput_phase(self, job: TPUJob, pods: List[Pod],
+                       state: Optional[JobProgress],
+                       events: List[str]) -> str:
+        """Attribute this instant to one ledger phase, highest-signal
+        first: an in-flight preemption/migration outranks resize, resize
+        outranks restart, restart outranks stall, and only a gang that is
+        fully Running with an advancing step clock counts as training.
+        Everything here is a signal the sync already holds — conditions,
+        annotations, the claimed pods, the heartbeat ingest events."""
+        ann = job.metadata.annotations or {}
+        if (ann.get(c.ANNOTATION_PREEMPT_TARGET) is not None
+                or ann.get(c.ANNOTATION_SCHED_EVICTED) is not None):
+            return (gp.PHASE_MIGRATING
+                    if ann.get(c.ANNOTATION_MIGRATED_FROM)
+                    else gp.PHASE_PREEMPTED)
+        if (job.status.resize is not None
+                or ann.get(c.ANNOTATION_TARGET_WORLD_SIZE) is not None
+                or st.has_condition(job.status, c.JOB_RESIZING)):
+            return gp.PHASE_RESIZING
+        if st.has_condition(job.status, c.JOB_RESTARTING):
+            return gp.PHASE_RESTARTING
+        if st.has_condition(job.status, c.JOB_STALLED):
+            return gp.PHASE_STALLED
+        expected = get_total_replicas(job)
+        live = [p for p in pods if not p.metadata.deletion_timestamp]
+        running = sum(1 for p in live if p.status.phase == "Running")
+        if len(live) < expected:
+            # the gang's pod objects are not all there yet: with a native
+            # scheduler that window is placement echo / bring-up
+            # (scheduling); without one it is plain initialization
+            return (gp.PHASE_SCHEDULING if self.scheduler is not None
+                    else gp.PHASE_INITIALIZING)
+        if running < expected:
+            return gp.PHASE_INITIALIZING
+        if state is not None:
+            if state.progress.step <= 0:
+                # heartbeats flow but the step clock has not started:
+                # rendezvous / compile / restore — initialization
+                return gp.PHASE_INITIALIZING
+            if (EVENT_CHECKPOINT in events
+                    and EVENT_ADVANCE not in events):
+                return gp.PHASE_CHECKPOINTING
+        return gp.PHASE_TRAINING
+
     def on_shard_drained(self, shard: int) -> None:
         """Shard handoff: drop the handed-off shard's telemetry state and
         metric series — the new owner re-seeds from the pod annotations,
         and two members exporting the same job would break the scrape-merge
         partition invariant."""
         dropped = self.telemetry.forget_shard(str(shard))
+        self.goodput.forget_shard(str(shard))
         if dropped:
             from tpujob.obs.recorder import CONTROLLER_TIMELINE_KEY
 
@@ -1612,6 +1742,10 @@ class TPUJobController(JobController):
             "stall_timeout_s": self.config.stall_timeout_s,
             "stall_policy": self.config.stall_policy,
             "jobs": self.telemetry.snapshot(),
+            # member-local goodput rollup + the badput-breakdown table
+            # (top contributors first); fleet-wide truth is the scrape-
+            # merge of the per-job series, like the telemetry rows above
+            "goodput": self.goodput.fleet(),
         }
         if self.scheduler is not None:
             # queue positions + admission decisions + capacity utilization:
@@ -1629,7 +1763,8 @@ class TPUJobController(JobController):
         row = self.telemetry.row(f"{ns}/{name}")
         if obj is None and row is None:
             return None
-        out: Dict[str, Any] = {"progress": row}
+        out: Dict[str, Any] = {"progress": row,
+                               "goodput": self.goodput.row(f"{ns}/{name}")}
         if obj is not None:
             status = obj.get("status")
             status = status if isinstance(status, dict) else {}

@@ -8,6 +8,7 @@ ship JSON-merge-patches of only the changed fields.
 """
 from __future__ import annotations
 
+import calendar
 import copy
 import time
 from typing import Any, Dict, List, Optional
@@ -19,6 +20,19 @@ from tpujob.kube.objects import Pod
 
 def now_iso() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def parse_iso(ts: Optional[str]) -> Optional[float]:
+    """Inverse of :func:`now_iso` — THE status-timestamp parser (epoch
+    seconds), shared by every consumer so the grammar lives in one place.
+    Garbage parses as unset: one corrupted timestamp write must degrade
+    the feature reading it, never crash-loop the sync."""
+    if not ts:
+        return None
+    try:
+        return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+    except ValueError:
+        return None
 
 
 # reasons (status.go:34-45 equivalents)

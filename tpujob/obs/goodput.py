@@ -1,0 +1,621 @@
+"""Per-job goodput accounting: the phase ledger and its scheduler view.
+
+The status machine records coarse phases; the papers this repo reproduces
+live and die on *goodput* — productive step time over wall time.  This
+module attributes every second of a job's life to exactly one phase,
+consuming only signals the repo already emits (Queued/Admitted conditions,
+PR-10 progress heartbeats and the Stalled condition, PR-9 resize staging,
+PR-11 preempt/evict annotations, PR-12 migration records, restart history):
+
+- ``queued``        — waiting in the gang scheduler's admission queue
+- ``scheduling``    — admitted, gang pods not yet all created
+- ``initializing``  — pods created but not all Running, or Running with no
+                      step progress yet (rendezvous, compile, restore)
+- ``training``      — the step clock is advancing (GOODPUT)
+- ``checkpointing`` — a checkpoint advanced without a step advance (GOODPUT)
+- ``stalled``       — the PR-10 watchdog holds the Stalled condition True
+- ``resizing``      — a PR-9 staged drain/join is in flight
+- ``migrating``     — evicted off dead/cordoned hosts (PR-12), mid-protocol
+- ``preempted``     — capacity preemption barrier/eviction/requeue (PR-11)
+- ``restarting``    — a counted ExitCode restart is replacing pods
+
+Clock discipline is the PR-10 stance: every interval is measured on the
+CONTROLLER's monotonic clock from the moment the phase was derived; the
+workload's ``t=`` heartbeat field is never an input, so clock-skewed
+publishers can neither fake nor hide badput.  Nothing here is durable —
+a cold-started controller (or a rebalanced-in shard owner) re-seeds the
+pre-history coarsely from the durable condition timestamps
+(:func:`seed_from_conditions`, the damper-reconstruction stance) and
+accounts precisely from that moment on.  Across the PR-8 drain barrier the
+handed-off shard's ledgers (and their metric series) are dropped so exactly
+one member ever accounts for — and exports — a job.
+
+Export is three-fold: the ``tpujob_job_goodput_ratio`` /
+``tpujob_job_goodput_seconds_total`` / ``tpujob_job_badput_seconds_total``
+families (one-exporter-per-job, scrape-merged across shards like the other
+``tpujob_job_*`` families), the ``goodput`` blocks on ``/debug/jobs`` and
+``/debug/fleet``, and the :class:`GoodputView` the GangScheduler consumes
+so preemption victim cost becomes *projected goodput lost* — redo seconds
+past the last checkpoint at the job's OWN observed step rate, plus its
+observed restore and requeue costs — instead of raw steps-past-checkpoint.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from tpujob.analysis import lockgraph
+from tpujob.api import constants as c
+from tpujob.controller import status as st
+from tpujob.controller.status import parse_iso as _parse_wall
+from tpujob.server import metrics
+
+PHASE_QUEUED = "queued"
+PHASE_SCHEDULING = "scheduling"
+PHASE_INITIALIZING = "initializing"
+PHASE_TRAINING = "training"
+PHASE_CHECKPOINTING = "checkpointing"
+PHASE_STALLED = "stalled"
+PHASE_RESIZING = "resizing"
+PHASE_MIGRATING = "migrating"
+PHASE_PREEMPTED = "preempted"
+PHASE_RESTARTING = "restarting"
+
+PHASES = (
+    PHASE_QUEUED, PHASE_SCHEDULING, PHASE_INITIALIZING, PHASE_TRAINING,
+    PHASE_CHECKPOINTING, PHASE_STALLED, PHASE_RESIZING, PHASE_MIGRATING,
+    PHASE_PREEMPTED, PHASE_RESTARTING,
+)
+# the productive phases: checkpointing is goodput — a checkpoint is the
+# work that makes every OTHER phase's cost bounded
+GOODPUT_PHASES = frozenset({PHASE_TRAINING, PHASE_CHECKPOINTING})
+BADPUT_PHASES = tuple(p for p in PHASES if p not in GOODPUT_PHASES)
+
+# observe() events
+EVENT_FIRST = "first"  # ledger entry created
+EVENT_TRANSITION = "transition"  # the attributed phase changed
+
+# the STICKY Queued-condition reason decides which badput bucket a queue
+# wait lands in (the requeue wait after an eviction is part of the
+# preemption/migration's cost, not generic queueing) — shared by the live
+# admission-gate path and the crash/handoff seed so the two can never
+# attribute the same wait to different phases
+QUEUE_REASON_PHASES = {
+    st.REASON_JOB_PREEMPTED: PHASE_PREEMPTED,
+    st.REASON_JOB_MIGRATED: PHASE_MIGRATING,
+}
+
+
+def _cond_fields(cond: Any) -> Dict[str, Optional[str]]:
+    """(type, status, reason, lastTransitionTime) off a JobCondition object
+    or its dict form — the seed path sees both."""
+    if isinstance(cond, dict):
+        return {"type": cond.get("type"), "status": cond.get("status"),
+                "reason": cond.get("reason"),
+                "t": cond.get("lastTransitionTime")}
+    return {"type": getattr(cond, "type", None),
+            "status": getattr(cond, "status", None),
+            "reason": getattr(cond, "reason", None),
+            "t": getattr(cond, "last_transition_time", None)}
+
+
+def seed_from_conditions(conditions: Optional[List[Any]],
+                         now_wall: Optional[float] = None
+                         ) -> Dict[str, float]:
+    """Coarse pre-history reconstruction from durable condition timestamps
+    — the damper-rebuild stance: a cold-started controller (or a
+    rebalanced-in shard owner) must account the job's FULL wall clock with
+    no gap, at condition-timestamp granularity.  The rules err productive:
+    a job that ever ran gets its unattributable middle as ``training``
+    (over-delaying badput attribution is the safe direction — badput is a
+    preemption-cost signal, and inflating it would mis-rank victims).
+
+    - the tail: the latest currently-True non-terminal condition
+      (Queued — by reason queued/preempted/migrating —, Stalled, Resizing,
+      Restarting) claims [its transition, now];
+    - the middle: ``training`` when a Running condition ever existed, else
+      ``queued``/``initializing``;
+    - the anchor: the Created condition's transition (absent = no seed —
+      precise accounting simply starts now).
+    """
+    now_wall = time.time() if now_wall is None else now_wall
+    by_type: Dict[str, Dict[str, Optional[str]]] = {}
+    for cond in conditions or []:
+        f = _cond_fields(cond)
+        if f["type"]:
+            by_type[f["type"]] = f
+    created = by_type.get(c.JOB_CREATED)
+    t0 = _parse_wall(created["t"]) if created else None
+    if t0 is None or now_wall <= t0:
+        return {}
+    totals: Dict[str, float] = {}
+    tail_cut = now_wall
+    # the tail: latest-transition True condition wins the final interval
+    tail: Optional[tuple] = None  # (t, phase)
+    queued = by_type.get(c.JOB_QUEUED)
+    if queued and queued["status"] == "True":
+        t = _parse_wall(queued["t"])
+        if t is not None:
+            phase = QUEUE_REASON_PHASES.get(queued["reason"] or "",
+                                            PHASE_QUEUED)
+            tail = (t, phase)
+    for ctype, phase in ((c.JOB_STALLED, PHASE_STALLED),
+                         (c.JOB_RESIZING, PHASE_RESIZING),
+                         (c.JOB_RESTARTING, PHASE_RESTARTING)):
+        cond = by_type.get(ctype)
+        if cond and cond["status"] == "True":
+            t = _parse_wall(cond["t"])
+            if t is not None and (tail is None or t > tail[0]):
+                tail = (t, phase)
+    if tail is not None:
+        t = max(t0, min(tail[0], now_wall))
+        if now_wall > t:
+            totals[tail[1]] = now_wall - t
+        tail_cut = t
+    # the middle [t0, tail_cut]
+    if tail_cut > t0:
+        if c.JOB_RUNNING in by_type:
+            middle = PHASE_TRAINING
+        elif queued is not None:
+            middle = PHASE_QUEUED
+        else:
+            middle = PHASE_INITIALIZING
+        totals[middle] = totals.get(middle, 0.0) + (tail_cut - t0)
+    return totals
+
+
+@dataclasses.dataclass(frozen=True)
+class GoodputView:
+    """What preempting this job costs, in projected seconds of goodput
+    lost.  ``source`` says how much the scheduler can trust it: ``ledger``
+    views carry the job's own observed step rate / restore / requeue
+    history; ``heartbeat`` views are the annotation-only fallback for jobs
+    with no ledger and preserve the legacy raw-steps ordering."""
+
+    source: str  # "ledger" | "heartbeat"
+    step: Optional[float]
+    checkpoint_step: Optional[float]
+    steps_at_risk: Optional[float]  # None = no telemetry at all
+    step_rate: Optional[float]  # observed steps/s of goodput time
+    restore_cost_s: float  # observed per-admission initializing cost
+    requeue_cost_s: float  # observed per-episode queue wait
+
+    @property
+    def projected_loss_s(self) -> float:
+        """Seconds of goodput a preemption would destroy: redo the
+        at-risk steps at the job's own rate, plus one restore and one
+        requeue.  Unknown telemetry = infinite — victims that publish
+        progress, and are provably cheap to evict, go first (the legacy
+        stance kept).  Without a measured rate one step counts one
+        second, which preserves the raw-steps ordering."""
+        if self.steps_at_risk is None:
+            return float("inf")
+        redo = (self.steps_at_risk / self.step_rate
+                if self.step_rate else self.steps_at_risk)
+        return redo + self.restore_cost_s + self.requeue_cost_s
+
+
+def heartbeat_view(step: float,
+                   checkpoint_step: Optional[float]) -> GoodputView:
+    """The no-ledger fallback view (annotation-parsed telemetry only)."""
+    return GoodputView(
+        source="heartbeat", step=float(step),
+        checkpoint_step=(None if checkpoint_step is None
+                         else float(checkpoint_step)),
+        steps_at_risk=max(0.0, float(step) - float(checkpoint_step or 0.0)),
+        step_rate=None, restore_cost_s=0.0, requeue_cost_s=0.0)
+
+
+@dataclasses.dataclass
+class JobGoodput:
+    """One job's ledger entry (mutated only under the ledger lock)."""
+
+    namespace: str
+    name: str
+    shard_label: str  # owning shard at observe time ('-' when unsharded)
+    phase: str
+    phase_start_mono: float
+    first_mono: float
+    totals: Dict[str, float]  # CLOSED intervals; live phase accrues lazily
+    episodes: Dict[str, int]  # transitions INTO each phase (cost divisors)
+    # the coarse pre-history a fresh entry was seeded with (condition-
+    # timestamp granularity, crash/handoff rebuild).  Kept apart so the
+    # scheduler's cost view derives ONLY from precisely-observed intervals:
+    # the seed has no step observations, so folding its hours of "training"
+    # into the step-rate denominator would dilute the rate ~wall/observed-x
+    # and blow up every projected redo cost after a controller restart.
+    seeded: Dict[str, float] = dataclasses.field(default_factory=dict)
+    last_step: Optional[float] = None
+    steps_in_goodput: float = 0.0  # step advances observed in goodput phases
+    tick_due_mono: Optional[float] = None  # in-flight refresh tick's due time
+
+
+class GoodputLedger:
+    def __init__(self):
+        self._lock = lockgraph.new_lock("goodput-ledger")
+        self._jobs: Dict[str, JobGoodput] = {}  # guarded by self._lock
+        self._fleet_refresh_mono = 0.0  # guarded by self._lock
+        # O(1) member rollup for the fleet gauge (export runs on every
+        # sync; walking every entry under the ledger lock there would be
+        # O(total jobs) — the firehose regime makes that a fleet-wide
+        # sync-latency spike).  Closed-interval sums plus per-entry
+        # phase-start sums give wall(now) = closed + n*now - start_sum,
+        # and the same for the goodput-phase subset; each observe/forget
+        # maintains them in O(1).  All guarded by self._lock.
+        self._agg_closed_wall = 0.0
+        self._agg_closed_good = 0.0
+        self._agg_start_sum = 0.0
+        self._agg_good_n = 0
+        self._agg_good_start_sum = 0.0
+
+    # ------------------------------------------------------------------
+    # accounting
+    # ------------------------------------------------------------------
+
+    def observe(
+        self,
+        key: str,
+        namespace: str,
+        name: str,
+        shard_label: str,
+        phase: str,
+        now: Optional[float] = None,
+        step: Optional[float] = None,
+        conditions: Optional[List[Any]] = None,
+        now_wall: Optional[float] = None,
+    ) -> Optional[str]:
+        """Fold one derived phase observation into the job's ledger.
+
+        Attribution is interval-closing: the seconds since the previous
+        observation belong to the phase that WAS active — a transition
+        closes the old phase at ``now`` and anchors the new one there, so
+        every second lands in exactly one bucket.  ``conditions`` seed a
+        FRESH entry's pre-history from durable status (crash / handoff
+        resume); ``step`` feeds the observed step rate while in a goodput
+        phase.  Returns the ledger event (or None)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            entry = self._jobs.get(key)
+            if entry is None:
+                totals = (seed_from_conditions(conditions, now_wall)
+                          if conditions else {})
+                entry = JobGoodput(
+                    namespace=namespace, name=name, shard_label=shard_label,
+                    phase=phase, phase_start_mono=now, first_mono=now,
+                    totals=totals, episodes={phase: 1},
+                    seeded=dict(totals),
+                    last_step=None if step is None else float(step))
+                self._jobs[key] = entry
+                self._agg_closed_wall += sum(totals.values())
+                self._agg_closed_good += sum(
+                    totals.get(p, 0.0) for p in GOODPUT_PHASES)
+                self._agg_start_sum += now
+                if phase in GOODPUT_PHASES:
+                    self._agg_good_n += 1
+                    self._agg_good_start_sum += now
+                return EVENT_FIRST
+            entry.shard_label = shard_label
+            event = None
+            # the phase the just-elapsed interval belongs to: a step delta
+            # observed NOW accrued during that interval, so the rate
+            # numerator is gated on it — not on the incoming phase (a
+            # stall-recovery catch-up must not inflate the rate, and steps
+            # earned right up to a training->resizing flip must count)
+            interval_phase = entry.phase
+            if phase != entry.phase:
+                closed = max(0.0, now - entry.phase_start_mono)
+                entry.totals[entry.phase] = (
+                    entry.totals.get(entry.phase, 0.0) + closed)
+                self._agg_closed_wall += closed
+                self._agg_start_sum += now - entry.phase_start_mono
+                if entry.phase in GOODPUT_PHASES:
+                    self._agg_closed_good += closed
+                    self._agg_good_n -= 1
+                    self._agg_good_start_sum -= entry.phase_start_mono
+                entry.phase = phase
+                entry.phase_start_mono = now
+                entry.episodes[phase] = entry.episodes.get(phase, 0) + 1
+                if phase in GOODPUT_PHASES:
+                    self._agg_good_n += 1
+                    self._agg_good_start_sum += now
+                event = EVENT_TRANSITION
+            if step is not None:
+                s = float(step)
+                if (entry.last_step is not None and s > entry.last_step
+                        and interval_phase in GOODPUT_PHASES):
+                    entry.steps_in_goodput += s - entry.last_step
+                entry.last_step = s
+            return event
+
+    @staticmethod
+    def _live_totals(entry: JobGoodput, now: float) -> Dict[str, float]:
+        """caller holds self._lock"""
+        out = dict(entry.totals)
+        out[entry.phase] = (out.get(entry.phase, 0.0)
+                            + max(0.0, now - entry.phase_start_mono))
+        return out
+
+    # ------------------------------------------------------------------
+    # reads
+    # ------------------------------------------------------------------
+
+    def get(self, key: str) -> Optional[JobGoodput]:
+        with self._lock:
+            return self._jobs.get(key)
+
+    def phase_of(self, key: str) -> Optional[str]:
+        with self._lock:
+            entry = self._jobs.get(key)
+            return entry.phase if entry is not None else None
+
+    def totals(self, key: str,
+               now: Optional[float] = None) -> Optional[Dict[str, float]]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            entry = self._jobs.get(key)
+            if entry is None:
+                return None
+            return self._live_totals(entry, now)
+
+    def ratio(self, key: str, now: Optional[float] = None) -> Optional[float]:
+        totals = self.totals(key, now)
+        if not totals:
+            return None
+        wall = sum(totals.values())
+        if wall <= 0:
+            return None
+        return sum(totals.get(p, 0.0) for p in GOODPUT_PHASES) / wall
+
+    def view(self, key: str, step: Optional[float] = None,
+             checkpoint_step: Optional[float] = None,
+             now: Optional[float] = None) -> Optional[GoodputView]:
+        """The scheduler-facing cost view (None = no ledger for the job).
+
+        Costs derive ONLY from precisely-observed intervals — the coarse
+        crash/handoff seed is subtracted out.  The seed carries no step
+        observations and no episode counts, so a freshly re-seeded entry
+        degrades exactly to the heartbeat-fallback pricing (rate None →
+        one step = one second, restore/requeue 0) until real observation
+        accumulates, instead of a diluted rate exploding the redo cost."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            entry = self._jobs.get(key)
+            if entry is None:
+                return None
+            totals = self._live_totals(entry, now)
+            observed = {p: v - entry.seeded.get(p, 0.0)
+                        for p, v in totals.items()}
+            steps = entry.steps_in_goodput
+            episodes = dict(entry.episodes)
+        good_s = sum(observed.get(p, 0.0) for p in GOODPUT_PHASES)
+        step_rate = steps / good_s if good_s > 0 and steps > 0 else None
+        # per-ADMISSION restore cost: one admission stint passes through
+        # scheduling AND initializing, so summing both episode counts
+        # would halve the modeled cost for gang-scheduled jobs; the max
+        # of the two approximates the admission count either way (a
+        # non-gang job only ever ticks initializing)
+        init_eps = max(1, episodes.get(PHASE_INITIALIZING, 0),
+                       episodes.get(PHASE_SCHEDULING, 0))
+        restore = (observed.get(PHASE_INITIALIZING, 0.0)
+                   + observed.get(PHASE_SCHEDULING, 0.0)) / init_eps
+        queue_eps = max(1, (episodes.get(PHASE_QUEUED, 0)
+                            + episodes.get(PHASE_PREEMPTED, 0)
+                            + episodes.get(PHASE_MIGRATING, 0)))
+        requeue = (observed.get(PHASE_QUEUED, 0.0)
+                   + observed.get(PHASE_PREEMPTED, 0.0)
+                   + observed.get(PHASE_MIGRATING, 0.0)) / queue_eps
+        at_risk = None
+        if step is not None:
+            at_risk = max(0.0, float(step) - float(checkpoint_step or 0.0))
+        return GoodputView(
+            source="ledger",
+            step=None if step is None else float(step),
+            checkpoint_step=(None if checkpoint_step is None
+                             else float(checkpoint_step)),
+            steps_at_risk=at_risk, step_rate=step_rate,
+            restore_cost_s=restore, requeue_cost_s=requeue)
+
+    # ------------------------------------------------------------------
+    # refresh tick (jobs without heartbeats never arm the telemetry tick)
+    # ------------------------------------------------------------------
+
+    def arm_tick(self, key: str, interval: float,
+                 now: Optional[float] = None) -> bool:
+        """Claim the job's metrics-refresh tick — at most ONE live chain
+        per job, the ProgressTracker.arm_tick contract (the delayed queue
+        does not dedupe, so an unconditional per-sync requeue would leak a
+        timer chain per event)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            entry = self._jobs.get(key)
+            if entry is None:
+                return False
+            if (entry.tick_due_mono is not None
+                    and now < entry.tick_due_mono):
+                return False
+            entry.tick_due_mono = now + interval
+            return True
+
+    # ------------------------------------------------------------------
+    # lifecycle / export
+    # ------------------------------------------------------------------
+
+    def _agg_drop(self, entry: JobGoodput, empty: bool) -> None:
+        """Remove one entry's contribution from the O(1) fleet-rollup
+        aggregates; caller holds self._lock and passes whether the ledger
+        is now empty — an empty ledger resets the sums to exactly zero
+        (float-accumulation drift hygiene)."""
+        self._agg_closed_wall -= sum(entry.totals.values())
+        self._agg_closed_good -= sum(
+            entry.totals.get(p, 0.0) for p in GOODPUT_PHASES)
+        self._agg_start_sum -= entry.phase_start_mono
+        if entry.phase in GOODPUT_PHASES:
+            self._agg_good_n -= 1
+            self._agg_good_start_sum -= entry.phase_start_mono
+        if empty:
+            self._agg_closed_wall = self._agg_closed_good = 0.0
+            self._agg_start_sum = self._agg_good_start_sum = 0.0
+            self._agg_good_n = 0
+
+    def forget(self, key: str) -> Optional[JobGoodput]:
+        """Drop one job's ledger (finished/deleted) and its series."""
+        with self._lock:
+            entry = self._jobs.pop(key, None)
+            empty = not self._jobs
+            if entry is not None:
+                self._agg_drop(entry, empty)
+        if entry is not None:
+            clear_job_series(entry)
+            if empty:
+                metrics.fleet_goodput_ratio.set(0.0)
+        return entry
+
+    def forget_shard(self, shard_label: str) -> List[JobGoodput]:
+        """Drop a handed-off shard's ledgers and series: the new owner
+        re-seeds from durable status, and two members must never both
+        account (or export) one job — the one-exporter invariant."""
+        with self._lock:
+            keys = [k for k, e in self._jobs.items()
+                    if e.shard_label == shard_label]
+            dropped = []
+            for k in keys:
+                entry = self._jobs.pop(k)
+                self._agg_drop(entry, not self._jobs)
+                dropped.append(entry)
+            empty = not self._jobs
+        for entry in dropped:
+            clear_job_series(entry)
+        if dropped and empty:
+            metrics.fleet_goodput_ratio.set(0.0)
+        return dropped
+
+    def export(self, key: str, now: Optional[float] = None) -> None:
+        """Refresh the job's goodput gauge/counter children, plus (rate-
+        limited) the member-local fleet rollup.  Sets run under the ledger
+        lock for the same reason ProgressTracker.export does: ``labels()``
+        re-creates a removed child, so a set racing ``forget``/
+        ``forget_shard`` could resurrect a just-cleared series and break
+        the one-exporter invariant on handoff.
+
+        The counter families carry only precisely-OBSERVED seconds (the
+        crash/handoff seed subtracted): a restart's counter reset then
+        drops toward zero exactly like a process restart, which is the
+        reset shape Prometheus ``rate()`` handles — re-including the
+        seeded pre-history would make the post-restart value a *decrease
+        to a still-large number*, and rate() would book the whole lifetime
+        as fresh increase.  The ratio gauge keeps the full-history
+        attribution (seed included): gauges have no reset semantics."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            entry = self._jobs.get(key)
+            if entry is None:
+                return
+            labels = dict(namespace=entry.namespace, job=entry.name,
+                          shard=entry.shard_label)
+            totals = self._live_totals(entry, now)
+            wall = sum(totals.values())
+            good = sum(totals.get(p, 0.0) for p in GOODPUT_PHASES)
+            if wall > 0:
+                metrics.job_goodput_ratio.labels(**labels).set(
+                    round(good / wall, 6))
+            good_obs = good - sum(entry.seeded.get(p, 0.0)
+                                  for p in GOODPUT_PHASES)
+            metrics.job_goodput_seconds.labels(**labels).set(
+                round(max(0.0, good_obs), 3))
+            for phase in BADPUT_PHASES:
+                v = totals.get(phase, 0.0) - entry.seeded.get(phase, 0.0)
+                if v > 0:
+                    metrics.job_badput_seconds.labels(
+                        phase=phase, **labels).set(round(v, 3))
+            if now - self._fleet_refresh_mono < 0.5:
+                return
+            self._fleet_refresh_mono = now
+            # O(1) via the incremental aggregates — never a walk of every
+            # entry on the per-sync export path
+            n = len(self._jobs)
+            fleet_wall = (self._agg_closed_wall + n * now
+                          - self._agg_start_sum)
+            fleet_good = (self._agg_closed_good + self._agg_good_n * now
+                          - self._agg_good_start_sum)
+            metrics.fleet_goodput_ratio.set(
+                round(fleet_good / fleet_wall, 6) if fleet_wall > 0 else 0.0)
+
+    # ------------------------------------------------------------------
+    # debug surfaces
+    # ------------------------------------------------------------------
+
+    def _row(self, key: str, entry: JobGoodput,
+             now: float) -> Dict[str, Any]:  # caller holds self._lock
+        totals = self._live_totals(entry, now)
+        wall = sum(totals.values())
+        good = sum(totals.get(p, 0.0) for p in GOODPUT_PHASES)
+        # rate over precisely-OBSERVED goodput seconds only (the coarse
+        # crash/handoff seed carries no step observations — see view())
+        good_obs = good - sum(entry.seeded.get(p, 0.0)
+                              for p in GOODPUT_PHASES)
+        return {
+            "job": key,
+            "shard": entry.shard_label,
+            "phase": entry.phase,
+            "wall_s": round(wall, 3),
+            "goodput_s": round(good, 3),
+            "goodput_ratio": round(good / wall, 4) if wall > 0 else None,
+            "badput_s": {p: round(v, 3) for p, v in sorted(totals.items())
+                         if p not in GOODPUT_PHASES and v > 0},
+            "step_rate": (round(entry.steps_in_goodput / good_obs, 4)
+                          if good_obs > 0 and entry.steps_in_goodput > 0
+                          else None),
+        }
+
+    def row(self, key: str,
+            now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """One job's goodput block (the /debug/jobs half) — O(1)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            entry = self._jobs.get(key)
+            if entry is None:
+                return None
+            return self._row(key, entry, now)
+
+    def snapshot(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return [self._row(key, e, now)
+                    for key, e in sorted(self._jobs.items())]
+
+    def fleet(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The /debug/fleet goodput block: this member's rollup plus the
+        badput-breakdown table (top contributors first)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            wall = good = 0.0
+            badput: Dict[str, float] = {}
+            for entry in self._jobs.values():
+                totals = self._live_totals(entry, now)
+                wall += sum(totals.values())
+                for phase, v in totals.items():
+                    if phase in GOODPUT_PHASES:
+                        good += v
+                    else:
+                        badput[phase] = badput.get(phase, 0.0) + v
+            n = len(self._jobs)
+        return {
+            "jobs": n,
+            "wall_s": round(wall, 3),
+            "goodput_s": round(good, 3),
+            "goodput_ratio": round(good / wall, 4) if wall > 0 else None,
+            # top badput contributors first — the fleet breakdown table
+            "badput_s": {p: round(v, 3) for p, v in sorted(
+                badput.items(), key=lambda kv: -kv[1]) if v > 0},
+        }
+
+
+def clear_job_series(entry: JobGoodput) -> None:
+    """Remove the job's children from every goodput metric family."""
+    labels = dict(namespace=entry.namespace, job=entry.name,
+                  shard=entry.shard_label)
+    metrics.job_goodput_ratio.remove(**labels)
+    metrics.job_goodput_seconds.remove(**labels)
+    for phase in BADPUT_PHASES:
+        metrics.job_badput_seconds.remove(phase=phase, **labels)

@@ -159,6 +159,7 @@ class OperatorApp:
                 stall_timeout_s=opt.stall_timeout_s,
                 stall_policy=opt.stall_policy,
                 stall_check_interval_s=opt.stall_check_interval_s,
+                enable_goodput=opt.enable_goodput,
             ),
         )
         if self.coordinator is not None:

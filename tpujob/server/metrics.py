@@ -201,6 +201,18 @@ class _LabeledFamily:
         with self._lock:
             return self._children.pop(key, None) is not None
 
+    def remove_matching(self, predicate) -> int:
+        """Bulk :meth:`remove`: drop every child whose label-value tuple
+        satisfies ``predicate`` (returns how many).  For sweep paths that
+        cannot enumerate the full label sets — e.g. clearing one job's
+        children across families whose extra labels (``phase``) the
+        sweeper does not know."""
+        with self._lock:
+            doomed = [k for k in self._children if predicate(k)]
+            for k in doomed:
+                self._children.pop(k, None)
+        return len(doomed)
+
     def kind(self) -> str:
         return self._kind
 
@@ -226,6 +238,24 @@ class LabeledGauge(_LabeledFamily):
     def __init__(self, name: str, help_text: str, registry: "Registry",
                  labelnames: Tuple[str, ...]):
         super().__init__(name, help_text, registry, labelnames, "gauge")
+
+    def _make_child(self, label_str: str) -> Gauge:
+        return Gauge(self.name, self.help, label_str=label_str)
+
+
+class LabeledSettableCounter(_LabeledFamily):
+    """Counter-TYPED family whose children are driven by absolute ``set``
+    calls rather than ``inc``: the owning ledger accumulates the cumulative
+    value itself, so incremental bookkeeping here would double count on
+    every rebuild.  Exposed as ``# TYPE ... counter`` — the series is
+    monotonic for any one exporter, and the ledger exports only its
+    precisely-observed accumulation (never coarse re-seeded pre-history),
+    so a restart/handoff reset drops toward zero exactly like a process
+    restart — the reset shape Prometheus ``rate()`` handles."""
+
+    def __init__(self, name: str, help_text: str, registry: "Registry",
+                 labelnames: Tuple[str, ...]):
+        super().__init__(name, help_text, registry, labelnames, "counter")
 
     def _make_child(self, label_str: str) -> Gauge:
         return Gauge(self.name, self.help, label_str=label_str)
@@ -508,9 +538,21 @@ history_compactions = Counter(
 # are removed when the job finishes, is deleted, or its shard is handed off.
 _JOB_LABELS = ("namespace", "job", "shard")
 job_steps = LabeledGauge(
-    "tpujob_job_steps_total",
+    "tpujob_job_steps",
     "Latest global training step reported by the job's workload heartbeat "
     "(gauge: a crash restore may regress it to the last checkpoint)",
+    REGISTRY,
+    _JOB_LABELS,
+)
+# DEPRECATED (one release): the original name for the series above — a
+# gauge with a counter's `_total` suffix, the naming wart docs/monitoring
+# documented as the legacy exception.  Both series carry identical values;
+# dashboards should move to `tpujob_job_steps`, and this family is removed
+# next release (see docs/monitoring, "Workload telemetry").
+job_steps_deprecated = LabeledGauge(
+    "tpujob_job_steps_total",
+    "DEPRECATED: renamed to tpujob_job_steps (this is a gauge; the _total "
+    "suffix was a naming mistake).  Removed next release.",
     REGISTRY,
     _JOB_LABELS,
 )
@@ -601,6 +643,46 @@ sched_migrations = Counter(
     "Checkpoint-aware gang migrations staged off dead/cordoned hosts (each "
     "publishes a preempt-target + migrated-from record and runs the bounded "
     "checkpoint barrier before eviction; zero failure strikes)",
+    REGISTRY,
+)
+
+# Goodput accounting plane (the per-job phase ledger, tpujob/obs/goodput):
+# every second of a job's life attributed to one phase, on the controller's
+# monotonic clock.  Same one-exporter-per-job discipline as the other
+# tpujob_job_* families: only the shard owner exports a job, series are
+# removed on finish/delete/handoff, and scraping all members composes the
+# fleet view.  The *_seconds_total families are counter-typed but ledger-
+# driven (LabeledSettableCounter): cumulative precisely-observed seconds
+# within one exporter; a restart/handoff resets them toward zero like a
+# process restart (the coarse condition-timestamp re-seed feeds only the
+# ratio gauge and the debug/scheduler surfaces).
+job_goodput_ratio = LabeledGauge(
+    "tpujob_job_goodput_ratio",
+    "Productive fraction of the job's accounted wall clock: "
+    "(training + checkpointing) seconds / total ledger seconds",
+    REGISTRY,
+    _JOB_LABELS,
+)
+job_goodput_seconds = LabeledSettableCounter(
+    "tpujob_job_goodput_seconds_total",
+    "Productive (training + checkpointing) seconds the job's phase ledger "
+    "has attributed",
+    REGISTRY,
+    _JOB_LABELS,
+)
+job_badput_seconds = LabeledSettableCounter(
+    "tpujob_job_badput_seconds_total",
+    "Unproductive seconds the job's phase ledger has attributed, by phase "
+    "(queued, scheduling, initializing, stalled, resizing, migrating, "
+    "preempted, restarting)",
+    REGISTRY,
+    _JOB_LABELS + ("phase",),
+)
+fleet_goodput_ratio = Gauge(
+    "tpujob_fleet_goodput_ratio",
+    "This member's rollup: productive seconds / total ledger seconds over "
+    "every job it currently accounts (fleet-wide truth is the scrape-merge "
+    "of the per-job *_seconds_total families — see docs/monitoring)",
     REGISTRY,
 )
 

@@ -88,6 +88,12 @@ class ServerOption:
     stall_timeout_s: float = 600.0
     stall_policy: str = "event"  # "event" | "restart"
     stall_check_interval_s: float = 0.0  # <= 0 derives stall_timeout / 4
+    # goodput accounting plane: per-job phase ledger + tpujob_job_goodput_*
+    # / tpujob_job_badput_seconds_total{phase} metrics + the projected-
+    # goodput-loss victim costing the gang scheduler consumes
+    # (--no-goodput disables; victim choice then falls back to raw
+    # steps-past-checkpoint)
+    enable_goodput: bool = True
     # native gang scheduler: modeled fleet capacity as slice pools, e.g.
     # "v4-32x4" or "v4-16x2,v5e-16x1".  Non-empty enables the admission
     # queue: jobs hold NO pods until the scheduler places their whole gang
@@ -270,6 +276,17 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         dest="stall_check_interval_s",
                         help="watchdog re-check cadence in seconds "
                              "(<=0 derives stall-timeout / 4)")
+    parser.add_argument("--goodput", dest="enable_goodput",
+                        action="store_true", default=True,
+                        help="account every second of each job's life to a "
+                             "phase ledger (goodput/badput metrics + the "
+                             "scheduler's projected-loss victim costing; "
+                             "default on)")
+    parser.add_argument("--no-goodput", dest="enable_goodput",
+                        action="store_false",
+                        help="disable the goodput accounting plane (victim "
+                             "choice falls back to raw steps-past-"
+                             "checkpoint)")
     parser.add_argument("--sched-capacity", default="",
                         dest="scheduler_capacity",
                         help="enable the native gang scheduler with this "

@@ -25,8 +25,11 @@ Contract:
   anti-starvation bound), then per-namespace dominant-share (chips of the
   modeled fleet), then FIFO.
 - **Checkpoint-aware preemption.**  Under pressure a higher-tier gang
-  preempts lower-tier victims chosen by lowest goodput cost (steps past
-  their last checkpoint, from the PR-10 progress tracker).  Eviction is the
+  preempts lower-tier victims chosen by lowest PROJECTED GOODPUT LOST
+  (redo-the-at-risk-steps at the victim's own observed step rate plus its
+  observed restore + requeue costs, from the goodput phase ledger; jobs
+  with no ledger keep the legacy raw steps-past-checkpoint ordering via
+  the heartbeat fallback).  Eviction is the
   PR-9 drain protocol re-aimed: publish ``tpujob.dev/preempt-target``, wait
   the bounded checkpoint barrier (workload ack / telemetry checkpoint
   catch-up / grace), then mark ``tpujob.dev/sched-evicted`` — the
@@ -42,7 +45,6 @@ Contract:
 """
 from __future__ import annotations
 
-import calendar
 import collections
 import functools
 import json
@@ -81,6 +83,7 @@ from tpujob.kube.client import RESOURCE_NODES, RESOURCE_TPUJOBS
 from tpujob.kube.control import gen_labels
 from tpujob.kube.errors import AlreadyExistsError, ApiError, NotFoundError
 from tpujob.kube.informers import INDEX_JOB_NAME
+from tpujob.obs.goodput import GoodputView, heartbeat_view
 from tpujob.server import metrics
 from tpujob.server.inventory import Inventory, NodeHealth, build_inventory
 
@@ -92,13 +95,7 @@ log = logging.getLogger("tpujob.scheduler")
 SCHEDULER_SHARD = 0
 
 
-def _parse_wall(ts: Optional[str]) -> Optional[float]:
-    if not ts:
-        return None
-    try:
-        return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
-    except ValueError:
-        return None
+_parse_wall = st.parse_iso  # THE status-timestamp parser, one grammar
 
 
 # ---------------------------------------------------------------------------
@@ -1138,18 +1135,14 @@ class GangScheduler:
 
     # -- preemption ----------------------------------------------------------
 
-    def _progress_of(self, key: str) -> Optional[Tuple[float, Optional[float]]]:
-        """The job's newest (step, checkpoint_step), from the local PR-10
-        tracker when this member syncs the job — or, in a sharded fleet
-        where the shard-0 owner's tracker only holds its OWN shards' rows,
-        straight from the heartbeat annotations in the shared pod informer
-        cache (every member watches every pod).  None = no telemetry."""
-        telemetry = getattr(self.controller, "telemetry", None)
-        row = telemetry.row(key) if telemetry is not None else None
-        if row is not None:
-            return (float(row["step"]),
-                    None if row["checkpoint_step"] is None
-                    else float(row["checkpoint_step"]))
+    def _progress_from_pods(self, key: str
+                            ) -> Optional[Tuple[float, Optional[float]]]:
+        """THE heartbeat-annotation fallback parser — the single place the
+        scheduler ever hand-reads ``tpujob.dev/progress``: in a sharded
+        fleet the shard-0 owner's ProgressTracker only holds its OWN
+        shards' rows, but every member watches every pod, so the shared
+        pod informer cache answers for the rest.  Returns the newest
+        (step, checkpoint_step); None = no telemetry."""
         from tpujob.api.progress import parse_progress
 
         ns, _, name = key.partition("/")
@@ -1175,21 +1168,54 @@ class GangScheduler:
                 None if prog.checkpoint_step is None
                 else float(prog.checkpoint_step))
 
-    def _at_risk(self, key: str) -> float:
-        """Goodput cost of preempting ``key``: steps its workload would
-        lose past the last checkpoint; unknown = infinite, so victims that
-        publish telemetry — and are provably cheap to evict — go first."""
-        prog = self._progress_of(key)
-        if prog is None:
+    def goodput_view(self, key: str) -> Optional[GoodputView]:
+        """The job's goodput cost view: telemetry (tracker row, else the
+        one annotation-parse fallback) + the controller's phase ledger.
+        A ledger-backed view prices a preemption as PROJECTED GOODPUT LOST
+        — redo the at-risk steps at the job's own observed step rate, plus
+        its observed restore and requeue costs; a ledger-less job keeps
+        the legacy heartbeat view (raw steps-past-checkpoint ordering).
+        None = no ledger AND no telemetry at all.
+
+        Known asymmetry: in a sharded fleet this member's ledger only
+        holds the jobs it owns, so other members' jobs are priced by the
+        fallback with no restore/requeue history — slightly cheap
+        relative to local jobs (the one-step-one-second prior keeps the
+        units comparable; tier still dominates the victim sort).  See
+        docs/failure-handling, "Gang admission & preemption"."""
+        telemetry = getattr(self.controller, "telemetry", None)
+        row = telemetry.row(key) if telemetry is not None else None
+        if row is not None:
+            step = float(row["step"])
+            ckpt = (None if row["checkpoint_step"] is None
+                    else float(row["checkpoint_step"]))
+        else:
+            prog = self._progress_from_pods(key)
+            step, ckpt = (None, None) if prog is None else prog
+        ledger = getattr(self.controller, "goodput", None)
+        if ledger is not None:
+            view = ledger.view(key, step=step, checkpoint_step=ckpt)
+            if view is not None:
+                return view
+        if step is None:
+            return None
+        return heartbeat_view(step, ckpt)
+
+    def _victim_cost(self, key: str) -> float:
+        """Goodput cost of preempting ``key``: the view's projected loss
+        in seconds (unknown telemetry = infinite, so victims that publish
+        progress — and are provably cheap to evict — go first)."""
+        view = self.goodput_view(key)
+        if view is None:
             return float("inf")
-        return max(0.0, prog[0] - (prog[1] or 0.0))
+        return view.projected_loss_s
 
     def _plan_preemption(self, req: GangRequest, eff_tier: int,
                          admitted: List[_Admitted],
                          cap: CapacityModel) -> List[_Admitted]:
         """Choose the cheapest victim set that makes ``req`` placeable:
-        strictly-lower-tier gangs only, lowest (tier, goodput-at-risk)
-        first.  In-flight evictions/preemptions count as already freeing —
+        strictly-lower-tier gangs only, lowest (tier, projected goodput
+        loss) first.  In-flight evictions/preemptions count as already freeing —
         a tick must not pick NEW victims for capacity that is already being
         vacated.  Returns [] when no workable set exists (or none is
         needed beyond what is already vacating)."""
@@ -1202,7 +1228,7 @@ class GangScheduler:
         candidates = sorted(
             (a for a in admitted
              if not a.evicting and not a.preempting and a.tier < eff_tier),
-            key=lambda a: (a.tier, self._at_risk(a.key), a.key))
+            key=lambda a: (a.tier, self._victim_cost(a.key), a.key))
         chosen: List[_Admitted] = []
         for victim in candidates:
             sim.release(victim.key)
@@ -1261,8 +1287,10 @@ class GangScheduler:
             return False
         if ann.get(c.ANNOTATION_PREEMPT_ACK) is not None:
             return True
-        prog = self._progress_of(key)
-        if prog is not None and prog[1] is not None and prog[1] >= prog[0]:
+        view = self.goodput_view(key)
+        if (view is not None and view.step is not None
+                and view.checkpoint_step is not None
+                and view.checkpoint_step >= view.step):
             return True  # checkpoint caught up to the step: nothing to lose
         # per-incarnation monotonic anchor, with a wall floor on the
         # published timestamp so a drain already pending across a crash
