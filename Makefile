@@ -29,9 +29,12 @@ codegen-verify:
 native:
 	$(MAKE) -C native
 
-# tpulint: the AST rule engine in tpujob/analysis (syntax/imports/whitespace
-# plus the concurrency & transport invariants TPL001-TPL005; see
-# docs/analysis/README.md for the catalog and waiver/baseline workflow)
+# tpulint: the AST rule engine in tpujob/analysis (syntax/imports/whitespace,
+# the concurrency & transport invariants TPL001-TPL005, and the wire-registry
+# protocol conformance family TPL200-TPL203: annotation protocol, metric/docs
+# parity, condition lifecycle, expectation bookkeeping; see
+# docs/analysis/README.md for the catalog and waiver/baseline workflow;
+# `scripts/lint.py --registry-dump` prints the extracted wire registry)
 lint:
 	$(PY) scripts/lint.py
 

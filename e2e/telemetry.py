@@ -162,12 +162,12 @@ def run_telemetry_smoke(seed: int = 13, timeout: float = 30.0) -> Dict[str, Any]
                        and app.controller.telemetry.get(key).progress.step > 0),
               "heartbeats to reach the controller")
         text = _fetch(mon.port, "/metrics")
-        for family in ("tpujob_job_steps_total", "tpujob_job_samples_per_second",
+        for family in ("tpujob_job_steps", "tpujob_job_samples_per_second",
                        "tpujob_job_checkpoint_age_seconds",
                        "tpujob_job_heartbeat_age_seconds", "tpujob_job_stalled"):
             assert f"# HELP {family} " in text, f"/metrics missing HELP {family}"
             assert f"# TYPE {family} gauge" in text, f"/metrics missing TYPE {family}"
-        assert (f'tpujob_job_steps_total{{namespace="default",job="{name}",'
+        assert (f'tpujob_job_steps{{namespace="default",job="{name}",'
                 f'shard="-"}}') in text, "job steps series not exported"
 
         fleet = _fetch(mon.port, "/debug/fleet")

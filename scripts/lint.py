@@ -3,9 +3,12 @@
 
 The checks themselves live in ``tpujob/analysis`` (``engine.py`` +
 ``rules/*.py``): syntax (TPL000), unused imports (TPL100), whitespace
-(TPL101), and the repo-specific concurrency/transport invariants
-TPL001-TPL005.  See ``docs/analysis/README.md`` for the rule catalog and
-the waiver/baseline workflow.
+(TPL101), the repo-specific concurrency/transport invariants
+TPL001-TPL005, and the interprocedural protocol-conformance family
+TPL200-TPL203 (annotation wire protocol, metric/docs parity, condition
+lifecycle, expectation bookkeeping) built on the shared wire registry
+(``tpujob/analysis/registry.py``).  See ``docs/analysis/README.md`` for
+the rule catalog and the waiver/baseline workflow.
 
 Usage (all flags forwarded to the engine):
 
@@ -13,6 +16,7 @@ Usage (all flags forwarded to the engine):
     python scripts/lint.py --write-baseline  # make lint-baseline
     python scripts/lint.py --list-rules
     python scripts/lint.py --select TPL002,TPL003
+    python scripts/lint.py --registry-dump   # the wire registry as JSON
 """
 import sys
 from pathlib import Path

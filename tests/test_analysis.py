@@ -2,10 +2,13 @@
 must-flag / must-not-flag / noqa-suppressed cases for every rule, plus the
 seeded-regression checks the acceptance criteria name (thread published
 before start, a verb missing from one transport layer, a guarded attribute
-read without its lock) and the shipped-tree-is-clean gate."""
+read without its lock, a deleted ack consumer, an undocumented metric, a
+condition missing from the terminal flip tuple, raw pod churn in the
+reconciler) and the shipped-tree-is-clean gate."""
 import json
 import shutil
 import textwrap
+import time
 from pathlib import Path
 
 from tpujob.analysis.engine import (
@@ -49,7 +52,8 @@ def _run(rule, tmp_path, source, rel="tpujob/x.py"):
 def test_rule_catalog_loads_every_repo_rule():
     ids = {r.id for r in load_rules()}
     assert {"TPL001", "TPL002", "TPL003", "TPL004", "TPL005",
-            "TPL100", "TPL101"} <= ids
+            "TPL100", "TPL101",
+            "TPL200", "TPL201", "TPL202", "TPL203"} <= ids
 
 
 def test_syntax_error_reports_tpl000(tmp_path):
@@ -596,3 +600,512 @@ def test_tpl005_waiver_noqa(tmp_path):
             pass
     """
     assert _run(SwallowedExceptionRule(), tmp_path, src) == []
+
+# ---------------------------------------------------------------------------
+# the wire registry (shared extraction pass for TPL200-TPL203)
+# ---------------------------------------------------------------------------
+
+
+def _tree(tmp_path: Path, sources):
+    """Build a Project from {repo-relative path: source} fixture snippets."""
+    files = []
+    for rel, src in sources.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+        files.append(path)
+    return Project(tmp_path, files)
+
+
+def _select(project, rule_id):
+    return run_rules(project, load_rules(), select=[rule_id])
+
+
+def test_registry_is_memoized_per_project():
+    from tpujob.analysis.registry import wire_registry
+
+    project = Project(REPO_ROOT)
+    assert wire_registry(project) is wire_registry(project)
+
+
+def test_registry_dump_flag(capsys):
+    from tpujob.analysis import engine
+
+    assert engine.main(["--registry-dump", "--root", str(REPO_ROOT)]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    ack = doc["annotations"]["tpujob.dev/preempt-ack"]
+    assert ack["reads"] and ack["null_writes"]
+    assert "tpujob_job_steps" in doc["metrics"]
+    assert "tpujob_job_steps_total" not in doc["metrics"]  # twin removed
+    assert "JOB_RESTARTING" in doc["conditions"]["terminal_flip"]
+    assert doc["pod_calls"]  # the reconciler's PodControl sites
+
+
+def test_lint_wall_time_budget():
+    """The project-wide registry pass must not turn lint into
+    O(rules x files): one full engine run over the real tree, all rules,
+    stays well inside the budget (the shipped tree runs in ~2s; the bound
+    is generous for slow CI hosts)."""
+    start = time.monotonic()
+    project = Project(REPO_ROOT)
+    run_rules(project)
+    assert time.monotonic() - start < 30.0
+
+
+# ---------------------------------------------------------------------------
+# TPL200 annotation-protocol conformance
+# ---------------------------------------------------------------------------
+
+_WIRE_CONSTANTS = """
+GROUP_NAME = "tpujob.dev"
+ANNOTATION_TARGET_WORLD_SIZE = f"{GROUP_NAME}/target-world-size"
+ANNOTATION_CHECKPOINT_ACK = f"{GROUP_NAME}/checkpoint-ack"
+"""
+
+_WIRE_OK_USER = """
+from tpujob.api import constants as c
+
+def publish_target(job, world):
+    job.patch({c.ANNOTATION_TARGET_WORLD_SIZE: str(world),
+               c.ANNOTATION_CHECKPOINT_ACK: None})
+
+def ack(job, world):
+    job.patch({c.ANNOTATION_CHECKPOINT_ACK: str(world)})
+
+def read(ann):
+    return (ann.get(c.ANNOTATION_TARGET_WORLD_SIZE),
+            ann.get(c.ANNOTATION_CHECKPOINT_ACK))
+"""
+
+
+def test_tpl200_paired_keys_pass(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/api/constants.py": _WIRE_CONSTANTS,
+        "tpujob/server/x.py": _WIRE_OK_USER,
+    })
+    assert _select(project, "TPL200") == []
+
+
+def test_tpl200_flags_key_with_no_consumer(tmp_path):
+    no_reads = _WIRE_OK_USER[:_WIRE_OK_USER.index("def read")]
+    project = _tree(tmp_path, {
+        "tpujob/api/constants.py": _WIRE_CONSTANTS,
+        "tpujob/server/x.py": no_reads,
+    })
+    findings = _select(project, "TPL200")
+    assert any("tpujob.dev/target-world-size" in f.message
+               and "no consumer" in f.message for f in findings)
+
+
+def test_tpl200_flags_key_with_no_publisher(tmp_path):
+    reads_only = _WIRE_OK_USER[_WIRE_OK_USER.index("def read"):]
+    project = _tree(tmp_path, {
+        "tpujob/api/constants.py": _WIRE_CONSTANTS,
+        "tpujob/server/x.py": "from tpujob.api import constants as c\n"
+                              + reads_only,
+    })
+    findings = _select(project, "TPL200")
+    assert any("tpujob.dev/target-world-size" in f.message
+               and "no publisher" in f.message for f in findings)
+
+
+def test_tpl200_flags_raw_wire_literal_but_not_prose(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/api/constants.py": _WIRE_CONSTANTS,
+        "tpujob/server/x.py": _WIRE_OK_USER + """
+KEY = "tpujob.dev/world-size"          # exact wire key: flagged
+
+def documented():
+    '''Reads the tpujob.dev/progress annotation.'''  # docstring: prose
+    return "set the tpujob.dev/preempt-target annotation first"
+""",
+    })
+    findings = _select(project, "TPL200")
+    assert len(findings) == 1
+    assert "raw wire-key literal" in findings[0].message
+    assert "tpujob.dev/world-size" in findings[0].message
+
+
+def test_tpl200_noqa_suppresses_raw_literal(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/api/constants.py": _WIRE_CONSTANTS,
+        "tpujob/server/x.py": _WIRE_OK_USER
+        + 'KEY = "tpujob.dev/world-size"  # noqa: TPL200\n',
+    })
+    assert _select(project, "TPL200") == []
+
+
+def test_tpl200_publish_without_ack_null_flags(tmp_path):
+    src = _WIRE_OK_USER.replace(
+        "job.patch({c.ANNOTATION_TARGET_WORLD_SIZE: str(world),\n"
+        "               c.ANNOTATION_CHECKPOINT_ACK: None})",
+        "job.patch({c.ANNOTATION_TARGET_WORLD_SIZE: str(world)})")
+    assert "ANNOTATION_CHECKPOINT_ACK: None" not in src
+    project = _tree(tmp_path, {
+        "tpujob/api/constants.py": _WIRE_CONSTANTS,
+        "tpujob/server/x.py": src,
+    })
+    findings = _select(project, "TPL200")
+    assert any("without nulling ANNOTATION_CHECKPOINT_ACK" in f.message
+               for f in findings)
+
+
+def test_tpl200_nulling_the_target_is_not_a_publish(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/api/constants.py": _WIRE_CONSTANTS,
+        "tpujob/server/x.py": _WIRE_OK_USER + """
+def cleanup(job):
+    job.patch({c.ANNOTATION_TARGET_WORLD_SIZE: None})
+""",
+    })
+    assert _select(project, "TPL200") == []
+
+
+def test_tpl200_skips_trees_without_the_constants_module(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/server/x.py": 'KEY = "tpujob.dev/world-size"\n'})
+    assert _select(project, "TPL200") == []
+
+
+# TPL200 seeded regression on a copy of the real annotation file set
+
+_TPL200_FILES = (
+    "tpujob/api/constants.py",
+    "tpujob/api/progress.py",
+    "tpujob/api/nodes.py",
+    "tpujob/controller/reconciler.py",
+    "tpujob/server/inventory.py",
+    "tpujob/server/scheduler.py",
+    "tpujob/workloads/distributed.py",
+    "e2e/chaos.py",
+    "e2e/elastic.py",
+    "e2e/nodes.py",
+    "e2e/scheduler.py",
+    "bench_controller.py",
+)
+
+
+def _copy_files(tmp_path: Path, rels) -> Path:
+    root = tmp_path / "tree"
+    for rel in rels:
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO_ROOT / rel, dst)
+    return root
+
+
+def test_tpl200_shipped_annotation_set_is_clean(tmp_path):
+    root = _copy_files(tmp_path, _TPL200_FILES)
+    project = Project(root, [root / rel for rel in _TPL200_FILES])
+    assert _select(project, "TPL200") == []
+
+
+def test_tpl200_deleting_the_preempt_ack_consumers_fails_lint(tmp_path):
+    """The seeded regression the acceptance criteria name: remove every
+    reader of ANNOTATION_PREEMPT_ACK (the scheduler's barrier check and
+    the e2e workload's idempotence guard) and the key must flag as
+    published into the void."""
+    root = _copy_files(tmp_path, _TPL200_FILES)
+    sched = root / "tpujob/server/scheduler.py"
+    src = sched.read_text()
+    assert "ann.get(c.ANNOTATION_PREEMPT_ACK) is not None" in src
+    sched.write_text(src.replace(
+        "ann.get(c.ANNOTATION_PREEMPT_ACK) is not None", "False"))
+    e2e_sched = root / "e2e/scheduler.py"
+    src = e2e_sched.read_text()
+    assert "annotations.get(c.ANNOTATION_PREEMPT_ACK) is not None" in src
+    e2e_sched.write_text(src.replace(
+        "annotations.get(c.ANNOTATION_PREEMPT_ACK) is not None", "False"))
+    project = Project(root, [root / rel for rel in _TPL200_FILES])
+    findings = _select(project, "TPL200")
+    assert any("tpujob.dev/preempt-ack" in f.message
+               and "no consumer" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# TPL201 metric/docs parity (seeded regressions on the real metric set)
+# ---------------------------------------------------------------------------
+
+_TPL201_FILES = (
+    "tpujob/server/metrics.py",
+    "tpujob/controller/progress.py",
+    "tpujob/obs/goodput.py",
+)
+
+
+def _metrics_tree(tmp_path: Path) -> Path:
+    root = _copy_files(tmp_path, _TPL201_FILES)
+    docs = root / "docs/monitoring/README.md"
+    docs.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copy(REPO_ROOT / "docs/monitoring/README.md", docs)
+    return root
+
+
+def _tpl201(root: Path):
+    files = [root / rel for rel in _TPL201_FILES]
+    project = Project(root, files)
+    return run_rules(project, load_rules(), select=["TPL201"])
+
+
+def test_tpl201_shipped_metric_set_is_clean(tmp_path):
+    assert _tpl201(_metrics_tree(tmp_path)) == []
+
+
+def test_tpl201_undocumented_family_fails_lint(tmp_path):
+    root = _metrics_tree(tmp_path)
+    docs = root / "docs/monitoring/README.md"
+    lines = [l for l in docs.read_text().splitlines()
+             if not l.startswith("| `tpujob_job_stalled`")]
+    docs.write_text("\n".join(lines) + "\n")
+    findings = _tpl201(root)
+    assert any("tpujob_job_stalled" in f.message
+               and "no table row" in f.message for f in findings)
+
+
+def test_tpl201_documented_ghost_family_fails_lint(tmp_path):
+    root = _metrics_tree(tmp_path)
+    docs = root / "docs/monitoring/README.md"
+    docs.write_text(docs.read_text()
+                    + "\n| `tpujob_ghost_total` | counter | — | ghost |\n")
+    findings = _tpl201(root)
+    assert any("tpujob_ghost_total" in f.message
+               and "not registered" in f.message for f in findings)
+
+
+def test_tpl201_per_job_family_without_remove_site_fails_lint(tmp_path):
+    root = _metrics_tree(tmp_path)
+    metrics_py = root / "tpujob/server/metrics.py"
+    metrics_py.write_text(metrics_py.read_text() + """
+job_orphan = LabeledGauge(
+    "tpujob_job_orphan",
+    "seeded regression: per-job family with no remove site",
+    REGISTRY,
+    _JOB_LABELS,
+)
+""")
+    findings = _tpl201(root)
+    assert any("tpujob_job_orphan" in f.message
+               and "no reachable remove" in f.message for f in findings)
+
+
+def test_tpl201_total_suffix_on_a_gauge_fails_lint(tmp_path):
+    """The tpujob_job_steps_total wart can never come back silently."""
+    root = _metrics_tree(tmp_path)
+    metrics_py = root / "tpujob/server/metrics.py"
+    metrics_py.write_text(metrics_py.read_text() + """
+regressed = Gauge(
+    "tpujob_operator_regressed_total",
+    "seeded regression: a gauge wearing a counter's suffix",
+    REGISTRY,
+)
+""")
+    findings = _tpl201(root)
+    assert any("tpujob_operator_regressed_total" in f.message
+               and "_total suffix" in f.message for f in findings)
+
+
+def test_tpl201_counter_without_total_suffix_fails_lint(tmp_path):
+    root = _metrics_tree(tmp_path)
+    metrics_py = root / "tpujob/server/metrics.py"
+    metrics_py.write_text(metrics_py.read_text() + """
+sneaky = Counter(
+    "tpujob_operator_sneaky_count",
+    "seeded regression: a counter hiding from the naming convention",
+    REGISTRY,
+)
+""")
+    findings = _tpl201(root)
+    assert any("tpujob_operator_sneaky_count" in f.message
+               and "lacks the _total suffix" in f.message for f in findings)
+
+
+def test_tpl201_skips_trees_without_the_metrics_module(tmp_path):
+    project = _tree(tmp_path, {"tpujob/server/x.py": "x = 1\n"})
+    assert _select(project, "TPL201") == []
+
+
+# ---------------------------------------------------------------------------
+# TPL202 condition lifecycle
+# ---------------------------------------------------------------------------
+
+_STATUS_FIXTURE = """
+from tpujob.api import constants as c
+
+def set_condition(status, condition):
+    conditions = list(status.conditions)
+    if condition.status == "True":
+        if condition.type in (c.JOB_SUCCEEDED, c.JOB_FAILED):
+            for cond in conditions:
+                if cond.type in (c.JOB_RUNNING, c.JOB_STALLED) \\
+                        and cond.status == "True":
+                    cond.status = "False"
+    conditions.append(condition)
+    status.conditions = conditions
+
+def update_job_conditions(status, cond_type, reason, message):
+    set_condition(status, None)
+"""
+
+
+def test_tpl202_condition_in_flip_tuple_passes(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/controller/status.py": _STATUS_FIXTURE,
+        "tpujob/controller/r.py": """
+from tpujob.api import constants as c
+from tpujob.controller import status as st
+
+def f(job):
+    st.update_job_conditions(job.status, c.JOB_RUNNING, "r", "m")
+    st.update_job_conditions(job.status, c.JOB_FAILED, "r", "m")
+""",
+    })
+    assert _select(project, "TPL202") == []
+
+
+def test_tpl202_condition_missing_from_flip_tuple_flags(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/controller/status.py": _STATUS_FIXTURE,
+        "tpujob/controller/r.py": """
+from tpujob.api import constants as c
+from tpujob.controller import status as st
+
+def f(job):
+    st.update_job_conditions(job.status, c.JOB_QUEUED, "r", "m")
+""",
+    })
+    findings = _select(project, "TPL202")
+    assert len(findings) == 1
+    assert "JOB_QUEUED" in findings[0].message
+    assert "terminal flip-False tuple" in findings[0].message
+
+
+def test_tpl202_noqa_waiver_suppresses(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/controller/status.py": _STATUS_FIXTURE,
+        "tpujob/controller/r.py": """
+from tpujob.api import constants as c
+from tpujob.controller import status as st
+
+def f(job):
+    # durable history marker, outlives completion by design
+    st.update_job_conditions(  # noqa: TPL202
+        job.status, c.JOB_QUEUED, "r", "m")
+""",
+    })
+    assert _select(project, "TPL202") == []
+
+
+def test_tpl202_skips_trees_without_the_status_machine(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/controller/r.py": """
+from tpujob.api import constants as c
+from tpujob.controller import status as st
+
+def f(job):
+    st.update_job_conditions(job.status, c.JOB_QUEUED, "r", "m")
+""",
+    })
+    assert _select(project, "TPL202") == []
+
+
+def test_tpl202_dropping_restarting_from_flip_tuple_fails_lint(tmp_path):
+    """The seeded regression: remove JOB_RESTARTING from the real terminal
+    flip tuple and every Restarting set-site must flag."""
+    rels = ("tpujob/controller/status.py", "tpujob/controller/reconciler.py")
+    root = _copy_files(tmp_path, rels)
+    project = Project(root, [root / rel for rel in rels])
+    assert _select(project, "TPL202") == []  # shipped pair is clean
+
+    status_py = root / "tpujob/controller/status.py"
+    src = status_py.read_text()
+    assert "c.JOB_RESTARTING,\n" in src
+    status_py.write_text(src.replace("c.JOB_RESTARTING,\n", "", 1))
+    project = Project(root, [root / rel for rel in rels])
+    findings = _select(project, "TPL202")
+    assert any("JOB_RESTARTING" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# TPL203 expectation bookkeeping
+# ---------------------------------------------------------------------------
+
+
+def test_tpl203_pod_control_ladder_passes(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/controller/x.py": """
+class R:
+    def shrink(self, job, pod):
+        self.pod_control.delete_pod(pod.ns, pod.name, job)
+
+    def grow(self, job, specs):
+        created, err = self.pod_control.create_pods(job, specs)
+        return created, err
+""",
+    })
+    assert _select(project, "TPL203") == []
+
+
+def test_tpl203_raw_transport_delete_flags(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/controller/x.py": """
+class R:
+    def shrink(self, job, pod):
+        self.clients.pods.delete_pod(pod.ns, pod.name)
+""",
+    })
+    findings = _select(project, "TPL203")
+    assert len(findings) == 1
+    assert "bypasses the PodControl expectation ladder" in findings[0].message
+
+
+def test_tpl203_generic_pods_resource_call_flags(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/controller/x.py": """
+class R:
+    def grow(self, body):
+        self.clients.server.create("pods", body)
+""",
+    })
+    findings = _select(project, "TPL203")
+    assert len(findings) == 1
+
+
+def test_tpl203_outside_controller_package_is_out_of_scope(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/kube/control.py": """
+class PodControl:
+    def delete_pod(self, ns, name, job):
+        self.transport.delete_pod(ns, name)
+""",
+    })
+    assert _select(project, "TPL203") == []
+
+
+def test_tpl203_noqa_suppresses(tmp_path):
+    project = _tree(tmp_path, {
+        "tpujob/controller/x.py": """
+class R:
+    def shrink(self, pod):
+        self.clients.pods.delete_pod(pod.ns, pod.name)  # noqa: TPL203
+""",
+    })
+    assert _select(project, "TPL203") == []
+
+
+def test_tpl203_raw_delete_in_reconciler_fails_lint(tmp_path):
+    """The seeded regression: reroute one reconciler delete around the
+    PodControl ladder and lint must fail."""
+    rels = ("tpujob/controller/reconciler.py",)
+    root = _copy_files(tmp_path, rels)
+    project = Project(root, [root / rels[0]])
+    assert _select(project, "TPL203") == []  # shipped reconciler is clean
+
+    rec = root / rels[0]
+    src = rec.read_text()
+    assert "self.pod_control.delete_pod(" in src
+    rec.write_text(src.replace("self.pod_control.delete_pod(",
+                               "self.kube.delete_pod(", 1))
+    project = Project(root, [root / rels[0]])
+    findings = _select(project, "TPL203")
+    assert len(findings) == 1
+    assert "self.kube.delete_pod" in findings[0].message

@@ -111,7 +111,7 @@ def test_every_labeled_family_exposes_help_and_type():
     fams = _labeled_families()
     assert fams, "no labeled families registered"
     names = {f.name for f in fams}
-    for want in ("tpujob_job_steps", "tpujob_job_steps_total",
+    for want in ("tpujob_job_steps",
                  "tpujob_job_samples_per_second",
                  "tpujob_job_checkpoint_age_seconds",
                  "tpujob_job_heartbeat_age_seconds", "tpujob_job_stalled",
@@ -130,13 +130,12 @@ def test_label_value_escaping_in_every_job_family():
     labels = dict(namespace="default", job=hostile, shard="-")
     escaped = 'job="we\\"ird\\njob\\\\x"'
     try:
-        for fam in (metrics.job_steps, metrics.job_steps_deprecated,
-                    metrics.job_samples_per_second,
+        for fam in (metrics.job_steps, metrics.job_samples_per_second,
                     metrics.job_checkpoint_age, metrics.job_heartbeat_age,
                     metrics.job_stalled):
             fam.labels(**labels).set(1.0)
         text = REGISTRY.expose()
-        for fam_name in ("tpujob_job_steps_total", "tpujob_job_stalled"):
+        for fam_name in ("tpujob_job_steps", "tpujob_job_stalled"):
             assert any(fam_name in line and escaped in line
                        for line in text.splitlines()), fam_name
         assert hostile not in text  # never raw
@@ -147,23 +146,22 @@ def test_label_value_escaping_in_every_job_family():
     assert escaped not in REGISTRY.expose()
 
 
-def test_steps_gauge_rename_emits_both_series():
-    """Satellite: the correctly-named ``tpujob_job_steps`` gauge emits next
-    to the deprecated ``tpujob_job_steps_total`` twin (kept one release),
-    with identical values, and removal drops both."""
+def test_steps_gauge_canonical_only():
+    """The deprecated ``tpujob_job_steps_total`` twin completed its
+    one-release deprecation: only the canonical ``tpujob_job_steps`` gauge
+    emits, and removal drops it."""
     h = _harness()
     _publish(h, 42, ckpt=40)
     h.sync()
     labels = dict(namespace="default", job=JOB, shard="-")
     assert metrics.job_steps.labels(**labels).value == 42
-    assert metrics.job_steps_deprecated.labels(**labels).value == 42
     text = REGISTRY.expose()
     assert "# TYPE tpujob_job_steps gauge" in text
-    assert "# TYPE tpujob_job_steps_total gauge" in text  # still a gauge
-    assert "# HELP tpujob_job_steps_total DEPRECATED" in text
+    assert "tpujob_job_steps_total" not in text  # twin is gone for good
+    assert not hasattr(metrics, "job_steps_deprecated")
     h.controller.telemetry.forget(KEY)
     for line in REGISTRY.expose().splitlines():
-        if line.startswith(("tpujob_job_steps{", "tpujob_job_steps_total{")):
+        if line.startswith("tpujob_job_steps{"):
             assert f'job="{JOB}"' not in line, line
 
 

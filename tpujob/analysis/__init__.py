@@ -7,8 +7,12 @@ now mechanically enforced):
   the dependency-free AST rule engine behind ``make lint``: thread-publish
   ordering (TPL001), transport-stack verb completeness (TPL002), guarded-by
   lock discipline (TPL003), monotonic-clock duration math (TPL004),
-  swallowed exceptions (TPL005), plus the legacy syntax/import/whitespace
-  checks (TPL000/TPL100/TPL101).
+  swallowed exceptions (TPL005), the legacy syntax/import/whitespace
+  checks (TPL000/TPL100/TPL101), and the interprocedural protocol
+  conformance family (TPL200 annotation wire protocol, TPL201 metric/docs
+  parity, TPL202 condition lifecycle, TPL203 expectation bookkeeping)
+  built on :mod:`tpujob.analysis.registry`, the once-per-run project-wide
+  wire-registry extraction.
 - :mod:`tpujob.analysis.lockgraph` — an opt-in runtime lock-order sentinel:
   instrumented locks record per-thread acquisition edges into a global
   graph; cycles (potential deadlocks) and long holds surface in the chaos

@@ -311,6 +311,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ignore the committed baseline (report everything)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--registry-dump", action="store_true",
+                   help="print the extracted wire registry (annotations, "
+                        "metric families, conditions, pod call sites) as "
+                        "JSON and exit")
     return p
 
 
@@ -334,6 +338,11 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
     project = Project(root)
+    if args.registry_dump:
+        from tpujob.analysis.registry import wire_registry
+
+        print(json.dumps(wire_registry(project).to_json(), indent=2))
+        return 0
     findings = run_rules(project, rules, select)
     baseline_path = root / BASELINE_NAME
 

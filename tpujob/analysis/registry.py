@@ -1,0 +1,432 @@
+"""The wire registry: one project-wide extraction pass for the TPL2xx rules.
+
+The operator's cross-module *protocol* invariants (consume-at-publish on
+ack annotations, metric/docs parity, terminal condition flips, expectation
+bookkeeping around pod churn) all need the same project-wide facts:
+
+- every ``ANNOTATION_*`` / ``GROUP_NAME``-derived wire key defined in the
+  API modules, plus every site that publishes, nulls, or reads it;
+- every metric family registered in ``tpujob/server/metrics.py`` with its
+  exposition type and label names;
+- every ``JOB_*`` condition constant set True anywhere, and the terminal
+  flip-False tuple inside ``status.set_condition``;
+- every pod create/delete call site in ``tpujob/controller/``.
+
+This module extracts them ONCE per :class:`~tpujob.analysis.engine.Project`
+(memoized on the project instance) so four rule families share a single
+walk instead of re-deriving the world per rule — `make lint` wall time
+stays flat as the TPL2xx catalog grows.  The registry is also a debugging
+surface: ``python scripts/lint.py --registry-dump`` prints it as JSON.
+
+Scope note: ``tests/`` is OUTSIDE the wire-reference scope.  Test fixtures
+legitimately spell raw downward-API text and set conditions into contrived
+states; the protocol's real publishers and consumers live in the shipped
+tree plus the e2e harnesses and benches (the workload half of several
+channels is exercised only there).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from tpujob.analysis.engine import FileContext, Project, dotted_name
+
+# the modules that DEFINE wire keys (and may carry raw group literals)
+KEY_MODULES = ("tpujob/api/constants.py", "tpujob/api/progress.py",
+               "tpujob/api/nodes.py")
+CONSTANTS_MODULE = "tpujob/api/constants.py"
+METRICS_MODULE = "tpujob/server/metrics.py"
+STATUS_MODULE = "tpujob/controller/status.py"
+CONTROLLER_DIR = "tpujob/controller/"
+
+# metric constructor -> exposition kind, as metrics.py's kind() reports it
+_METRIC_CTORS = {
+    "Counter": "counter",
+    "Gauge": "gauge",
+    "Histogram": "histogram",
+    "LabeledCounter": "counter",
+    "LabeledGauge": "gauge",
+    # counter-TYPED, set-driven (the ledger accumulates; see metrics.py)
+    "LabeledSettableCounter": "counter",
+    "LabeledHistogram": "histogram",
+}
+
+Site = Tuple[str, int]  # (repo-relative path, line)
+
+
+@dataclass
+class AnnotationKey:
+    const: str                   # constant name, e.g. ANNOTATION_WORLD_SIZE
+    key: str                     # wire spelling, e.g. tpujob.dev/world-size
+    module: str                  # defining module (repo-relative)
+    line: int                    # definition line
+    publishes: List[Site] = field(default_factory=list)
+    null_writes: List[Site] = field(default_factory=list)
+    reads: List[Site] = field(default_factory=list)
+
+
+@dataclass
+class MetricFamily:
+    var: str                     # module-level variable name
+    name: str                    # exposition family name
+    kind: str                    # counter | gauge | histogram
+    labels: Tuple[str, ...]      # label names ((), for unlabeled)
+    line: int
+
+
+@dataclass
+class ConditionInfo:
+    set_true: Dict[str, List[Site]]  # JOB_* const -> set-True call sites
+    terminal_flip: Set[str]          # consts in the terminal flip tuple
+    flip_line: int                   # line of the flip tuple (0 = not found)
+
+
+@dataclass
+class PodCallSite:
+    path: str
+    line: int
+    method: str                  # create_pod | create_pods | delete_pod
+    receiver: Optional[str]      # dotted receiver, e.g. self.pod_control
+
+
+@dataclass
+class WireRegistry:
+    annotations: Dict[str, AnnotationKey]
+    metrics: Dict[str, MetricFamily]   # keyed by family (exposition) name
+    conditions: ConditionInfo
+    pod_calls: List[PodCallSite]
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "annotations": {
+                a.key: {
+                    "const": a.const,
+                    "defined": f"{a.module}:{a.line}",
+                    "publishes": [f"{p}:{l}" for p, l in a.publishes],
+                    "null_writes": [f"{p}:{l}" for p, l in a.null_writes],
+                    "reads": [f"{p}:{l}" for p, l in a.reads],
+                }
+                for a in sorted(self.annotations.values(),
+                                key=lambda a: a.key)
+            },
+            "metrics": {
+                m.name: {"var": m.var, "kind": m.kind,
+                         "labels": list(m.labels),
+                         "defined": f"{METRICS_MODULE}:{m.line}"}
+                for m in sorted(self.metrics.values(), key=lambda m: m.name)
+            },
+            "conditions": {
+                "set_true": {
+                    const: [f"{p}:{l}" for p, l in sites]
+                    for const, sites in sorted(
+                        self.conditions.set_true.items())
+                },
+                "terminal_flip": sorted(self.conditions.terminal_flip),
+            },
+            "pod_calls": [
+                {"site": f"{s.path}:{s.line}", "method": s.method,
+                 "receiver": s.receiver}
+                for s in self.pod_calls
+            ],
+        }
+
+
+def in_wire_scope(rel: str) -> bool:
+    """Whether a path counts as a wire-protocol reference site (the shipped
+    tree + e2e harnesses + scripts + top-level benches; NOT tests/)."""
+    return not rel.startswith("tests/")
+
+
+# ---------------------------------------------------------------------------
+# extraction passes
+# ---------------------------------------------------------------------------
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _module_constants(ctx: FileContext) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments."""
+    out: Dict[str, str] = {}
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            s = _const_str(node.value)
+            if s is not None:
+                out[node.targets[0].id] = s
+    return out
+
+
+def _extract_annotation_keys(project: Project) -> Dict[str, AnnotationKey]:
+    """GROUP_NAME-derived f-string constants in the key modules, resolved to
+    their wire spelling.  Only metadata keys (``ANNOTATION_*`` / ``LABEL_*``)
+    join the publish/consume protocol; ``API_VERSION``-style derivations are
+    resolved but carry no conformance obligations."""
+    out: Dict[str, AnnotationKey] = {}
+    for mod in KEY_MODULES:
+        ctx = project.context(mod)
+        if ctx is None:
+            continue
+        literals = _module_constants(ctx)
+        for node in ctx.tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.JoinedStr)):
+                continue
+            name = node.targets[0].id
+            if not (name.startswith("ANNOTATION_")
+                    or name.startswith("LABEL_")):
+                continue
+            parts: List[str] = []
+            ok = True
+            for piece in node.value.values:
+                if isinstance(piece, ast.Constant):
+                    parts.append(str(piece.value))
+                elif (isinstance(piece, ast.FormattedValue)
+                      and isinstance(piece.value, ast.Name)
+                      and piece.value.id in literals):
+                    parts.append(literals[piece.value.id])
+                else:
+                    ok = False
+                    break
+            if ok:
+                out[name] = AnnotationKey(
+                    const=name, key="".join(parts), module=ctx.rel,
+                    line=node.lineno)
+    return out
+
+
+def _classify_annotation_refs(project: Project,
+                              keys: Dict[str, AnnotationKey]) -> None:
+    """Find every ``c.ANNOTATION_X`` / bare-name reference outside the
+    defining modules and classify it: dict-literal key with a non-None value
+    (or a subscript store) = publish; dict key with a literal ``None`` value
+    (or a del) = null-write (ack consumption); everything else = read."""
+    if not keys:
+        return
+    wanted = set(keys)
+    for ctx in project.contexts():
+        # only a key's own DEFINING module is skipped (per-key, below) —
+        # the other API modules are real protocol participants
+        # (api/nodes.py both reads the heartbeat key and publishes the
+        # synthesized label)
+        if not in_wire_scope(ctx.rel):
+            continue
+        parents = None
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, ast.Name):
+                name = node.id
+            else:
+                continue
+            if name not in wanted or ctx.rel == keys[name].module:
+                continue
+            if isinstance(node, ast.Attribute) and not isinstance(
+                    node.value, ast.Name):
+                continue  # x.y.ANNOTATION_FOO: not a constants alias
+            if parents is None:
+                parents = ctx.parents()
+            # skip the inner Name of an Attribute match (walk visits both)
+            parent = parents.get(node)
+            if isinstance(node, ast.Name) and isinstance(parent, ast.Attribute):
+                continue
+            site = (ctx.rel, node.lineno)
+            rec = keys[name]
+            if isinstance(parent, ast.Dict) and node in parent.keys:
+                value = parent.values[parent.keys.index(node)]
+                if isinstance(value, ast.Constant) and value.value is None:
+                    rec.null_writes.append(site)
+                else:
+                    rec.publishes.append(site)
+            elif isinstance(parent, ast.Subscript) and parent.slice is node:
+                if isinstance(parent.ctx, ast.Store):
+                    rec.publishes.append(site)
+                elif isinstance(parent.ctx, ast.Del):
+                    rec.null_writes.append(site)
+                else:
+                    rec.reads.append(site)
+            else:
+                rec.reads.append(site)
+
+
+def _resolve_labels(node: ast.AST,
+                    tuples: Dict[str, Tuple[str, ...]]) -> Tuple[str, ...]:
+    """A labelnames argument as a tuple of strings: a literal tuple, a
+    module-level tuple constant by name, or a ``NAME + (...)`` concatenation."""
+    if isinstance(node, ast.Tuple):
+        return tuple(v for v in (_const_str(e) for e in node.elts)
+                     if v is not None)
+    if isinstance(node, ast.Name):
+        return tuples.get(node.id, ())
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return (_resolve_labels(node.left, tuples)
+                + _resolve_labels(node.right, tuples))
+    return ()
+
+
+def _extract_metric_families(project: Project) -> Dict[str, MetricFamily]:
+    ctx = project.context(METRICS_MODULE)
+    if ctx is None:
+        return {}
+    # module-level tuple constants (_JOB_LABELS)
+    tuples: Dict[str, Tuple[str, ...]] = {}
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Tuple):
+            tuples[node.targets[0].id] = tuple(
+                v for v in (_const_str(e) for e in node.value.elts)
+                if v is not None)
+    out: Dict[str, MetricFamily] = {}
+    for node in ctx.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id in _METRIC_CTORS):
+            continue
+        call = node.value
+        name = _const_str(call.args[0]) if call.args else None
+        if name is None:
+            continue
+        labels: Tuple[str, ...] = ()
+        ctor = call.func.id
+        if ctor.startswith("Labeled"):
+            # signature: (name, help, registry, labelnames, ...)
+            if len(call.args) >= 4:
+                labels = _resolve_labels(call.args[3], tuples)
+            for kw in call.keywords:
+                if kw.arg == "labelnames":
+                    labels = _resolve_labels(kw.value, tuples)
+        out[name] = MetricFamily(
+            var=node.targets[0].id, name=name, kind=_METRIC_CTORS[ctor],
+            labels=labels, line=node.lineno)
+    return out
+
+
+def _job_cond_names(node: ast.AST) -> Optional[str]:
+    """``c.JOB_X`` / ``constants.JOB_X`` / bare ``JOB_X`` -> ``JOB_X``."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.attr.startswith("JOB_"):
+        return node.attr
+    if isinstance(node, ast.Name) and node.id.startswith("JOB_"):
+        return node.id
+    return None
+
+
+def _extract_conditions(project: Project) -> ConditionInfo:
+    set_true: Dict[str, List[Site]] = {}
+    for ctx in project.contexts():
+        if not ctx.rel.startswith("tpujob/"):
+            continue  # fixtures in tests/e2e set contrived condition states
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = dotted_name(node.func)
+            if func is None or not func.endswith("update_job_conditions"):
+                continue
+            if len(node.args) < 2:
+                continue
+            const = _job_cond_names(node.args[1])
+            if const is not None:
+                set_true.setdefault(const, []).append(
+                    (ctx.rel, node.lineno))
+
+    terminal_flip: Set[str] = set()
+    flip_line = 0
+    ctx = project.context(STATUS_MODULE)
+    if ctx is not None:
+        for fn in ast.walk(ctx.tree):
+            if not (isinstance(fn, ast.FunctionDef)
+                    and fn.name == "set_condition"):
+                continue
+            # the terminal branch: `condition.type in (JOB_SUCCEEDED,
+            # JOB_FAILED)`; the flip tuple is the `cond.type in (...)`
+            # compare inside its body
+            for test in ast.walk(fn):
+                if not (isinstance(test, ast.If)
+                        and isinstance(test.test, ast.Compare)
+                        and len(test.test.ops) == 1
+                        and isinstance(test.test.ops[0], ast.In)
+                        and isinstance(test.test.comparators[0], ast.Tuple)):
+                    continue
+                branch_consts = {
+                    _job_cond_names(e)
+                    for e in test.test.comparators[0].elts}
+                if branch_consts != {"JOB_SUCCEEDED", "JOB_FAILED"}:
+                    continue
+                for inner in ast.walk(test):
+                    if (isinstance(inner, ast.Compare)
+                            and len(inner.ops) == 1
+                            and isinstance(inner.ops[0], ast.In)
+                            and isinstance(inner.comparators[0], ast.Tuple)
+                            and inner is not test.test):
+                        consts = {
+                            c for c in (_job_cond_names(e) for e in
+                                        inner.comparators[0].elts)
+                            if c is not None}
+                        if consts:
+                            terminal_flip = consts
+                            flip_line = inner.lineno
+                            break
+                break
+    return ConditionInfo(set_true=set_true, terminal_flip=terminal_flip,
+                         flip_line=flip_line)
+
+
+_POD_METHODS = ("create_pod", "create_pods", "delete_pod")
+
+
+def _extract_pod_calls(project: Project) -> List[PodCallSite]:
+    out: List[PodCallSite] = []
+    for ctx in project.contexts():
+        if not ctx.rel.startswith(CONTROLLER_DIR):
+            continue
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in _POD_METHODS:
+                out.append(PodCallSite(
+                    path=ctx.rel, line=node.lineno, method=func.attr,
+                    receiver=dotted_name(func.value)))
+            elif isinstance(func, ast.Name) and func.id in _POD_METHODS:
+                out.append(PodCallSite(
+                    path=ctx.rel, line=node.lineno, method=func.id,
+                    receiver=None))
+            elif (isinstance(func, ast.Attribute)
+                  and func.attr in ("create", "delete") and node.args):
+                resource = node.args[0]
+                is_pods = (_const_str(resource) == "pods"
+                           or (isinstance(resource, ast.Name)
+                               and resource.id == "RESOURCE_PODS"))
+                if is_pods:
+                    out.append(PodCallSite(
+                        path=ctx.rel, line=node.lineno,
+                        method=f"{func.attr}(pods)",
+                        receiver=dotted_name(func.value)))
+    out.sort(key=lambda s: (s.path, s.line))
+    return out
+
+
+def wire_registry(project: Project) -> WireRegistry:
+    """The project's wire registry, built once and memoized on the project
+    instance so every TPL2xx rule shares one extraction pass."""
+    cached = getattr(project, "_wire_registry", None)
+    if cached is not None:
+        return cached
+    keys = _extract_annotation_keys(project)
+    _classify_annotation_refs(project, keys)
+    reg = WireRegistry(
+        annotations=keys,
+        metrics=_extract_metric_families(project),
+        conditions=_extract_conditions(project),
+        pod_calls=_extract_pod_calls(project),
+    )
+    project._wire_registry = reg  # type: ignore[attr-defined]
+    return reg

@@ -1,0 +1,55 @@
+"""TPL202: condition lifecycle — everything set True must terminally flip.
+
+``status.set_condition`` owns the job condition machine: when a job
+reaches Succeeded/Failed it flips every still-live condition False (the
+terminal flip tuple) so no consumer ever observes ``Running=True`` on a
+finished job.  That guarantee is only as good as the tuple's coverage:
+a NEW condition constant set True anywhere in the controller that is
+missing from the tuple outlives completion silently — kubectl waits hang,
+the scheduler double-counts live gangs, dashboards show phantom state.
+
+The rule reads the wire registry's condition pass: every ``JOB_*``
+constant passed to ``update_job_conditions`` in the shipped tree must
+either appear in the terminal flip tuple, be the terminal pair itself
+(Succeeded/Failed), or carry an inline ``# noqa: TPL202`` waiver stating
+WHY the condition legitimately outlives completion (the waiver text lives
+next to the call, where the next editor will read it).
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+from tpujob.analysis.engine import Finding, Project, Rule
+from tpujob.analysis.registry import STATUS_MODULE, wire_registry
+
+# the terminal pair is what CAUSES the flip; it cannot flip itself
+_TERMINAL = frozenset({"JOB_SUCCEEDED", "JOB_FAILED"})
+
+
+class ConditionLifecycleRule(Rule):
+    id = "TPL202"
+    name = "condition-lifecycle"
+    rationale = ("a condition set True but missing from the terminal "
+                 "flip-False tuple outlives job completion silently")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        reg = wire_registry(project)
+        cond = reg.conditions
+        if not cond.flip_line or project.context(STATUS_MODULE) is None:
+            return ()  # not this tree (fixture dirs, partial checkouts)
+        out: List[Finding] = []
+        for const, sites in sorted(cond.set_true.items()):
+            if const in _TERMINAL or const in cond.terminal_flip:
+                continue
+            for path, line in sites:
+                out.append(Finding(
+                    self.id, path, line,
+                    f"condition {const} is set True here but missing from "
+                    f"the terminal flip-False tuple "
+                    f"({STATUS_MODULE}:{cond.flip_line}) — it will survive "
+                    f"job completion; add it to the tuple or waive with "
+                    f"`# noqa: TPL202` stating why it outlives the job"))
+        return out
+
+
+RULES: Tuple[Rule, ...] = (ConditionLifecycleRule(),)

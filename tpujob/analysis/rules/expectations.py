@@ -1,0 +1,50 @@
+"""TPL203: expectation bookkeeping — pod churn goes through PodControl.
+
+Every pod create/delete in ``tpujob/controller/`` must flow through the
+``PodControl`` ladder (``self.pod_control.create_pod/create_pods/
+delete_pod``) or the shared ``_delete_pod_no_strike`` wrapper, because
+that ladder is where the informer-lag expectations are raised and cleared
+(adds/dels accounting).  A raw transport call — a bare ``create_pod``
+import, a generic ``client.create("pods", ...)`` — creates or deletes a
+pod the expectation tracker never hears about, which is exactly the
+double-create-under-informer-lag bug class fixed in PRs 1/2 and re-fixed
+in PR 11.
+
+The rule reads the wire registry's pod-call pass: every create/delete
+call site in the controller package whose receiver is not a
+``pod_control`` handle is a violation.  ``PodControl`` itself lives in
+``tpujob/kube/control.py`` — outside the scanned package — so the
+ladder's own transport calls are not self-flagging.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+from tpujob.analysis.engine import Finding, Project, Rule
+from tpujob.analysis.registry import wire_registry
+
+
+class ExpectationBookkeepingRule(Rule):
+    id = "TPL203"
+    name = "expectation-bookkeeping"
+    rationale = ("pod create/delete outside the PodControl ladder skips "
+                 "expectation accounting: the double-create bug class")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        reg = wire_registry(project)
+        out: List[Finding] = []
+        for site in reg.pod_calls:
+            if site.receiver is not None \
+                    and site.receiver.split(".")[-1] == "pod_control":
+                continue
+            out.append(Finding(
+                self.id, site.path, site.line,
+                f"raw pod churn: {site.receiver or '<bare>'}"
+                f".{site.method} bypasses the PodControl expectation "
+                f"ladder — route through self.pod_control (or "
+                f"_delete_pod_no_strike for non-strike deletes) so "
+                f"informer-lag accounting sees it"))
+        return out
+
+
+RULES: Tuple[Rule, ...] = (ExpectationBookkeepingRule(),)

@@ -1,0 +1,144 @@
+"""TPL201: metric/docs parity and per-job series hygiene.
+
+Three invariants over the metric families registered in
+``tpujob/server/metrics.py`` (extracted once into the wire registry):
+
+1. **Docs parity, both directions.**  Every family registered in code has
+   a table row in ``docs/monitoring/README.md``, and every family named in
+   a docs table row exists in code.  Dashboards are built from the docs;
+   a family on one side only is either invisible or a 404 panel.
+2. **Suffix/type discipline.**  ``_total`` ⇔ counter: a gauge named
+   ``*_total`` lies to every rate() query, and a counter without the
+   suffix hides from the convention scrapers rely on.  A legacy exception
+   is expressible ONLY as a committed baseline entry with a rationale
+   (the ``tpujob_job_steps_total`` wart lived and died this way).
+3. **Per-job families must be droppable.**  Any family labeled by
+   (namespace, job) holds one series per job forever unless something
+   calls ``remove``/``remove_matching``/``forget`` on it — the
+   resurrected-series/leaked-cardinality bug class from the shard-handoff
+   work.  A per-job family with no reachable remove site anywhere in the
+   tree cannot participate in the handoff-drop discipline.
+
+Remove-site detection is deliberately coarse: a family counts as covered
+when some function outside ``tests/`` references it AND calls one of the
+drop methods (this matches both the ``clear_job_series`` loop-over-tuple
+shape and goodput's direct ``metrics.x.remove(...)`` calls).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tpujob.analysis.engine import Finding, Project, Rule
+from tpujob.analysis.registry import (
+    METRICS_MODULE, in_wire_scope, wire_registry)
+
+DOCS_PATH = "docs/monitoring/README.md"
+
+# a docs table row: `| `tpujob_foo{label=}` | gauge (`state`) | ... |`
+_DOC_ROW_RE = re.compile(r"^\|\s*`(?P<name>tpujob_[a-z0-9_]+)[^`]*`\s*\|"
+                         r"\s*(?P<type>[a-z]+)")
+_DROP_METHODS = ("remove", "remove_matching", "forget")
+
+
+def _documented_families(project: Project) -> Dict[str, Tuple[str, int]]:
+    """family name -> (documented type, docs line)."""
+    path = project.root / DOCS_PATH
+    if not path.exists():
+        return {}
+    out: Dict[str, Tuple[str, int]] = {}
+    for i, line in enumerate(path.read_text().splitlines(), 1):
+        m = _DOC_ROW_RE.match(line.strip())
+        if m:
+            out.setdefault(m.group("name"), (m.group("type"), i))
+    return out
+
+
+def _removable_vars(project: Project) -> Set[str]:
+    """Every name referenced inside a function that calls a drop method."""
+    out: Set[str] = set()
+    for ctx in project.contexts():
+        if ctx.rel == METRICS_MODULE or not in_wire_scope(ctx.rel):
+            continue
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            drops = False
+            names: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) \
+                        and node.func.attr in _DROP_METHODS:
+                    drops = True
+                if isinstance(node, ast.Attribute):
+                    names.add(node.attr)
+                elif isinstance(node, ast.Name):
+                    names.add(node.id)
+            if drops:
+                out |= names
+    return out
+
+
+class MetricDocsParityRule(Rule):
+    id = "TPL201"
+    name = "metric-docs-parity"
+    rationale = ("metric families must match docs/monitoring, _total must "
+                 "mean counter, and per-job families need a remove site")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        reg = wire_registry(project)
+        if not reg.metrics or project.context(METRICS_MODULE) is None:
+            return ()  # not this tree (fixture dirs, partial checkouts)
+        out: List[Finding] = []
+        documented = _documented_families(project)
+
+        for fam in sorted(reg.metrics.values(), key=lambda m: m.name):
+            if fam.name not in documented:
+                out.append(Finding(
+                    self.id, METRICS_MODULE, fam.line,
+                    f"family {fam.name} is registered in code but has no "
+                    f"table row in {DOCS_PATH} — dashboards are built from "
+                    f"the docs"))
+            else:
+                doc_type = documented[fam.name][0]
+                if doc_type != fam.kind:
+                    out.append(Finding(
+                        self.id, METRICS_MODULE, fam.line,
+                        f"family {fam.name} is a {fam.kind} in code but "
+                        f"documented as {doc_type} in {DOCS_PATH}"))
+            is_total = fam.name.endswith("_total")
+            if is_total and fam.kind != "counter":
+                out.append(Finding(
+                    self.id, METRICS_MODULE, fam.line,
+                    f"family {fam.name} carries the _total suffix but is a "
+                    f"{fam.kind} — _total promises counter semantics to "
+                    f"every rate() query (legacy exceptions live in the "
+                    f"baseline, never inline)"))
+            elif fam.kind == "counter" and not is_total:
+                out.append(Finding(
+                    self.id, METRICS_MODULE, fam.line,
+                    f"counter family {fam.name} lacks the _total suffix — "
+                    f"scrapers key counter semantics off the name"))
+
+        for name, (_type, line) in sorted(documented.items()):
+            if name not in reg.metrics:
+                out.append(Finding(
+                    self.id, DOCS_PATH, line,
+                    f"{DOCS_PATH} documents family {name} which is not "
+                    f"registered in {METRICS_MODULE} — stale row or typo"))
+
+        removable = _removable_vars(project)
+        for fam in sorted(reg.metrics.values(), key=lambda m: m.name):
+            if not {"namespace", "job"} <= set(fam.labels):
+                continue
+            if fam.var not in removable:
+                out.append(Finding(
+                    self.id, METRICS_MODULE, fam.line,
+                    f"per-job family {fam.name} (labels {fam.labels}) has "
+                    f"no reachable remove/remove_matching/forget site — its "
+                    f"series outlive every job (handoff-drop discipline)"))
+        return out
+
+
+RULES: Tuple[Rule, ...] = (MetricDocsParityRule(),)

@@ -1,0 +1,148 @@
+"""TPL200: annotation wire-protocol conformance.
+
+The operator and its workloads talk through ``tpujob.dev/*`` annotations —
+the resize channel (target-world-size / checkpoint-ack), the scheduler
+channel (preempt-target / preempt-ack), node heartbeats, migration
+markers.  Three invariants keep those channels honest:
+
+1. **Paired ends.**  Every registered key has at least one publisher
+   (a dict-literal write or subscript store with a real value) AND at
+   least one consumer (a read) somewhere in the shipped tree, the e2e
+   harnesses, or the benches.  A key with one end missing is a protocol
+   half nobody answers — exactly how a deleted ack consumer ships.
+2. **No raw spellings.**  ``tpujob.dev/...`` string literals outside the
+   constants/API modules are violations: the workload and controller
+   halves can only stay in agreement if both import the spelling from
+   ``api/constants.py``.  Docstrings are prose, not wire traffic.
+3. **Consume-at-publish.**  Ack keys (``checkpoint-ack``,
+   ``preempt-ack``) must be nulled in the SAME patch dict that publishes
+   their paired target.  Publishing a new target while a stale ack is
+   still standing lets the controller read last epoch's ack as this
+   epoch's answer (the bug class re-fixed in PRs 9 and 11).
+
+All three run off the shared wire registry (one project-wide extraction
+pass; see tpujob/analysis/registry.py).  ``tests/`` is out of scope —
+fixtures legitimately spell raw strings and fake half-channels.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from tpujob.analysis.engine import Finding, Project, Rule
+from tpujob.analysis.registry import (
+    CONSTANTS_MODULE, KEY_MODULES, in_wire_scope, wire_registry)
+
+# paired target -> ack constant names (the consume-at-publish pairs)
+ACK_PAIRS: Dict[str, str] = {
+    "ANNOTATION_TARGET_WORLD_SIZE": "ANNOTATION_CHECKPOINT_ACK",
+    "ANNOTATION_PREEMPT_TARGET": "ANNOTATION_PREEMPT_ACK",
+}
+
+# an exact wire key, not prose that merely mentions the group
+_RAW_KEY_RE = re.compile(r"^tpujob\.dev(/[A-Za-z0-9_.\-]+)?$")
+
+
+class AnnotationProtocolRule(Rule):
+    id = "TPL200"
+    name = "annotation-protocol-conformance"
+    rationale = ("every wire key needs a publisher and a consumer; raw "
+                 "tpujob.dev literals and un-nulled acks skew the "
+                 "controller/workload protocol")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        reg = wire_registry(project)
+        if not reg.annotations or project.context(CONSTANTS_MODULE) is None:
+            return ()  # not this tree (fixture dirs, partial checkouts)
+        out: List[Finding] = []
+        self._check_pairing(project, reg, out)
+        self._check_raw_literals(project, out)
+        self._check_consume_at_publish(project, reg, out)
+        return out
+
+    # -- invariant 1: every key has both ends ------------------------------
+
+    def _check_pairing(self, project, reg, out: List[Finding]) -> None:
+        for rec in sorted(reg.annotations.values(), key=lambda a: a.const):
+            if not rec.publishes:
+                out.append(Finding(
+                    self.id, rec.module, rec.line,
+                    f"wire key {rec.key} ({rec.const}) has no publisher "
+                    f"anywhere in the tree — dead protocol half "
+                    f"(readers: {len(rec.reads)})"))
+            if not rec.reads:
+                out.append(Finding(
+                    self.id, rec.module, rec.line,
+                    f"wire key {rec.key} ({rec.const}) has no consumer "
+                    f"anywhere in the tree — published into the void "
+                    f"(publishers: {len(rec.publishes)})"))
+
+    # -- invariant 2: no raw tpujob.dev spellings --------------------------
+
+    def _check_raw_literals(self, project, out: List[Finding]) -> None:
+        for ctx in project.contexts():
+            if ctx.rel in KEY_MODULES or not in_wire_scope(ctx.rel):
+                continue
+            parents = ctx.parents()
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and _RAW_KEY_RE.match(node.value)):
+                    continue
+                # statement-level string constants are docstrings/prose
+                if isinstance(parents.get(node), ast.Expr):
+                    continue
+                out.append(Finding(
+                    self.id, ctx.rel, node.lineno,
+                    f"raw wire-key literal {node.value!r} — import the "
+                    f"spelling from tpujob.api.constants so both protocol "
+                    f"halves share one source of truth"))
+
+    # -- invariant 3: consume-at-publish on ack pairs ----------------------
+
+    def _check_consume_at_publish(self, project, reg,
+                                  out: List[Finding]) -> None:
+        wanted = set(ACK_PAIRS) | set(ACK_PAIRS.values())
+        for ctx in project.contexts():
+            if ctx.rel in KEY_MODULES or not in_wire_scope(ctx.rel):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Dict):
+                    continue
+                keys = self._const_keys(node, wanted)
+                for target, ack in ACK_PAIRS.items():
+                    if target not in keys:
+                        continue
+                    value = node.values[keys[target]]
+                    if isinstance(value, ast.Constant) and value.value is None:
+                        continue  # nulling the target is cleanup, not publish
+                    if ack not in keys:
+                        out.append(Finding(
+                            self.id, ctx.rel, node.lineno,
+                            f"publishes {target} without nulling {ack} in "
+                            f"the same patch — a stale ack from the last "
+                            f"epoch stays readable (consume-at-publish)"))
+                        continue
+                    ack_value = node.values[keys[ack]]
+                    if not (isinstance(ack_value, ast.Constant)
+                            and ack_value.value is None):
+                        out.append(Finding(
+                            self.id, ctx.rel, node.lineno,
+                            f"writes {ack} alongside {target} but not to "
+                            f"None — only the workload may publish acks; "
+                            f"the controller's job is to null them"))
+
+    @staticmethod
+    def _const_keys(node: ast.Dict, wanted) -> Dict[str, int]:
+        """Map of annotation-constant key name -> index in the dict literal."""
+        found: Dict[str, int] = {}
+        for i, key in enumerate(node.keys):
+            if isinstance(key, ast.Attribute) and key.attr in wanted:
+                found[key.attr] = i
+            elif isinstance(key, ast.Name) and key.id in wanted:
+                found[key.id] = i
+        return found
+
+
+RULES: Tuple[Rule, ...] = (AnnotationProtocolRule(),)

@@ -228,9 +228,6 @@ class ProgressTracker:
                           shard=state.shard_label)
             prog = state.progress
             metrics.job_steps.labels(**labels).set(float(prog.step))
-            # deprecated twin (one release): the old gauge-with-_total name
-            metrics.job_steps_deprecated.labels(**labels).set(
-                float(prog.step))
             metrics.job_samples_per_second.labels(**labels).set(
                 float(prog.samples_per_sec or 0.0))
             metrics.job_heartbeat_age.labels(**labels).set(
@@ -279,8 +276,7 @@ def clear_job_series(state: JobProgress) -> None:
     """Remove the job's children from every ``tpujob_job_*`` family."""
     labels = dict(namespace=state.namespace, job=state.name,
                   shard=state.shard_label)
-    for family in (metrics.job_steps, metrics.job_steps_deprecated,
-                   metrics.job_samples_per_second,
+    for family in (metrics.job_steps, metrics.job_samples_per_second,
                    metrics.job_checkpoint_age, metrics.job_heartbeat_age,
                    metrics.job_stalled):
         family.remove(**labels)

@@ -507,7 +507,11 @@ class TPUJobController(JobController):
             self._sync_pod_group(job)
 
         if not st.get_condition(job.status, c.JOB_CREATED):
-            st.update_job_conditions(
+            # Created is the job's durable history marker (kubeflow
+            # semantics): it records that the object was admitted and is
+            # MEANT to stay True after Succeeded/Failed, so it is waived
+            # from the terminal flip-False tuple rather than added to it.
+            st.update_job_conditions(  # noqa: TPL202
                 job.status, c.JOB_CREATED, st.REASON_JOB_CREATED,
                 f"TPUJob {job.metadata.name} is created.",
             )
@@ -1161,8 +1165,15 @@ class TPUJobController(JobController):
         ann = job.metadata.annotations or {}
         if ann.get(c.ANNOTATION_TARGET_WORLD_SIZE) == str(target_world):
             return
-        self._patch_job_annotations(
-            job, {c.ANNOTATION_TARGET_WORLD_SIZE: str(target_world)})
+        self._patch_job_annotations(job, {
+            c.ANNOTATION_TARGET_WORLD_SIZE: str(target_world),
+            # consume-at-publish (TPL200): a NEW target invalidates any ack
+            # standing from a previous drain in the same patch, so the
+            # barrier check can never read last epoch's ack as this one's.
+            # (The idempotence guard above means a mid-drain resync — same
+            # target, possibly a fresh valid ack — never repatches.)
+            c.ANNOTATION_CHECKPOINT_ACK: None,
+        })
 
     def _publish_world(self, job: TPUJob, world: int) -> None:
         """Republish the world size: the resize's commit point.  Survivors

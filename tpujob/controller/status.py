@@ -101,8 +101,9 @@ def set_condition(status: JobStatus, condition: JobCondition) -> None:
     (status.go:226-272):
 
     - Running=True removes Restarting; Restarting=True removes Running.
-    - Succeeded/Failed=True flips Running to False (job no longer running)
-      rather than dropping history.
+    - Succeeded/Failed=True flips every live condition (Running,
+      Restarting, Resizing, Stalled, Queued) to False rather than
+      dropping history.
     - Re-setting an identical condition (same status+reason) is a no-op so
       lastTransitionTime is preserved.
     """
@@ -119,12 +120,15 @@ def set_condition(status: JobStatus, condition: JobCondition) -> None:
         elif condition.type == c.JOB_RESTARTING:
             conditions = _filter_out(conditions, c.JOB_RUNNING)
         elif condition.type in (c.JOB_SUCCEEDED, c.JOB_FAILED):
-            # a finished job is neither running, nor mid-resize, nor stalled,
-            # nor waiting in the admission queue: flip all four to False
-            # (history preserved) rather than dropping them
+            # a finished job is neither running, nor restarting, nor
+            # mid-resize, nor stalled, nor waiting in the admission queue:
+            # flip every live condition to False (history preserved) rather
+            # than dropping them.  TPL202 checks this tuple against every
+            # condition set True anywhere in the controller.
             for cond in conditions:
-                if cond.type in (c.JOB_RUNNING, c.JOB_RESIZING,
-                                 c.JOB_STALLED, c.JOB_QUEUED) \
+                if cond.type in (c.JOB_RUNNING, c.JOB_RESTARTING,
+                                 c.JOB_RESIZING, c.JOB_STALLED,
+                                 c.JOB_QUEUED) \
                         and cond.status == "True":
                     cond.status = "False"
                     cond.last_transition_time = condition.last_transition_time

@@ -544,18 +544,6 @@ job_steps = LabeledGauge(
     REGISTRY,
     _JOB_LABELS,
 )
-# DEPRECATED (one release): the original name for the series above — a
-# gauge with a counter's `_total` suffix, the naming wart docs/monitoring
-# documented as the legacy exception.  Both series carry identical values;
-# dashboards should move to `tpujob_job_steps`, and this family is removed
-# next release (see docs/monitoring, "Workload telemetry").
-job_steps_deprecated = LabeledGauge(
-    "tpujob_job_steps_total",
-    "DEPRECATED: renamed to tpujob_job_steps (this is a gauge; the _total "
-    "suffix was a naming mistake).  Removed next release.",
-    REGISTRY,
-    _JOB_LABELS,
-)
 job_samples_per_second = LabeledGauge(
     "tpujob_job_samples_per_second",
     "Smoothed training throughput reported by the job's workload heartbeat",
@@ -621,8 +609,10 @@ sched_admission_wait = Histogram(
 # these follow Prometheus conventions — the `_total` suffix appears ONLY on
 # counters (`tpujob_scheduler_migrations_total`,
 # `tpujob_node_health_transitions_total`); gauges carry none
-# (`tpujob_node_count`).  The one legacy exception in this codebase is
-# `tpujob_job_steps_total`, a gauge that predates the convention.
+# (`tpujob_node_count`).  The convention now holds with no exceptions —
+# the one legacy wart, a gauge named `tpujob_job_steps_total`, completed
+# its one-release deprecation and is gone; TPL201 enforces the suffix
+# rule mechanically from here on.
 node_count = LabeledGauge(
     "tpujob_node_count",
     "Nodes in the fleet inventory by effective state (ready / not_ready / "

@@ -52,6 +52,7 @@ import uuid
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from tpujob.analysis import lockgraph
+from tpujob.api import constants as c
 from tpujob.kube.errors import AlreadyExistsError, ConflictError, NotFoundError
 from tpujob.kube.fencing import FencingToken
 from tpujob.server import metrics
@@ -307,7 +308,7 @@ class ShardCoordinator:
         else adopts the map's count (logging loudly on mismatch) before
         acquiring anything."""
         record = {
-            "apiVersion": "tpujob.dev/v1",
+            "apiVersion": c.API_VERSION,
             "kind": "ShardMap",
             "metadata": {"name": SHARD_MAP_NAME, "namespace": self.namespace},
             "spec": {"shards": self.num_shards},
