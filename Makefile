@@ -11,7 +11,7 @@ SHELL := /bin/bash
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke goodput-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
+.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke goodput-smoke flex-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
@@ -118,9 +118,18 @@ node-smoke:
 goodput-smoke:
 	$(PY) scripts/goodput_smoke.py
 
+# elastic-capacity smoke (~6 s): a high-tier arrival shrinks a running
+# low-tier 2-slice gang by one slice through the staged-drain checkpoint
+# barrier instead of evicting it — zero counted restarts, zero restores,
+# no partial placement at any committed instant — and the background
+# grower restores the full shape once the pressure clears
+# (docs/failure-handling, "Elastic capacity & defragmentation semantics")
+flex-smoke:
+	$(PY) scripts/flex_smoke.py
+
 # the tier-1 command from ROADMAP.md, verbatim (modulo $$-escaping for
 # make), so local and CI invocations agree on what "the tests pass" means
-test: lint trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke goodput-smoke
+test: lint trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke goodput-smoke flex-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # the operator/controller/kube/api tests only — the model-path suites

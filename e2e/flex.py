@@ -1,0 +1,676 @@
+"""Elastic-capacity chaos tier: num_slices flex + torus defragmentation.
+
+The flex smoke (``make flex-smoke``) is the acceptance gate of the
+elastic capacity optimizer: a high-tier arrival must shrink a running
+low-tier 2-slice gang by one slice THROUGH the staged-resize checkpoint
+barrier — zero counted restarts, the gang never evicted, never partially
+placed at any committed instant — and the background grower must restore
+the full shape once the pressure clears.
+
+``run_flex_soak`` (``soak.py --flex``, in the ``--crash`` set) runs an
+oversubscribed mixed-tier matrix — flexible multislice gangs, a per-job
+min-slices floor annotation, a late high-tier arrival — under the full
+API fault schedule, a node storm (heartbeat flap, cordon churn, a
+whole-slice outage with recovery) and controller hard-kills, TWICE per
+seed on the same fault schedule: once with the elastic planner on, once
+preempt-only.  Invariants, on top of the standard chaos + scheduler sets:
+
+19. **graceful degradation beats eviction** — the flex run's cumulative
+    ``tpujob_fleet_goodput_ratio`` strictly beats the preempt-only run's
+    on the same seed (the whole point of flexing: pressure costs a
+    re-rendezvous, not a redo);
+20. **every flex/defrag move is checkpoint-safe** — zero counted restarts
+    across the whole run (drains, migrations and preemptions all ride
+    the barrier; nothing registers as a failure strike);
+21. **no partial placement at any committed instant** — the flex-aware
+    AdmissionTracker allows a committed assignment between the published
+    flex target and the spec shape, and nothing outside it.
+
+Runnable:  python soak.py --flex
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from e2e.chaos import (
+    JobCase,
+    StallTracker,
+    _all_converged,
+    _converge_or_fail,
+    _job,
+    _lock_audit_report,
+    _settle_invariants,
+    _soak_harness,
+    _start_app,
+    _tmpl,
+    _wait_for,
+    check_trace_ledger,
+)
+from e2e.kubelet import KubeletSim
+from e2e.nodes import NodeAgentSim, NodeStorm
+from e2e.scheduler import AdmissionTracker, SchedWorkload, _sched_job_problems
+from tpujob.analysis import lockgraph
+from tpujob.api import constants as c
+from tpujob.controller import status as st
+from tpujob.kube.chaos import ChaosConfig
+from tpujob.kube.client import RESOURCE_TPUJOBS, ClientSet
+from tpujob.kube.errors import ApiError, NotFoundError
+from tpujob.obs import goodput as gp
+from tpujob.obs.trace import TRACER
+from tpujob.server.monitoring import MonitoringServer
+from tpujob.server.scheduler import Assignment
+
+NO_FAULTS = ChaosConfig(
+    error_rate=0.0, timeout_rate=0.0, conflict_rate=0.0, latency_rate=0.0,
+    kill_watch_every=0, compact_every=0, duplicate_event_rate=0.0,
+)
+
+FLEX_SMOKE_CAPACITY = "v4-16x2"  # 2 slices x 2 hosts
+FLEX_SOAK_CAPACITY = "v4-16x4"  # 4 slices x 2 hosts = 8 host slots
+
+FLEX_SOAK_OVERRIDES = dict(
+    scheduler_capacity=FLEX_SOAK_CAPACITY,
+    scheduler_tick_s=0.05,
+    scheduler_aging_s=1.0,
+    scheduler_preempt_grace_s=1.0,
+    scheduler_flex=True,
+    scheduler_defrag=True,
+    # grace sized like the node soak's: a flap's effective heartbeat gap
+    # must never brush the staleness bound on a loaded host
+    node_grace_s=1.2,
+    node_migration_damp_s=0.5,
+    stall_timeout_s=5.0,
+    stall_check_interval_s=0.5,
+)
+
+
+def _assignment_of(admin: ClientSet, name: str) -> Optional[Assignment]:
+    try:
+        job = admin.tpujobs.get("default", name)
+    except ApiError:
+        return None
+    raw = (job.metadata.annotations or {}).get(c.ANNOTATION_SCHED_ASSIGNMENT)
+    return Assignment.from_json(raw) if raw else None
+
+
+def _annotation_of(admin: ClientSet, name: str, key: str) -> Optional[str]:
+    try:
+        job = admin.tpujobs.get("default", name)
+    except ApiError:
+        return None
+    return (job.metadata.annotations or {}).get(key)
+
+
+def _restarts_of(admin: ClientSet, name: str) -> int:
+    try:
+        job = admin.tpujobs.get("default", name)
+    except NotFoundError:
+        return 0
+    return sum(rs.restarts for rs in job.status.replica_statuses.values())
+
+
+class _FlexWatch:
+    """Committed-stream hook recording every flex-slices value each job
+    ever carried (the annotation is cleared when the grower restores the
+    full shape, so the end state alone cannot prove a flex happened)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.flexed: Dict[str, List[str]] = {}
+
+    def hook(self, ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
+        if resource != RESOURCE_TPUJOBS:
+            return
+        meta = obj.get("metadata") or {}
+        value = (meta.get("annotations") or {}).get(c.ANNOTATION_FLEX_SLICES)
+        if value is None:
+            return
+        name = meta.get("name") or ""
+        with self._lock:
+            values = self.flexed.setdefault(name, [])
+            if not values or values[-1] != value:
+                values.append(value)
+
+    def snapshot(self) -> Dict[str, List[str]]:
+        with self._lock:
+            return {k: list(v) for k, v in self.flexed.items()}
+
+
+class _GoodputSampler:
+    """Samples every job's phase-ledger totals while the jobs still exist
+    (the ledger forgets a finished job, and an EMPTY ledger zeroes the
+    fleet gauge — so the run's cumulative ratio must be reconstructed
+    from the last observation of each job, per controller incarnation)."""
+
+    def __init__(self, keys: List[str],
+                 ledger_of: Callable[[], Any]) -> None:
+        self.keys = keys
+        self.ledger_of = ledger_of
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._open: Dict[str, Dict[str, float]] = {}  # guarded by self._lock
+        self._closed: List[Dict[str, float]] = []  # guarded by self._lock
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "_GoodputSampler":
+        loop = threading.Thread(target=self._loop, daemon=True,
+                                name="goodput-sampler")
+        loop.start()
+        self._thread = loop
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            ledger = self.ledger_of()
+            if ledger is not None:
+                for key in self.keys:
+                    try:
+                        totals = ledger.totals(key)
+                    except Exception:  # noqa: TPL005 - mid-restart races
+                        totals = None
+                    if totals:
+                        self._note(key, totals)
+            time.sleep(0.05)
+
+    def _note(self, key: str, totals: Dict[str, float]) -> None:
+        with self._lock:
+            prev = self._open.get(key)
+            if prev is not None \
+                    and sum(totals.values()) + 0.25 < sum(prev.values()):
+                # a restarted controller rebuilt the ledger from scratch:
+                # bank the pre-kill stint, start tracking the new one
+                self._closed.append(prev)
+            self._open[key] = dict(totals)
+
+    def fleet_ratio(self) -> float:
+        """Cumulative fleet goodput ratio over everything sampled — the
+        run-long value of ``tpujob_fleet_goodput_ratio``."""
+        with self._lock:
+            stints = self._closed + list(self._open.values())
+        wall = sum(sum(t.values()) for t in stints)
+        good = sum(sum(t.get(p, 0.0) for p in gp.GOODPUT_PHASES)
+                   for t in stints)
+        return good / wall if wall > 0 else 0.0
+
+
+# ---------------------------------------------------------------------------
+# the smoke (tier-1 gate)
+# ---------------------------------------------------------------------------
+
+
+FLEX_SMOKE_OVERRIDES = dict(
+    scheduler_capacity=FLEX_SMOKE_CAPACITY,
+    scheduler_tick_s=0.05,
+    # aging long so nothing ages above its tier mid-smoke; drain grace
+    # long so the drain can ONLY complete through the workload's
+    # checkpoint-barrier ack (a grace-timeout drain would blow the budget)
+    scheduler_aging_s=30.0,
+    scheduler_preempt_grace_s=5.0,
+    scheduler_flex=True,
+    scheduler_defrag=True,
+    resize_drain_grace_s=5.0,
+    stall_timeout_s=5.0,
+    stall_check_interval_s=0.5,
+)
+
+
+def run_flex_smoke(seed: int = 19, timeout: float = 45.0) -> Dict[str, Any]:
+    """The fast elastic-capacity acceptance gate (``make flex-smoke``):
+    a high-tier single-slice arrival against a full fleet shrinks the
+    running low-tier 2-slice gang by one slice through the checkpoint
+    barrier (zero counted restarts, never evicted, never partially
+    placed), and the grower restores the full shape after the high-tier
+    job finishes.
+
+    Runs under the lock-order sentinel (see ``run_soak``)."""
+    with lockgraph.audit():
+        report = _run_flex_smoke_inner(seed, timeout)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_flex_smoke_inner(seed: int, timeout: float) -> Dict[str, Any]:
+    trainer_stop = threading.Event()
+    low_gate = threading.Event()  # holds the victim alive until restored
+    boss_gate = threading.Event()  # holds the pressure until flex observed
+    prefix, _, inner, chaos, admin, tracker, _ = _soak_harness(
+        seed, "fx", NO_FAULTS, cases=[])
+    admissions = AdmissionTracker(FLEX_SMOKE_CAPACITY)
+    inner.hooks.append(admissions.hook)
+    stall_tracker = StallTracker()
+    inner.hooks.append(stall_tracker.hook)
+    flex_watch = _FlexWatch()
+    inner.hooks.append(flex_watch.hook)
+
+    low_name = f"{prefix}-low"
+    boss_name = f"{prefix}-boss"
+    wl_low = SchedWorkload(admin, low_name, total_steps=25,
+                           stop_event=trainer_stop, finish_gate=low_gate,
+                           answer_drains=True)
+    wl_boss = SchedWorkload(admin, boss_name, total_steps=12,
+                            stop_event=trainer_stop, finish_gate=boss_gate,
+                            answer_drains=True)
+
+    def gang(name: str, workers: int, num_slices: int, priority: str,
+             wl: SchedWorkload) -> JobCase:
+        spec: Dict[str, Any] = {
+            "runPolicy": {"backoffLimit": 10},
+            "tpuReplicaSpecs": {"Worker": {
+                "replicas": workers,
+                "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                "tpu": {"accelerator": "v4-16", "numSlices": num_slices},
+                "template": _tmpl()}},
+        }
+        if priority:
+            spec["runPolicy"]["schedulingPolicy"] = {
+                "priorityClass": priority}
+        return JobCase(job=_job(name, spec), scripts=wl.scripts(),
+                       expect_terminal="Succeeded")
+
+    cases = [
+        gang(low_name, 4, 2, "low", wl_low),  # whole fleet, flexible
+        gang(boss_name, 2, 1, "high", wl_boss),
+    ]
+    # the per-job flex floor, published the way an operator would annotate
+    # a job that can still rendezvous on a single slice
+    cases[0].job.metadata.annotations = {c.ANNOTATION_MIN_SLICES: "1"}
+    started = time.monotonic()
+    deadline = started + timeout
+
+    def _wait(pred, what: str) -> None:
+        if not _wait_for(pred, max(0.1, deadline - time.monotonic()),
+                         interval=0.01):
+            raise AssertionError(f"flex smoke: timed out waiting for {what}")
+
+    def _pods_of(name: str) -> List[str]:
+        return sorted(p.metadata.name for p in admin.pods.list()
+                      if p.metadata.labels.get(c.LABEL_JOB_NAME) == name)
+
+    scripts = [s for case in cases for s in case.scripts]
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts)
+    app = _start_app(chaos, FLEX_SMOKE_OVERRIDES)
+    mon = MonitoringServer(host="127.0.0.1", port=0,
+                           flight=app.controller.flight,
+                           fleet=app.controller.fleet_snapshot,
+                           debug_state=app.controller.debug_job_state).start()
+    kubelet.start()
+    problems: List[str] = []
+    try:
+        # 1. the low-tier 2-slice gang soaks the whole fleet and trains
+        admin.tpujobs.create(cases[0].job)
+        _wait(lambda: len(_pods_of(low_name)) == 4, "the low gang's 4 pods")
+        _wait(lambda: wl_low.ledger.snapshot()["progress"] > 2,
+              "the low gang to train")
+        progress_at_pressure = wl_low.ledger.snapshot()["progress"]
+
+        # 2. a high-tier single-slice gang arrives: the planner must FLEX
+        # the low gang down one slice, not evict it
+        admin.tpujobs.create(cases[1].job)
+        _wait(lambda: _annotation_of(
+            admin, low_name, c.ANNOTATION_FLEX_SLICES) == "1",
+            "the flex target to publish")
+        _wait(lambda: len(_pods_of(boss_name)) == 2, "the boss's admission")
+        # at the instant the boss holds pods, the drain has completed:
+        # the low gang keeps exactly its two leading workers
+        if _pods_of(low_name) != [f"{low_name}-worker-0",
+                                  f"{low_name}-worker-1"]:
+            problems.append(
+                f"low gang pods {_pods_of(low_name)} != its two leading "
+                "workers after the flex drain")
+        _wait(lambda: (lambda a: a is not None and len(a.slices) == 1)(
+            _assignment_of(admin, low_name)),
+            "the assignment to trim to the flexed shape")
+        if wl_low.drain_acks < 1:
+            problems.append(
+                "the drain completed without the workload's checkpoint-"
+                "barrier ack (grace timeout, not the barrier)")
+        if not wl_low.ledger.snapshot()["barriers"]:
+            problems.append("the flex drain never ran its checkpoint barrier")
+        if admissions.preempted or admissions.evicted:
+            problems.append(
+                f"pressure evicted/preempted {admissions.preempted + admissions.evicted}"
+                " — flex was supposed to absorb it")
+        for a in (c.ANNOTATION_PREEMPT_TARGET, c.ANNOTATION_SCHED_EVICTED):
+            if _annotation_of(admin, low_name, a) is not None:
+                problems.append(f"{low_name}: {a} published during a flex")
+        queued = st.get_condition(
+            admin.tpujobs.get("default", low_name).status, c.JOB_QUEUED)
+        if queued is not None and queued.status == "True":
+            problems.append("the flexed gang was re-queued (lost admission)")
+
+        # 3. the flexed gang keeps TRAINING at the smaller world
+        _wait(lambda: wl_low.ledger.snapshot()["progress"]
+              > progress_at_pressure + 3, "training to continue while flexed")
+        text = _fetch(mon.port, "/metrics")
+        for family in ("tpujob_scheduler_flex_total",
+                       "tpujob_scheduler_defrag_moves_total",
+                       "tpujob_scheduler_fragmentation_ratio"):
+            if f"# HELP {family} " not in text:
+                problems.append(f"/metrics missing HELP {family}")
+        if 'tpujob_scheduler_flex_total{direction="shrink"}' not in text:
+            problems.append("flex shrink counter not exported")
+
+        # 4. the pressure clears: the grower restores the full shape
+        boss_gate.set()
+        _wait(lambda: _all_converged(admin, [cases[1]]), "the boss to finish")
+        _wait(lambda: len(_pods_of(low_name)) == 4, "the grow-back to 4 pods")
+        _wait(lambda: _annotation_of(
+            admin, low_name, c.ANNOTATION_FLEX_SLICES) is None,
+            "the flex annotation to clear")
+        asg = _assignment_of(admin, low_name)
+        if asg is None or len(asg.slices) != 2:
+            problems.append(f"assignment after grow-back: {asg} != 2 slices")
+
+        # 5. the restored gang trains to Succeeded; settle
+        low_gate.set()
+        _wait(lambda: _all_converged(admin, cases), "full convergence")
+        problems += _settle_invariants(admin, app.controller, cases, tracker,
+                                       chaos, deadline)
+        problems += _sched_job_problems(
+            admin, {low_name: wl_low, boss_name: wl_boss}, admissions)
+        problems += stall_tracker.problems()
+        restarts = _restarts_of(admin, low_name)
+        if restarts:
+            problems.append(
+                f"{low_name}: {restarts} counted restart(s) — a flex drain "
+                "must not register as a failure strike")
+        if wl_low.ledger.snapshot()["restores"]:
+            problems.append(
+                "the flexed gang restored from a checkpoint — a flex must "
+                "lose NOTHING (the coordinator never dies)")
+        order = [k.split("/", 1)[1] for k in admissions.order()]
+        if not order or order[0] != low_name:
+            problems.append(f"admission order {order}: low gang not first")
+        snap = app.scheduler.debug_snapshot()
+        if snap.get("flex_total", 0) < 2:
+            problems.append(
+                f"scheduler counted {snap.get('flex_total')} flex move(s), "
+                "expected the shrink AND the grow-back")
+        text = _fetch(mon.port, "/metrics")
+        if 'tpujob_scheduler_flex_total{direction="grow"}' not in text:
+            problems.append("flex grow counter not exported")
+        if problems:
+            raise AssertionError(
+                "flex smoke invariants violated:\n  " + "\n  ".join(problems))
+        return {
+            "mode": "flex-smoke",
+            "seed": seed,
+            "flex_values": flex_watch.snapshot(),
+            "flex_total": snap.get("flex_total"),
+            "drain_acks": wl_low.drain_acks,
+            "victim_ledger": {k: v for k, v in
+                              wl_low.ledger.snapshot().items()
+                              if k != "violations"},
+            "duration_s": round(time.monotonic() - started, 3),
+            "invariants": "ok",
+        }
+    finally:
+        trainer_stop.set()
+        boss_gate.set()
+        low_gate.set()
+        kubelet.stop()
+        mon.stop()
+        app.shutdown()
+
+
+def _fetch(port: int, path: str) -> str:
+    import urllib.request
+
+    url = f"http://127.0.0.1:{port}{path}"
+    with urllib.request.urlopen(url) as resp:  # noqa: S310 (local)
+        return resp.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# the soak
+# ---------------------------------------------------------------------------
+
+
+def _flex_matrix(prefix: str, admin: ClientSet, stop_event: threading.Event,
+                 finish_gate: threading.Event,
+                 ) -> Tuple[List[JobCase], Dict[str, SchedWorkload]]:
+    """An oversubscribed mixed-tier matrix (~8 slice-demand vs 4 slices)
+    built around flexible multislice gangs: a low-tier 3-slice gang with
+    a min-slices floor annotation, a normal-tier 2-slice gang with a spec
+    floor, a late high-tier 2-slice gang (created by the caller), and two
+    small fillers that keep the torus fragmenting as they churn."""
+    shapes = [
+        # (suffix, priority, workers, tpu dict, minSlices spec, steps)
+        ("f1", "low", 6, {"accelerator": "v4-16", "numSlices": 3}, None, 200),
+        ("f2", "", 4, {"accelerator": "v4-16", "numSlices": 2}, 1, 60),
+        ("hi", "high", 4, {"accelerator": "v4-16", "numSlices": 2}, None, 40),
+        ("s1", "", 2, {"accelerator": "v4-16"}, None, 30),
+        ("s2", "low", 1, None, None, 30),  # unpinned sub-slice
+    ]
+    cases: List[JobCase] = []
+    workloads: Dict[str, SchedWorkload] = {}
+    for suffix, priority, workers, tpu, min_slices, steps in shapes:
+        name = f"{prefix}-{suffix}"
+        spec: Dict[str, Any] = {
+            "runPolicy": {"backoffLimit": 60},
+            "tpuReplicaSpecs": {"Worker": {
+                "replicas": workers,
+                "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+                "template": _tmpl()}},
+        }
+        if tpu:
+            spec["tpuReplicaSpecs"]["Worker"]["tpu"] = tpu
+        if priority or min_slices is not None:
+            policy: Dict[str, Any] = {}
+            if priority:
+                policy["priorityClass"] = priority
+            if min_slices is not None:
+                policy["minSlices"] = min_slices
+            spec["runPolicy"]["schedulingPolicy"] = policy
+        job = _job(name, spec)
+        if suffix == "f1":
+            # the per-job floor override: this gang declares it can still
+            # rendezvous on a single slice, so the planner may flex it all
+            # the way down before ever considering a preemption
+            job.metadata.annotations = {c.ANNOTATION_MIN_SLICES: "1"}
+        wl = SchedWorkload(admin, name, total_steps=steps, tick_s=0.02,
+                           stop_event=stop_event, finish_gate=finish_gate,
+                           answer_drains=True)
+        cases.append(JobCase(job=job, scripts=wl.scripts(),
+                             expect_terminal="Succeeded"))
+        workloads[name] = wl
+    return cases, workloads
+
+
+def run_flex_soak(
+    seed: int,
+    config: Optional[ChaosConfig] = None,
+    kills: int = 1,
+    timeout: float = 120.0,
+) -> Dict[str, Any]:
+    """Elastic-capacity soak: the oversubscribed flexible matrix under the
+    full API fault schedule + a node storm + controller hard-kills, run
+    TWICE on the same seed — elastic planner on, then preempt-only — and
+    the flex run's cumulative fleet goodput ratio must STRICTLY beat the
+    preempt-only run's (invariant 19), with zero counted restarts and no
+    partial placement in either run (20, 21).
+
+    Runs under the lock-order sentinel (see ``run_soak``)."""
+    trace_started0, trace_closed0 = TRACER.counters()
+    with lockgraph.audit():
+        baseline = _run_flex_soak_inner(seed, config, kills, timeout,
+                                        flex_enabled=False)
+        flexed = _run_flex_soak_inner(seed, config, kills, timeout,
+                                      flex_enabled=True)
+        locks = _lock_audit_report(seed)
+    problems: List[str] = []
+    if not flexed["flex_values"]:
+        problems.append(
+            "the flex run never committed a flex-slices target — the "
+            "goodput comparison is vacuous")
+    if baseline["flex_values"]:
+        problems.append(
+            f"the preempt-only run flexed {baseline['flex_values']} with "
+            "the planner disabled")
+    if flexed["fleet_goodput_ratio"] <= baseline["fleet_goodput_ratio"]:
+        problems.append(
+            f"fleet goodput ratio {flexed['fleet_goodput_ratio']:.4f} "
+            f"(flex) does not strictly beat "
+            f"{baseline['fleet_goodput_ratio']:.4f} (preempt-only) on "
+            f"seed {seed} — graceful degradation lost to eviction")
+    if problems:
+        raise AssertionError(
+            f"seed {seed}: elastic-capacity invariants violated:\n  "
+            + "\n  ".join(problems))
+    trace_problems, trace_stats = check_trace_ledger(trace_started0,
+                                                     trace_closed0)
+    if trace_problems:
+        raise AssertionError(
+            f"seed {seed}: trace ledger violated across the flex soak:\n  "
+            + "\n  ".join(trace_problems))
+    return {
+        "mode": "flex",
+        "seed": seed,
+        "jobs": baseline["jobs"] + flexed["jobs"],
+        "fleet_goodput_ratio": flexed["fleet_goodput_ratio"],
+        "baseline_goodput_ratio": baseline["fleet_goodput_ratio"],
+        "flex_values": flexed["flex_values"],
+        "defrag_moves": flexed["defrag_moves"],
+        "duration_s": round(baseline["duration_s"] + flexed["duration_s"], 3),
+        "api_faults": baseline["api_faults"] + flexed["api_faults"],
+        "runs": [baseline, flexed],
+        "locks": locks,
+        "trace": trace_stats,
+        "invariants": "ok",
+    }
+
+
+def _run_flex_soak_inner(seed: int, config: Optional[ChaosConfig],
+                         kills: int, timeout: float,
+                         flex_enabled: bool) -> Dict[str, Any]:
+    trainer_stop = threading.Event()
+    finish_gate = threading.Event()
+    finish_gate.set()  # completions ARE the capacity churn
+    prefix, _, inner, chaos, admin, tracker, _ = _soak_harness(
+        seed, "fe" if flex_enabled else "fp", config, cases=[])
+    cases, workloads = _flex_matrix(prefix, admin, trainer_stop, finish_gate)
+    admissions = AdmissionTracker(FLEX_SOAK_CAPACITY)
+    stall_tracker = StallTracker()
+    flex_watch = _FlexWatch()
+    for hook in (admissions.hook, stall_tracker.hook, flex_watch.hook):
+        inner.hooks.append(hook)
+    scripts = [s for case in cases for s in case.scripts]
+    rng = random.Random(f"{seed}:flex-storm")
+    started = time.monotonic()
+
+    overrides = dict(FLEX_SOAK_OVERRIDES)
+    if not flex_enabled:
+        overrides["scheduler_flex"] = False
+        overrides["scheduler_defrag"] = False
+    grace = overrides["node_grace_s"]
+    agent = NodeAgentSim(admin, interval_s=0.1)
+    storm = NodeStorm(admin, agent, seed, grace_s=grace)
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts,
+                         node_down=storm.host_down)
+    app = _start_app(chaos, overrides)
+    app_holder = {"app": app}
+    sampler = _GoodputSampler(
+        [f"default/{case.job.metadata.name}" for case in cases],
+        lambda: app_holder["app"].controller.goodput).start()
+    kubelet.start()
+    agent.start()
+    kill_log: List[Dict[str, float]] = []
+    defrag_moves = 0
+    try:
+        if not _wait_for(lambda: len(admin.nodes.list()) == 8, timeout=20.0):
+            raise AssertionError(
+                f"seed {seed}: node inventory never bootstrapped")
+        # staggered submission: the flexible gangs and fillers soak the
+        # fleet first, then the high-tier 2-slice gang arrives — pressure
+        # the elastic planner must absorb by shrinking, the preempt-only
+        # baseline by evicting
+        for case in cases:
+            if not case.job.metadata.name.endswith("-hi"):
+                admin.tpujobs.create(case.job)
+        time.sleep(rng.uniform(0.4, 0.8))
+        hi = next(case for case in cases
+                  if case.job.metadata.name.endswith("-hi"))
+        admin.tpujobs.create(hi.job)
+        # the node storm: a flap strictly inside one grace window, cordon
+        # churn, and a whole-slice outage that recovers — host-level chaos
+        # layered over the capacity pressure (hard host DEATH lives in the
+        # node tier; here every host comes back, so the two runs stay
+        # capacity-comparable end to end)
+        slices = rng.sample(range(4), 4)
+        host = lambda si, h: f"v4-16-p0-s{si}-h{h}"  # noqa: E731
+        time.sleep(rng.uniform(0.3, 0.6))
+        storm.flap(host(slices[0], rng.randrange(2)))
+        cordon_target = host(slices[1], rng.randrange(2))
+        storm.cordon(cordon_target)
+        for _ in range(kills):
+            # seeded mid-pressure hard kill: a flex publish, drain barrier
+            # or defrag migration may be mid-protocol — the restarted
+            # scheduler must resume it from the committed annotations
+            time.sleep(rng.uniform(0.5, 1.0))
+            defrag_moves += app.scheduler.debug_snapshot().get(
+                "defrag_moves_total", 0)
+            app.hard_kill()
+            headless_s = rng.uniform(0.05, 0.4)
+            time.sleep(headless_s)
+            app = _start_app(chaos, overrides)
+            app_holder["app"] = app
+            kill_log.append({"headless_s": round(headless_s, 3)})
+        outage = [host(slices[2], 0), host(slices[2], 1)]
+        storm.slice_outage(outage)
+        time.sleep(rng.uniform(1.5, 2.5) * grace)
+        storm.revive(outage)
+        storm.cordon(cordon_target, cordoned=False)
+        deadline = started + timeout
+        _converge_or_fail(admin, cases, deadline, seed, f" within {timeout}s")
+        problems = _settle_invariants(admin, app.controller, cases, tracker,
+                                      chaos, deadline)
+        problems += _sched_job_problems(admin, workloads, admissions)
+        problems += stall_tracker.problems()
+        for case in cases:
+            restarts = _restarts_of(admin, case.job.metadata.name)
+            if restarts:
+                problems.append(
+                    f"{case.job.metadata.name}: {restarts} counted "
+                    "restart(s) — flex drains, defrag migrations, "
+                    "preemptions and node losses all ride the checkpoint "
+                    "barrier and must never register as failure strikes")
+        if problems:
+            raise AssertionError(
+                f"seed {seed}: flex-soak invariants violated "
+                f"({'flex' if flex_enabled else 'preempt-only'} run):\n  "
+                + "\n  ".join(problems))
+        defrag_moves += app.scheduler.debug_snapshot().get(
+            "defrag_moves_total", 0)
+        report = {
+            "mode": "flex-inner",
+            "planner": "flex" if flex_enabled else "preempt-only",
+            "seed": seed,
+            "jobs": len(cases),
+            "controller_kills": kills,
+            "kill_schedule": kill_log,
+            "admissions": len(admissions.order()),
+            "preempted": sorted(admissions.preempted),
+            "flex_values": flex_watch.snapshot(),
+            "defrag_moves": defrag_moves,
+            "fleet_goodput_ratio": round(sampler.fleet_ratio(), 4),
+            "storm": storm.log,
+            "duration_s": round(time.monotonic() - started, 3),
+            "api_faults": len(chaos.injected),
+            "invariants": "ok",
+        }
+    finally:
+        trainer_stop.set()
+        finish_gate.set()
+        sampler.stop()
+        agent.stop()
+        kubelet.stop()
+        app.shutdown()
+    return report

@@ -181,6 +181,7 @@ class SchedWorkload:
         stop_event: Optional[threading.Event] = None,
         finish_gate: Optional[threading.Event] = None,
         heartbeat_interval_s: float = 0.1,
+        answer_drains: bool = False,
     ):
         self.admin = admin
         self.job_name = job_name
@@ -196,6 +197,12 @@ class SchedWorkload:
         self.ledger = SchedLedger(job_name)
         self.acked = 0  # barrier acks written (informational)
         self.heartbeat_interval_s = heartbeat_interval_s
+        # answer the staged-drain checkpoint barrier (a target-world-size
+        # publish from a spec shrink OR a scheduler flex) instead of
+        # letting the reconciler's drain grace expire.  Opt-in: the
+        # goodput tier deliberately exercises the grace-timeout path
+        self.answer_drains = answer_drains
+        self.drain_acks = 0  # drain barrier acks written (informational)
 
     def _annotations(self) -> Optional[Dict[str, str]]:
         try:
@@ -222,6 +229,20 @@ class SchedWorkload:
                 {"metadata": {"annotations": {
                     c.ANNOTATION_PREEMPT_ACK: "1"}}})
             self.acked += 1
+        except ApiError:
+            pass  # retried next tick
+
+    def _ack_drain(self, annotations: Dict[str, str]) -> None:
+        target = annotations.get(c.ANNOTATION_TARGET_WORLD_SIZE)
+        if target is None \
+                or annotations.get(c.ANNOTATION_CHECKPOINT_ACK) == target:
+            return
+        try:
+            self.admin.server.patch(
+                RESOURCE_TPUJOBS, self.ns, self.job_name,
+                {"metadata": {"annotations": {
+                    c.ANNOTATION_CHECKPOINT_ACK: target}}})
+            self.drain_acks += 1
         except ApiError:
             pass  # retried next tick
 
@@ -254,6 +275,15 @@ class SchedWorkload:
                     self._ack(annotations)
             elif annotations.get(c.ANNOTATION_SCHED_EVICTED) is not None:
                 led.barrier()  # stay paused: the pod is about to die
+            elif (self.answer_drains and annotations.get(
+                    c.ANNOTATION_TARGET_WORLD_SIZE) is not None):
+                # a staged shrink (spec resize or scheduler flex): hit the
+                # checkpoint barrier and (coordinator) ack with the target
+                # world; survivors resume when the reconciler clears the
+                # target after deleting the drained pods
+                led.barrier()
+                if pid == 0:
+                    self._ack_drain(annotations)
             else:
                 led.resume()
                 if pid == 0:
@@ -338,13 +368,30 @@ class AdmissionTracker:
         except Exception:  # noqa: TPL005 - a job mutated into garbage
             req = None  # mid-run is another invariant's problem
         if req is not None:
-            if len(asg.slices) != req.num_slices or any(
+            # a scheduler-flexed gang legitimately holds FEWER slices than
+            # its spec shape: anywhere from the published flex target (the
+            # post-drain trim) up to the full request (mid-drain, before
+            # the highest slices vacate).  Anything outside that range —
+            # or a slice of the wrong host width — is a partial grant.
+            floor = req.num_slices
+            raw_flex = ((obj.get("metadata") or {}).get("annotations")
+                        or {}).get(c.ANNOTATION_FLEX_SLICES)
+            if raw_flex is not None:
+                try:
+                    flex = int(raw_flex)
+                except ValueError:
+                    flex = None
+                if flex is not None and 1 <= flex < req.num_slices:
+                    floor = flex
+            if not (floor <= len(asg.slices) <= req.num_slices) or any(
                     s.host_hi - s.host_lo != req.hosts_per_slice
                     for s in asg.slices):
                 self.violations.append(
                     f"{key}: PARTIAL admission: granted "
                     f"{[(s.slice_index, s.host_lo, s.host_hi) for s in asg.slices]}"
-                    f" for a {req.num_slices}x{req.hosts_per_slice}-host gang")
+                    f" for a {req.num_slices}x{req.hosts_per_slice}-host gang"
+                    + (f" (flex target {raw_flex})"
+                       if raw_flex is not None else ""))
         for s in asg.slices:
             if s.pool >= len(self.pools) \
                     or s.slice_index >= self.pools[s.pool].count \
@@ -471,6 +518,13 @@ SCHED_OPT_OVERRIDES = dict(
     scheduler_tick_s=0.05,
     scheduler_aging_s=1.0,
     scheduler_preempt_grace_s=1.0,
+    # this tier pins the PREEMPT-ONLY ladder: its invariants (victim
+    # evicted, admission order, checkpoint-safe restore) are about full
+    # preemption, and they double as the elastic tier's comparison
+    # baseline — num_slices flex and torus defrag get their own tier
+    # (e2e/flex.py, `make flex-smoke` / soak --flex)
+    scheduler_flex=False,
+    scheduler_defrag=False,
     stall_timeout_s=5.0,
     stall_check_interval_s=0.5,
 )

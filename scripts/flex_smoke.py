@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Elastic-capacity smoke: pressure flexes a gang instead of evicting it.
+
+The fast acceptance gate of the elastic capacity optimizer (``make
+flex-smoke``, wired as a ``make test`` prerequisite; budget ~6 s):
+
+- a low-tier 2-slice gang soaks the whole fleet and trains; a high-tier
+  single-slice gang arrives and the planner publishes a flex target
+  instead of a preemption — the gang gives up its highest slice through
+  the staged-drain checkpoint barrier (the REAL workload loop acks the
+  target world), keeps its two leading workers, and keeps TRAINING;
+- zero counted restarts, zero checkpoint restores (the coordinator never
+  dies — a flex loses nothing at all), never evicted, and the flex-aware
+  AdmissionTracker holds no-partial-placement at every committed instant;
+- once the high-tier job finishes, the background grower restores the
+  full 2-slice shape (annotation cleared, 4 pods back) and the gang
+  trains to Succeeded;
+- the ``tpujob_scheduler_flex_total{direction=...}`` counters and the
+  fragmentation gauge export on the real ``/metrics`` listener.
+
+No API-transport faults here — the oversubscribed flexible matrix under
+the full fault schedule + node storm + controller kills runs in
+``soak.py --flex``; this smoke isolates the flex protocol so a failure
+points straight at it.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from e2e.flex import run_flex_smoke
+
+
+def main() -> int:
+    logging.disable(logging.CRITICAL)
+    report = run_flex_smoke(seed=19)
+    assert report["invariants"] == "ok"
+    ledger = report["victim_ledger"]
+    print(f"flex-smoke: OK (flex targets {report['flex_values']}, "
+          f"{report['flex_total']} flex move(s), "
+          f"{report['drain_acks']} drain ack(s), victim trained "
+          f"{ledger['progress']} steps with 0 restarts/restores, "
+          f"in {report['duration_s']}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,12 +20,16 @@ checkpoint, never a duplicate pod at any instant, every resize converges),
 and the gang-scheduler storm (``run_sched_soak``: an oversubscribed
 admission queue + seeded preemption under faults and a controller kill;
 no gang ever partially admitted, no starvation past fair share + aging,
-every scheduled eviction checkpoint-safe)
+every scheduled eviction checkpoint-safe), and the elastic-capacity tier
+(``run_flex_soak``: the oversubscribed flexible matrix run twice on the
+same seed, elastic planner vs preempt-only; the flex run's cumulative
+fleet goodput ratio must strictly win, with zero counted restarts and no
+partial placement in either run)
 — the crash-only acceptance gate: all invariants hold across every kill,
 zero writes are accepted from a fenced leader or a deposed shard owner,
 and every job is synced by exactly one owner per shard-lease generation.
 ``--resize`` runs just the resize tier on top of the API tier;
-``--sched`` just the scheduler tier.
+``--sched`` just the scheduler tier; ``--flex`` just the elastic tier.
 
 Usage:
     python soak.py                      # default 5 seeds x 5 jobs = 25 jobs
@@ -51,6 +55,7 @@ from e2e.chaos import (
     run_shard_soak,
     run_soak,
 )
+from e2e.flex import run_flex_soak
 from e2e.nodes import run_node_soak
 from e2e.scheduler import run_sched_soak
 
@@ -78,6 +83,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "heartbeat flap, cordon churn, whole-slice "
                              "outage + gang migration) for every seed "
                              "(included in --crash)")
+    parser.add_argument("--flex", action="store_true",
+                        help="also run the elastic-capacity tier "
+                             "(num_slices flex + torus defrag vs a "
+                             "preempt-only baseline on the same seed) for "
+                             "every seed (included in --crash)")
     parser.add_argument("--timeout", type=float, default=60.0,
                         help="per-seed convergence timeout (s)")
     parser.add_argument("--verbose", action="store_true",
@@ -125,6 +135,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the barrier checkpoint with zero counted restarts, the flap
         # changes nothing.  Same deadline floor as the resize/sched tiers.
         runs.append(("nodes", lambda seed: run_node_soak(
+            seed, timeout=max(args.timeout, 120.0))))
+    if args.crash or args.flex:
+        # elastic-capacity tier: the oversubscribed flexible matrix under
+        # the full fault schedule + a node storm + a controller hard-kill,
+        # run twice per seed on the same schedule (elastic planner on,
+        # then preempt-only); invariants: the flex run's cumulative fleet
+        # goodput ratio strictly beats the preempt-only run's, every
+        # flex/defrag move completes with zero counted restarts, and no
+        # gang is partially placed at any committed instant.  Same
+        # deadline floor as the other heavy tiers — and it runs the
+        # matrix twice, so the floor covers each run separately.
+        runs.append(("flex", lambda seed: run_flex_soak(
             seed, timeout=max(args.timeout, 120.0))))
 
     failures = 0

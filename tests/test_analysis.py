@@ -773,12 +773,14 @@ _TPL200_FILES = (
     "tpujob/api/constants.py",
     "tpujob/api/progress.py",
     "tpujob/api/nodes.py",
+    "tpujob/controller/barrier.py",
     "tpujob/controller/reconciler.py",
     "tpujob/server/inventory.py",
     "tpujob/server/scheduler.py",
     "tpujob/workloads/distributed.py",
     "e2e/chaos.py",
     "e2e/elastic.py",
+    "e2e/flex.py",
     "e2e/nodes.py",
     "e2e/scheduler.py",
     "bench_controller.py",

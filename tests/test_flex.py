@@ -1,0 +1,432 @@
+"""Elastic capacity optimizer: num_slices flex + torus defragmentation.
+
+The pressure ladder (flex < migrate < preempt): under capacity pressure
+the scheduler shrinks a lower-tier multislice gang by slices through the
+staged-resize drain (checkpoint barrier, zero failure strikes) instead of
+evicting it; a background grower flexes shrunk gangs back into idle
+capacity; and a shard-0 defragmenter compacts shredded free intervals by
+migrating small gangs so large contiguous gangs become placeable.  Plus
+the seeded shrinking-counterexample property test for the defrag planner.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+import pytest
+
+from jobtestutil import Harness, new_tpujob
+from tpujob.api import constants as c
+from tpujob.api.quota import GangRequest, parse_capacity
+from tpujob.api.types import RunPolicy, TPUJob
+from tpujob.controller.job_base import ControllerConfig
+from tpujob.kube.client import RESOURCE_TPUJOBS
+from tpujob.server.scheduler import (
+    Assignment,
+    CapacityModel,
+    GangScheduler,
+    fragmentation_ratio,
+    fragmentation_stats,
+    plan_defrag,
+    trimmed_assignment,
+)
+
+
+def flex_job(name: str, num_slices: int = 2, priority: str = "low",
+             min_slices: Optional[int] = None,
+             hosts_per_slice: int = 2) -> TPUJob:
+    """Master-less multislice v4-16 job (2 hosts per slice)."""
+    job = new_tpujob(name=name, master=None,
+                     workers=num_slices * hosts_per_slice,
+                     accelerator="v4-16", num_slices=num_slices,
+                     restart_policy="ExitCode", backoff_limit=20)
+    sp: Dict[str, object] = {"priorityClass": priority}
+    if min_slices is not None:
+        sp["minSlices"] = min_slices
+    job.spec.run_policy = RunPolicy.from_dict({"schedulingPolicy": sp})
+    return job
+
+
+def flex_harness(capacity: str = "v4-16x2", grace: float = 0.0,
+                 **sched_kw):
+    h = Harness(config=ControllerConfig(settle_window_s=0.0,
+                                        resize_drain_grace_s=grace))
+    sched = GangScheduler(h.controller, capacity, aging_s=0.0,
+                          preempt_grace_s=0.0, **sched_kw)
+    h.controller.set_scheduler(sched)
+    return h, sched
+
+
+def step(h, sched, rounds: int = 2):
+    for _ in range(rounds):
+        h.controller.factory.sync_all()
+        sched.tick()
+        h.sync()
+
+
+def run_workers(h, name: str, n: int, start: int = 0):
+    for i in range(start, n):
+        h.set_pod_phase(name, c.REPLICA_TYPE_WORKER, i, "Running")
+    h.sync()
+
+
+def _ack(h, name: str, target_world: int):
+    h.clients.server.patch(RESOURCE_TPUJOBS, "default", name, {
+        "metadata": {"annotations": {
+            c.ANNOTATION_CHECKPOINT_ACK: str(target_world)}}})
+
+
+def _asg(job: TPUJob) -> Optional[Assignment]:
+    raw = (job.metadata.annotations or {}).get(c.ANNOTATION_SCHED_ASSIGNMENT)
+    return Assignment.from_json(raw) if raw else None
+
+
+# ---------------------------------------------------------------------------
+# the flex shrink path (pressure degrades, never partially places)
+# ---------------------------------------------------------------------------
+
+
+def test_pressure_flexes_low_tier_instead_of_evicting():
+    """THE tentpole flow: a high-tier arrival on a full fleet shrinks the
+    low-tier 2-slice gang to 1 slice through the staged drain — zero
+    failure strikes, no eviction — and the freed slice admits the
+    high-tier gang with no partial placement at any committed instant."""
+    h, sched = flex_harness(grace=30.0)
+    h.submit(flex_job("low", num_slices=2))
+    step(h, sched)
+    run_workers(h, "low", 4)
+    step(h, sched)
+    low = h.get_job("low")
+    assert len(_asg(low).slices) == 2  # admitted at full shape
+
+    h.submit(flex_job("boss", num_slices=1, priority="high"))
+    step(h, sched)
+    low = h.get_job("low")
+    ann = low.metadata.annotations or {}
+    # flexed, NOT evicted: the gang keeps its assignment and its pods
+    assert ann.get(c.ANNOTATION_FLEX_SLICES) == "1"
+    assert ann.get(c.ANNOTATION_SCHED_EVICTED) is None
+    assert ann.get(c.ANNOTATION_PREEMPT_TARGET) is None
+    # the drain staged toward the flexed world behind the barrier: the
+    # assignment is still FULL (capacity frees when pods are gone, not
+    # before) and the high-tier gang is still queued — no partial instant
+    assert ann.get(c.ANNOTATION_TARGET_WORLD_SIZE) == "2"
+    assert len(_asg(low).slices) == 2
+    assert _asg(h.get_job("boss")) is None
+
+    _ack(h, "low", 2)
+    step(h, sched, rounds=3)
+    low, boss = h.get_job("low"), h.get_job("boss")
+    ann = low.metadata.annotations or {}
+    # drain complete: world republished small, assignment trimmed, the
+    # freed slice admitted the high-tier gang
+    assert ann.get(c.ANNOTATION_WORLD_SIZE) == "2"
+    assert ann.get(c.ANNOTATION_TARGET_WORLD_SIZE) is None
+    assert len(_asg(low).slices) == 1
+    assert len(_asg(boss).slices) == 1
+    # zero counted restarts: the drain deletions were not failure strikes
+    rs = low.status.replica_statuses.get(c.REPLICA_TYPE_WORKER)
+    assert rs is not None and rs.restarts == 0
+    # the two assignments never overlap (no double-booking)
+    cap = CapacityModel(parse_capacity("v4-16x2"))
+    assert cap.reserve("low", _asg(low)) == []
+    assert cap.reserve("boss", _asg(boss)) == []
+    assert sched.flexes >= 1 and sched.debug_snapshot()["flex_total"] >= 1
+
+
+def test_flex_floor_min_slices_forces_preemption():
+    """A gang whose declared floor equals its shape cannot shrink: the
+    planner falls back to the preemption ladder (the floor is a promise —
+    below minSlices the job cannot make progress, so evict-and-requeue
+    beats a useless shrink)."""
+    h, sched = flex_harness()
+    h.submit(flex_job("pinned", num_slices=2, min_slices=2))
+    step(h, sched)
+    run_workers(h, "pinned", 4)
+    h.submit(flex_job("boss", num_slices=1, priority="high"))
+    step(h, sched)
+    ann = h.get_job("pinned").metadata.annotations or {}
+    assert ann.get(c.ANNOTATION_FLEX_SLICES) is None
+    assert ann.get(c.ANNOTATION_PREEMPT_TARGET) is not None
+
+
+def test_flex_floor_annotation_overrides_spec():
+    """The per-job min-slices annotation outranks schedulingPolicy."""
+    h, sched = flex_harness()
+    job = flex_job("anno", num_slices=2, min_slices=1)
+    job.metadata.annotations = {c.ANNOTATION_MIN_SLICES: "2"}
+    h.submit(job)
+    step(h, sched)
+    run_workers(h, "anno", 4)
+    h.submit(flex_job("boss", num_slices=1, priority="high"))
+    step(h, sched)
+    ann = h.get_job("anno").metadata.annotations or {}
+    assert ann.get(c.ANNOTATION_FLEX_SLICES) is None
+    assert ann.get(c.ANNOTATION_PREEMPT_TARGET) is not None
+
+
+def test_flex_disabled_falls_back_to_preemption():
+    h, sched = flex_harness(enable_flex=False)
+    h.submit(flex_job("low", num_slices=2))
+    step(h, sched)
+    run_workers(h, "low", 4)
+    h.submit(flex_job("boss", num_slices=1, priority="high"))
+    step(h, sched)
+    ann = h.get_job("low").metadata.annotations or {}
+    assert ann.get(c.ANNOTATION_FLEX_SLICES) is None
+    assert ann.get(c.ANNOTATION_PREEMPT_TARGET) is not None
+
+
+def test_grower_restores_flexed_gang_when_pressure_clears():
+    """The background grower: once the high-tier gang finishes, the
+    flexed gang grows back to its spec shape (one slice per idle tick,
+    assignment + flex target in ONE patch) and the reconciler re-joins
+    the restored replicas."""
+    h, sched = flex_harness(grace=0.0)
+    h.submit(flex_job("low", num_slices=2))
+    step(h, sched)
+    run_workers(h, "low", 4)
+    h.submit(flex_job("boss", num_slices=1, priority="high"))
+    step(h, sched, rounds=4)
+    low = h.get_job("low")
+    assert len(_asg(low).slices) == 1  # shrink committed (grace 0)
+    # high-tier gang finishes -> its slice frees -> the grower restores
+    run_workers(h, "boss", 2)
+    for i in range(2):
+        h.set_pod_phase("boss", c.REPLICA_TYPE_WORKER, i, "Succeeded")
+    step(h, sched, rounds=4)
+    low = h.get_job("low")
+    ann = low.metadata.annotations or {}
+    assert len(_asg(low).slices) == 2  # grown back to spec
+    assert ann.get(c.ANNOTATION_FLEX_SLICES) is None  # restored: no flex
+    # the reconciler re-created the joined replicas
+    assert sum(1 for p in h.clients.pods.list()
+               if p.metadata.labels.get("tpujob.dev/job-name") == "low"
+               or "low-worker" in p.metadata.name) >= 4
+    rs = low.status.replica_statuses.get(c.REPLICA_TYPE_WORKER)
+    assert rs is not None and rs.restarts == 0
+
+
+def test_release_clears_flex_annotation():
+    """A finished (or evicted) gang re-admits at its FULL spec shape: the
+    release null-patch consumes the flex annotation with the assignment."""
+    h, sched = flex_harness(grace=30.0)
+    h.submit(flex_job("low", num_slices=2))
+    step(h, sched)
+    run_workers(h, "low", 4)
+    h.submit(flex_job("boss", num_slices=1, priority="high"))
+    step(h, sched)
+    assert (h.get_job("low").metadata.annotations or {}).get(
+        c.ANNOTATION_FLEX_SLICES) == "1"
+    for i in range(4):
+        h.set_pod_phase("low", c.REPLICA_TYPE_WORKER, i, "Succeeded")
+    step(h, sched, rounds=3)
+    ann = h.get_job("low").metadata.annotations or {}
+    assert ann.get(c.ANNOTATION_SCHED_ASSIGNMENT) is None
+    assert ann.get(c.ANNOTATION_FLEX_SLICES) is None
+
+
+def test_planner_prefers_flex_over_preempt_at_equal_tier():
+    """Two low-tier victims, one multislice: the planner shrinks the
+    multislice gang (restore cost only) instead of evicting the other
+    (full projected loss) — flex < preempt by construction."""
+    h, sched = flex_harness(capacity="v4-16x3")
+    h.submit(flex_job("multi", num_slices=2))
+    h.submit(flex_job("single", num_slices=1))
+    step(h, sched)
+    run_workers(h, "multi", 4)
+    run_workers(h, "single", 2)
+    h.submit(flex_job("boss", num_slices=1, priority="high"))
+    step(h, sched)
+    multi = h.get_job("multi").metadata.annotations or {}
+    single = h.get_job("single").metadata.annotations or {}
+    assert multi.get(c.ANNOTATION_FLEX_SLICES) == "1"
+    assert single.get(c.ANNOTATION_PREEMPT_TARGET) is None
+    assert single.get(c.ANNOTATION_SCHED_EVICTED) is None
+
+
+# ---------------------------------------------------------------------------
+# the defrag planner (pure; + the scheduler's gauge)
+# ---------------------------------------------------------------------------
+
+
+def _dreq(name: str, num_slices: int = 1, hosts: int = 2) -> GangRequest:
+    return GangRequest(namespace="default", name=name, generation=None,
+                       accelerator=None, num_slices=num_slices,
+                       hosts_per_slice=hosts, tier=1)
+
+
+def test_plan_defrag_compacts_a_hole():
+    """A released middle gang leaves two 2-host fragments; moving the
+    tail gang into the hole merges them into one 4-host run."""
+    cap = CapacityModel(parse_capacity("v4-64x1"))  # 1 slice x 8 hosts
+    a = cap.place(_dreq("default/a"), "default/a")
+    b = cap.place(_dreq("default/b"), "default/b")
+    cc = cap.place(_dreq("default/c"), "default/c")
+    assert a and b and cc
+    cap.release("default/b")
+    assert fragmentation_stats(cap) == (2, 4)
+    assert fragmentation_ratio(cap) == 0.5
+    plan = plan_defrag(cap, [("default/c", cc, _dreq("default/c"))])
+    assert len(plan) == 1 and plan[0].key == "default/c"
+    sim = cap.clone()
+    sim.release("default/c")
+    assert sim.reserve("default/c", plan[0].dst) == []
+    assert fragmentation_stats(sim) == (4, 4)
+    assert fragmentation_ratio(sim) == 0.0
+
+
+def test_plan_defrag_refuses_churn():
+    """No strict largest-run gain -> no move (a checkpoint barrier is
+    never worth shuffling equal fragments), and a full or compact fleet
+    plans nothing."""
+    cap = CapacityModel(parse_capacity("v4-64x1"))
+    a = cap.place(_dreq("default/a"), "default/a")
+    assert fragmentation_ratio(cap) == 0.0  # one contiguous free run
+    assert plan_defrag(cap, [("default/a", a, _dreq("default/a"))]) == []
+
+
+def test_fragmentation_ratio_of_full_fleet_is_zero():
+    cap = CapacityModel(parse_capacity("v4-16x1"))
+    cap.place(_dreq("default/a", hosts=2), "default/a")
+    assert fragmentation_stats(cap)[1] == 0
+    assert fragmentation_ratio(cap) == 0.0  # busy, not fragmented
+
+
+# ---------------------------------------------------------------------------
+# the seeded shrinking-counterexample property test (PR-12 idiom): no plan
+# reduces placeable contiguous capacity, no move overlaps a live
+# reservation, and the moves are executable in the order emitted
+# ---------------------------------------------------------------------------
+
+Op = Tuple  # ("place", owner, num_slices, hosts) | ("release", owner)
+
+_PROP_POOLS = parse_capacity("v4-64x2")  # 2 slices x 8 hosts
+
+
+def _gen_ops(rng: random.Random, n: int) -> List[Op]:
+    ops: List[Op] = []
+    owners = [f"default/g{i}" for i in range(8)]
+    for _ in range(n):
+        if rng.random() < 0.6:
+            ops.append(("place", rng.choice(owners),
+                        rng.choice([1, 1, 1, 2]),
+                        rng.choice([1, 1, 2, 2, 3])))
+        else:
+            ops.append(("release", rng.choice(owners)))
+    return ops
+
+
+def _check_plan(cap: CapacityModel,
+                gangs: List[Tuple[str, Assignment, GangRequest]],
+                max_moves: int) -> Optional[str]:
+    """One planner invocation's invariants (None = clean)."""
+    base_largest, base_total = fragmentation_stats(cap)
+    plan = plan_defrag(cap, gangs, max_moves=max_moves)
+    live = {k: (a, r) for k, a, r in gangs}
+    sim = cap.clone()
+    prev_largest = base_largest
+    for mv in plan:
+        if mv.key not in live:
+            return f"planned a move of unknown gang {mv.key}"
+        _, req = live[mv.key]
+        if (len(mv.dst.slices) != req.num_slices
+                or any(s.host_hi - s.host_lo != req.hosts_per_slice
+                       for s in mv.dst.slices)):
+            return f"move of {mv.key} changed the gang's shape: {mv.dst}"
+        sim.release(mv.key)
+        conflicts = sim.reserve(mv.key, mv.dst)
+        if conflicts:
+            return (f"move of {mv.key} overlaps live reservations: "
+                    f"{conflicts}")
+        largest, total = fragmentation_stats(sim)
+        if total != base_total:
+            return (f"total free hosts changed {base_total} -> {total} "
+                    f"(a move must preserve capacity)")
+        if largest <= prev_largest:
+            return (f"move of {mv.key} did not strictly grow the largest "
+                    f"free run ({prev_largest} -> {largest})")
+        prev_largest = largest
+    return None
+
+
+def _run_ops(ops: List[Op]) -> Optional[str]:
+    """Replay one interleaving; after every op, the defrag planner must
+    satisfy its invariants against the live occupancy."""
+    assignments: Dict[str, Assignment] = {}
+    reqs: Dict[str, GangRequest] = {}
+
+    def rebuild() -> Tuple[CapacityModel, Optional[str]]:
+        cap = CapacityModel(_PROP_POOLS)
+        for owner, asg in assignments.items():
+            conflicts = cap.reserve(owner, asg)
+            if conflicts:
+                return cap, f"double-booking: {conflicts}"
+        return cap, None
+
+    for i, op in enumerate(ops):
+        if op[0] == "place":
+            _, owner, num_slices, hosts = op
+            if owner in assignments:
+                continue
+            cap, err = rebuild()
+            if err:
+                return f"op {i} {op}: {err}"
+            req = _dreq(owner, num_slices, hosts)
+            asg = cap.place(req, owner)
+            if asg is None:
+                continue
+            assignments[owner] = asg
+            reqs[owner] = req
+        else:
+            assignments.pop(op[1], None)
+            reqs.pop(op[1], None)
+        cap, err = rebuild()
+        if err:
+            return f"op {i} {op}: {err}"
+        gangs = [(o, assignments[o], reqs[o]) for o in sorted(assignments)]
+        for max_moves in (1, 3):
+            err = _check_plan(cap, gangs, max_moves)
+            if err:
+                return f"op {i} {op} (max_moves={max_moves}): {err}"
+    return None
+
+
+def _shrink(ops: List[Op]) -> List[Op]:
+    """Greedy 1-minimal shrink: drop ops while the failure persists."""
+    i = 0
+    while i < len(ops):
+        candidate = ops[:i] + ops[i + 1:]
+        if _run_ops(candidate) is not None:
+            ops = candidate
+        else:
+            i += 1
+    return ops
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_defrag_planner_property(seed):
+    rng = random.Random(f"defrag-prop:{seed}")
+    ops = _gen_ops(rng, 40)
+    err = _run_ops(ops)
+    if err is not None:
+        minimal = _shrink(list(ops))
+        pytest.fail(
+            f"seed {seed}: {err}\nshrunk counterexample "
+            f"({len(minimal)} op(s)): {minimal}\n"
+            f"final error: {_run_ops(minimal)}")
+
+
+# ---------------------------------------------------------------------------
+# trimmed_assignment arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_trimmed_assignment_keeps_leading_slices_and_scales_chips():
+    cap = CapacityModel(parse_capacity("v4-16x3"))
+    asg = cap.place(_dreq("default/m", num_slices=3, hosts=2), "default/m")
+    assert asg is not None and len(asg.slices) == 3
+    t = trimmed_assignment(asg, 1)
+    assert t.slices == asg.slices[:1]
+    assert t.chips == asg.chips // 3
+    assert t.accelerator == asg.accelerator

@@ -569,16 +569,17 @@ def test_victim_choice_flips_on_projected_goodput_loss():
                 gp.PHASE_TRAINING, now=t0, step=0)
     led.observe("default/slow-vic", "default", "slow-vic", "-",
                 gp.PHASE_TRAINING, now=t0 + 100, step=10)
-    h.controller.telemetry.ingest(
-        "default/fast-vic", "default", "fast-vic", "-", "fast-vic-worker-0",
-        "step=1000 ckpt=900", __import__(
-            "tpujob.api.progress", fromlist=["parse_progress"]
-        ).parse_progress("step=1000 ckpt=900"))
-    h.controller.telemetry.ingest(
-        "default/slow-vic", "default", "slow-vic", "-", "slow-vic-worker-0",
-        "step=10 ckpt=0", __import__(
-            "tpujob.api.progress", fromlist=["parse_progress"]
-        ).parse_progress("step=10 ckpt=0"))
+    # step/ckpt progress rides the POD heartbeat annotations — the one
+    # parser every member prices from (the tracker is never consulted)
+    h.server.patch(
+        RESOURCE_PODS, "default",
+        gen_general_name("fast-vic", c.REPLICA_TYPE_WORKER, 0),
+        pod_progress_patch(format_progress(1000, checkpoint_step=900)))
+    h.server.patch(
+        RESOURCE_PODS, "default",
+        gen_general_name("slow-vic", c.REPLICA_TYPE_WORKER, 0),
+        pod_progress_patch(format_progress(10, checkpoint_step=0)))
+    h.controller.factory.sync_all()
     # raw ordering would pick slow-vic (10 < 100 steps at risk); projected
     # loss picks fast-vic (10s < 100s)
     assert sched._victim_cost("default/fast-vic") \
@@ -626,6 +627,52 @@ def test_goodput_view_heartbeat_fallback_is_the_one_parser():
     ann = {c.ANNOTATION_PREEMPT_TARGET: st.now_iso()}
     assert sched._barrier_passed("default/vic", ann, time.monotonic(),
                                  time.time()) is True
+
+
+def test_victim_pricing_is_symmetric_across_tracker_ownership():
+    """Regression (sharded-fleet pricing asymmetry): the member that OWNS a
+    job's telemetry shard must price it exactly like a member that does not
+    — both read step/ckpt from the shared pod-cache heartbeat parser, so a
+    stale local tracker row can never skew the fleet-wide victim choice."""
+    h = Harness(config=ControllerConfig(settle_window_s=0.0))
+    sched = GangScheduler(h.controller, "v4-16x1", preempt_grace_s=0.0)
+    h.controller.set_scheduler(sched)
+    for _ in range(2):
+        h.controller.factory.sync_all()
+        sched.tick()
+        h.sync()
+    h.submit(_sched_job("vic"))
+    for _ in range(2):
+        h.controller.factory.sync_all()
+        sched.tick()
+        h.sync()
+    led = h.controller.goodput
+    t0 = time.monotonic() - 200.0
+    led.observe("default/vic", "default", "vic", "-", gp.PHASE_TRAINING,
+                now=t0, step=0)
+    led.observe("default/vic", "default", "vic", "-", gp.PHASE_TRAINING,
+                now=t0 + 100, step=100)
+    # pod heartbeat says 100/ckpt 80; the local tracker row DISAGREES
+    # (stale: 500/ckpt 0) — pricing must follow the pods either way
+    h.server.patch(
+        RESOURCE_PODS, "default",
+        gen_general_name("vic", c.REPLICA_TYPE_WORKER, 0),
+        pod_progress_patch(format_progress(100, checkpoint_step=80)))
+    h.controller.factory.sync_all()
+    from tpujob.api.progress import parse_progress
+    h.controller.telemetry.ingest(
+        "default/vic", "default", "vic", "-", "vic-worker-0",
+        "step=500 ckpt=0", parse_progress("step=500 ckpt=0"))
+    owned = sched._victim_cost("default/vic")
+    owned_view = sched.goodput_view("default/vic")
+    h.controller.telemetry.forget("default/vic")  # now a non-owned member
+    # approx: the projected loss prices at-risk SECONDS from the live clock,
+    # so two reads microseconds apart differ in the noise — what must hold
+    # is that dropping the tracker row changes nothing material
+    assert sched._victim_cost("default/vic") == pytest.approx(owned, abs=0.1)
+    other_view = sched.goodput_view("default/vic")
+    assert owned_view.step == other_view.step == 100.0
+    assert owned_view.checkpoint_step == other_view.checkpoint_step == 80.0
 
 
 def test_debug_surfaces_carry_goodput_blocks():

@@ -112,6 +112,34 @@ def test_negative_run_policy_values():
     assert any("activeDeadlineSeconds" in e for e in errs)
 
 
+def test_min_slices_within_spec_shape_is_valid():
+    d = copy.deepcopy(VALID)
+    d["tpuReplicaSpecs"]["Worker"]["tpu"] = {
+        "accelerator": "v4-16", "numSlices": 2}
+    d["tpuReplicaSpecs"].pop("Master")
+    d["tpuReplicaSpecs"]["Worker"]["replicas"] = 4
+    d["runPolicy"] = {"schedulingPolicy": {"minSlices": 1}}
+    assert validate_tpujob_spec(spec_of(d)) == []
+    d["runPolicy"]["schedulingPolicy"]["minSlices"] = 2  # == numSlices: ok
+    assert validate_tpujob_spec(spec_of(d)) == []
+
+
+def test_min_slices_below_one_rejected():
+    d = copy.deepcopy(VALID)
+    d["runPolicy"] = {"schedulingPolicy": {"minSlices": 0}}
+    errs = validate_tpujob_spec(spec_of(d))
+    assert any("minSlices must be >= 1" in e for e in errs)
+
+
+def test_min_slices_above_num_slices_rejected():
+    d = copy.deepcopy(VALID)
+    d["tpuReplicaSpecs"]["Worker"]["tpu"] = {
+        "accelerator": "v4-16", "numSlices": 2}
+    d["runPolicy"] = {"schedulingPolicy": {"minSlices": 3}}
+    errs = validate_tpujob_spec(spec_of(d))
+    assert any("minSlices" in e and "numSlices" in e for e in errs)
+
+
 def test_validation_error_lists_all():
     d = copy.deepcopy(VALID)
     d["tpuReplicaSpecs"]["Master"]["replicas"] = 2

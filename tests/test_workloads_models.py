@@ -97,10 +97,12 @@ class TestRingAttention:
         mesh = dist.make_mesh({"data": -1, "sequence": 4}, env=cpu_env())
         fl = {}
         for causal in (True, False):
-            fl[causal] = jax.jit(
+            ca = jax.jit(
                 lambda q, k, v, c=causal: parallel.ring_attention(
                     q, k, v, mesh, causal=c)
-            ).lower(q, k, v).compile().cost_analysis()["flops"]
+            ).lower(q, k, v).compile().cost_analysis()
+            # jax < 0.5 wraps cost analysis in a one-element list
+            fl[causal] = (ca[0] if isinstance(ca, list) else ca)["flops"]
         ratio = fl[True] / fl[False]
         assert 0.45 < ratio < 0.65, f"causal/non-causal flops {ratio:.3f}"
 
@@ -665,6 +667,9 @@ class TestBert:
         pipeline+batch axes only; the tensor axis stays auto, so the
         per-layer kernels keep their Megatron shardings inside the stages.
         Loss parity with pure DP."""
+        if not dist.shard_map_supports_partial_manual():
+            pytest.skip("jax < 0.5: legacy shard_map cannot leave the "
+                        "tensor axis auto (PartitionId crash)")
         r_dp = bertlib.run(tiny_bert_args(tmp_path, steps=2))
         r = bertlib.run(tiny_bert_args(tmp_path, steps=2,
                                        pipeline_parallel=2,

@@ -126,6 +126,25 @@ ANNOTATION_SCHED_EVICTED = f"{GROUP_NAME}/sched-evicted"
 ANNOTATION_PREEMPT_TARGET = f"{GROUP_NAME}/preempt-target"
 ANNOTATION_PREEMPT_ACK = f"{GROUP_NAME}/preempt-ack"
 
+# --- elastic capacity: num_slices flex ---------------------------------------
+# Under pressure the scheduler SHRINKS a running low-tier multislice gang by
+# whole slices (through the staged-resize drain barrier — zero failure
+# strikes) instead of evicting it, and a background grower flexes it back
+# into idle capacity.  Both decisions are durable-by-annotation like every
+# other scheduler protocol:
+#
+# - FLEX_SLICES: written by the SCHEDULER — the slice count the gang is
+#   currently flexed to (strictly less than spec num_slices while shrunk;
+#   cleared when the grower restores the full spec shape).  The reconciler's
+#   flex staging gate clamps the Worker replica count to this value, which
+#   drives the ordinary staged drain/join machinery.
+# - MIN_SLICES: optional per-job override of spec.runPolicy.
+#   schedulingPolicy.minSlices — the floor below which the scheduler must
+#   preempt rather than flex (a job that cannot make progress under N
+#   slices declares it here).
+ANNOTATION_FLEX_SLICES = f"{GROUP_NAME}/flex-slices"
+ANNOTATION_MIN_SLICES = f"{GROUP_NAME}/min-slices"
+
 # --- node inventory & fleet repair -------------------------------------------
 # Nodes are a first-class resource: each Node object names one TPU host VM
 # (its slice pool, slice index and torus host coordinate) and carries a

@@ -24,6 +24,7 @@ isolation):
 """
 from __future__ import annotations
 
+import dataclasses
 import math
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
@@ -152,6 +153,10 @@ class GangRequest:
     num_slices: int
     hosts_per_slice: int
     tier: int
+    # elastic-capacity floor: the scheduler may flex the gang down to this
+    # many slices (but never below) instead of evicting it; None = no
+    # declared floor (flexible to one slice)
+    min_slices: Optional[int] = None
 
     @property
     def total_hosts(self) -> int:
@@ -161,6 +166,17 @@ class GangRequest:
         """Modeled chip cost when placed on ``pool`` (the dominant-share
         accounting unit)."""
         return self.total_hosts * pool.chips_per_host
+
+
+def flex_request(req: GangRequest, flex: Optional[int]) -> GangRequest:
+    """The flex-effective request: the spec shape narrowed to the slice
+    count the scheduler currently holds the gang at.  The full-spec request
+    still judges feasibility (never-placeable is about the SPEC), but
+    capacity decisions — outgrow detection, re-admission while flexed —
+    follow the flexed shape."""
+    if flex is None or flex >= req.num_slices or flex < 1:
+        return req
+    return dataclasses.replace(req, num_slices=flex)
 
 
 def gang_request(job: TPUJob) -> GangRequest:
@@ -174,6 +190,7 @@ def gang_request(job: TPUJob) -> GangRequest:
     """
     sp = job.spec.run_policy.scheduling_policy
     tier = parse_tier(sp.priority_class if sp is not None else None)
+    min_slices = sp.min_slices if sp is not None else None
     ns = job.metadata.namespace or "default"
     tpu = None
     for rspec in job.spec.tpu_replica_specs.values():
@@ -189,13 +206,15 @@ def gang_request(job: TPUJob) -> GangRequest:
         return GangRequest(
             namespace=ns, name=job.metadata.name or "",
             generation=None, accelerator=None,
-            num_slices=1, hosts_per_slice=max(1, total), tier=tier)
+            num_slices=1, hosts_per_slice=max(1, total), tier=tier,
+            min_slices=min_slices)
     topo = tpu.resolve()
     gen, _ = parse_accelerator(topo.accelerator)
     return GangRequest(
         namespace=ns, name=job.metadata.name or "",
         generation=gen.name, accelerator=topo.accelerator,
-        num_slices=topo.num_slices, hosts_per_slice=topo.hosts, tier=tier)
+        num_slices=topo.num_slices, hosts_per_slice=topo.hosts, tier=tier,
+        min_slices=min_slices)
 
 
 def pool_fits(req: GangRequest, pool: SlicePoolSpec) -> bool:

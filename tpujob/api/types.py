@@ -63,11 +63,15 @@ class ReplicaSpec(K8sObject):
 
 @dataclass
 class SchedulingPolicy(K8sObject):
-    """Gang-scheduling knobs (kubeflow/common types.go:185-191)."""
+    """Gang-scheduling knobs (kubeflow/common types.go:185-191), plus the
+    elastic-capacity floor: ``minSlices`` is the slice count below which
+    the native scheduler must preempt rather than flex a multislice gang
+    (per-job overridable via the ``tpujob.dev/min-slices`` annotation)."""
 
     min_available: Optional[int] = None
     queue: Optional[str] = None
     priority_class: Optional[str] = None
+    min_slices: Optional[int] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
 

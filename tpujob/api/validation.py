@@ -140,6 +140,26 @@ def validate_tpujob_spec(spec: TPUJobSpec, strict_topology: bool = False) -> Lis
         and spec.run_policy.ttl_seconds_after_finished < 0
     ):
         errs.append("TPUJobSpec is not valid: ttlSecondsAfterFinished must be >= 0")
+    sp = spec.run_policy.scheduling_policy
+    if sp is not None and sp.min_slices is not None:
+        # the elastic-capacity flex floor: a declared floor below 1 or above
+        # the spec's own slice count is a contradiction the scheduler could
+        # only resolve by guessing — reject it at the spec boundary
+        if sp.min_slices < 1:
+            errs.append(
+                "TPUJobSpec is not valid: schedulingPolicy.minSlices must be"
+                " >= 1")
+        else:
+            num_slices = max(
+                (r.tpu.num_slices for r in spec.tpu_replica_specs.values()
+                 if r.tpu is not None and r.tpu.accelerator),
+                default=1)
+            if sp.min_slices > num_slices:
+                errs.append(
+                    "TPUJobSpec is not valid: schedulingPolicy.minSlices "
+                    f"({sp.min_slices}) exceeds the job's numSlices "
+                    f"({num_slices}) — the flex floor cannot sit above the "
+                    "spec shape")
     return errs
 
 

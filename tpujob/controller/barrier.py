@@ -1,0 +1,140 @@
+"""Shared checkpoint-barrier ladder for every staged vacate protocol.
+
+Five protocols drive a workload through the same publish -> checkpoint
+barrier -> act ladder: the elastic-resize drain (PR 9), the scheduler's
+capacity preemption (PR 11), node-repair gang migration (PR 12), and the
+elastic-capacity optimizer's num_slices flex + torus-defrag moves.  Each
+used to hand-roll the same three pieces; this module is the one copy:
+
+- **Patch builders** (:func:`resize_target_patch`,
+  :func:`preempt_target_patch`): publish the target AND consume any stale
+  ack in the SAME merge-patch dict (the TPL200 consume-at-publish rule) —
+  an ack left behind by a previous episode must never let THIS episode's
+  barrier pass before the workload checkpoints.
+- **The barrier judge** (:func:`barrier_passed`): ack wins immediately;
+  otherwise a per-incarnation monotonic anchor grants the workload up to
+  one grace period from when THIS incarnation first looked, floored by the
+  durable published-at wall timestamp so a barrier already pending across
+  a crash/handoff proceeds at once instead of re-granting a fresh grace.
+  Fails open on a corrupt durable anchor — every barrier exists to bound
+  progress loss, never to wedge its protocol.
+- **The sent ledger** (:class:`SentLedger`): committed-but-not-yet-echoed
+  write dedup (the ``_release_sent`` discipline generalized).  A tick that
+  rebuilds from a cache trailing its own committed write must neither
+  re-issue the patch (write amplification; worse, a re-published target
+  wipes an ack the workload just wrote) nor treat the write as absent.
+
+Callers keep their protocol-specific edges: the scheduler's preemption
+barrier FAILS CLOSED until its publish echoes into the cache (the grace
+clock starts at the echo), and treats telemetry whose checkpoint caught up
+to the step as an implicit ack; the resize drain acks with the target
+world size rather than a bare marker.  Both reduce to the same judge.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+from tpujob.api import constants as c
+from tpujob.controller import status as st
+
+
+def resize_target_patch(target_world: int) -> Dict[str, Optional[str]]:
+    """The drain barrier's publish: the pending world size the workload
+    must checkpoint for, consuming any stale checkpoint-ack in the same
+    patch (a later shrink to the SAME target must run its own barrier)."""
+    return {
+        c.ANNOTATION_TARGET_WORLD_SIZE: str(target_world),
+        c.ANNOTATION_CHECKPOINT_ACK: None,
+    }
+
+
+def preempt_target_patch(
+    extra: Optional[Dict[str, Optional[str]]] = None,
+) -> Dict[str, Optional[str]]:
+    """The eviction barrier's publish: preempt-target stamped now, the
+    paired ack consumed in the same patch.  ``extra`` rides the same
+    merge-patch (the migration's ``migrated-from`` record, the defrag
+    move's marker) so the whole decision commits atomically."""
+    patch: Dict[str, Optional[str]] = {
+        c.ANNOTATION_PREEMPT_TARGET: st.now_iso(),
+        c.ANNOTATION_PREEMPT_ACK: None,
+    }
+    if extra:
+        patch.update(extra)
+    return patch
+
+
+def barrier_passed(
+    anchors: Dict[str, float],
+    key: str,
+    grace_s: float,
+    acked: bool,
+    published_wall: Optional[float],
+    now_mono: float,
+    now_wall: float,
+) -> bool:
+    """One checkpoint-barrier verdict.
+
+    ``anchors`` is the caller's per-incarnation monotonic anchor map
+    (mutated: the first look at a pending barrier plants ``now_mono``);
+    ``published_wall`` is the durable publish instant parsed from the
+    annotation/status record (None = corrupt or absent — fail open, the
+    barrier bounds loss).  The +1.0s on the wall floor covers the persisted
+    timestamp's one-second granularity, exactly like the resize drain and
+    active-deadline floors.
+    """
+    if grace_s <= 0:
+        return True
+    if acked:
+        return True
+    anchor = anchors.setdefault(key, now_mono)
+    if now_mono - anchor >= grace_s:
+        return True
+    if published_wall is None:
+        return True  # corrupt durable anchor: fail open, the barrier bounds loss
+    return now_wall - published_wall >= grace_s + 1.0  # noqa: TPL004 - wall-vs-persisted timestamp math, the shared crash-resilient floor
+
+
+class SentLedger:
+    """Committed-but-unechoed write dedup, keyed by the value written.
+
+    ``record`` after the patch commits; ``sent`` answers whether the SAME
+    write is already in flight (so the tick neither re-issues it nor
+    treats it as absent); ``retire`` when the cache echo lands (or shows
+    the job gone).  ``prune`` keeps the map bounded to live keys — the
+    PR-3 ledger-hygiene stance — and ``clear`` drops everything on duty
+    handoff (another member owns the protocol now; the durable annotations
+    are the truth a regained duty rebuilds from).
+    """
+
+    def __init__(self) -> None:
+        self._sent: Dict[str, str] = {}
+
+    def __len__(self) -> int:
+        return len(self._sent)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._sent
+
+    def sent(self, key: str, value: str = "") -> bool:
+        return self._sent.get(key) == value
+
+    def value(self, key: str) -> Optional[str]:
+        """The in-flight value for ``key`` (None = nothing in flight):
+        until the echo lands, the caller's view of the field is the value
+        it committed, not the stale cache's."""
+        return self._sent.get(key)
+
+    def record(self, key: str, value: str = "") -> None:
+        self._sent[key] = value
+
+    def retire(self, key: str) -> None:
+        self._sent.pop(key, None)
+
+    def prune(self, live: Iterable[str]) -> None:
+        keep = set(live)
+        for key in [k for k in self._sent if k not in keep]:
+            self._sent.pop(key, None)
+
+    def clear(self) -> None:
+        self._sent.clear()

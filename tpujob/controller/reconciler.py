@@ -21,8 +21,11 @@ from typing import Any, Dict, List, Optional, Tuple
 from tpujob.api import constants as c
 from tpujob.api.defaults import set_defaults_tpujob
 from tpujob.api.progress import parse_progress
+from tpujob.api.quota import gang_request
+from tpujob.api.topology import TopologyError
 from tpujob.api.types import ReplicaStatus, ResizeStatus, TPUJob
 from tpujob.api.validation import validate_tpujob_spec
+from tpujob.controller import barrier
 from tpujob.controller import status as st
 from tpujob.controller import tpu_env
 from tpujob.controller.config import render_init_containers
@@ -529,6 +532,15 @@ class TPUJobController(JobController):
             if gated is not None:
                 return gated
 
+        # flex staging gate (elastic capacity): a scheduler-published
+        # ``flex-slices`` annotation clamps the Worker replica count IN
+        # MEMORY to the flexed world, so the ordinary resize pre-pass below
+        # stages the shrink/restore as a checkpoint-barriered drain/join.
+        # The SPEC stays the user's truth — the clamp is recomputed from the
+        # annotation every sync, and the scheduler clears the annotation
+        # (never this code) when the gang grows back or releases.
+        self._apply_flex(job)
+
         # elastic resize pre-pass: a spec.replicas change is a STAGED
         # drain/join transition, not a teardown.  Pods being drained are
         # excluded from the normal per-type reconcile below — they must not
@@ -892,6 +904,55 @@ class TPUJobController(JobController):
     # elastic resize (staged drain/join; ROADMAP item 3)
     # ------------------------------------------------------------------
 
+    def _apply_flex(self, job: TPUJob) -> None:
+        """Clamp the Worker replica count to the scheduler's flexed slice
+        target (``tpujob.dev/flex-slices``) — in memory only, this sync.
+
+        The scheduler shrinks a multislice gang under pressure by publishing
+        the flex annotation instead of editing the user's spec; this gate
+        translates it into the replica count the staged-resize machinery
+        understands (``flex * hosts_per_slice - masters``), so the shrink
+        rides the same publish-target -> checkpoint-barrier -> drain ladder
+        as a user resize: highest-index replicas (== highest slices) drain
+        with zero failure strikes, and the world republishes only when they
+        are provably gone.  Stateless: the clamp re-derives from the durable
+        annotation every sync, so a crash or shard handoff resumes the flex
+        exactly where the annotations say it is.  Runs AFTER strict spec
+        validation (sync_handler) — the spec the user wrote is what gets
+        validated — and only for admitted jobs (the caller's admission gate
+        already returned for anything unadmitted)."""
+        if self.scheduler is None:
+            return
+        ann = job.metadata.annotations or {}
+        if ann.get(c.ANNOTATION_SCHED_ASSIGNMENT) is None:
+            return
+        raw = ann.get(c.ANNOTATION_FLEX_SLICES)
+        if raw is None:
+            return
+        try:
+            flex = int(raw)
+        except (TypeError, ValueError):
+            logger_for_job(job).warning(
+                "ignoring unparseable %s=%r", c.ANNOTATION_FLEX_SLICES, raw)
+            return
+        rspec = job.spec.tpu_replica_specs.get(c.REPLICA_TYPE_WORKER)
+        if rspec is None:
+            return
+        try:
+            req = gang_request(job)
+        except TopologyError:
+            return  # never-placeable specs get their verdict elsewhere
+        if not 1 <= flex < req.num_slices:
+            return  # out-of-range flex (or full shape): spec replicas stand
+        masters = sum(
+            (r.replicas if r.replicas is not None else 1)
+            for t, r in job.spec.tpu_replica_specs.items()
+            if t == c.REPLICA_TYPE_MASTER)
+        workers = flex * req.hosts_per_slice - masters
+        if workers < 1:
+            return  # degenerate clamp: keep the spec shape
+        rspec.replicas = workers
+
     def _reconcile_resize(self, job: TPUJob, pods: List[Pod]) -> List[Pod]:
         """Stage a mid-flight ``spec.replicas`` change on the Worker type as
         a drain/join transition instead of a teardown.
@@ -1072,29 +1133,21 @@ class TPUJobController(JobController):
     def _drain_barrier_passed(self, job: TPUJob, target_world: int) -> bool:
         """Scale-down checkpoint barrier: wait for the workload's ack (the
         checkpoint-ack annotation naming the target world) or the bounded
-        drain grace.  Fails open on a corrupt anchor — the barrier bounds
-        progress loss, it must never wedge a shrink."""
-        grace = self.config.resize_drain_grace_s
-        if grace <= 0:
-            return True
+        drain grace.  The shared ladder (controller/barrier.py): per-
+        incarnation monotonic anchor — a controller that RESUMED a half-
+        finished drain grants the workload up to one more grace — floored
+        by the durable ``status.resize.started_at`` so a drain already
+        pending longer than the grace across incarnations proceeds
+        immediately; fails open on a corrupt anchor."""
         ack = (job.metadata.annotations or {}).get(c.ANNOTATION_CHECKPOINT_ACK)
-        if ack == str(target_world):
-            return True
-        # precise per-incarnation anchor: a controller that RESUMED a
-        # half-finished drain (crash, shard handoff) re-anchors here and
-        # grants the workload up to one more grace period
-        anchor = self._resize_started_mono.setdefault(job.key, time.monotonic())
-        if time.monotonic() - anchor >= grace:
-            return True
         resize = job.status.resize
         started = _parse_time(resize.started_at if resize is not None else None)
-        if started is None:
-            return True
-        # crash-resilient floor on the durable anchor (wall-vs-persisted
-        # math like _past_active_deadline; +1s covers the timestamp's
-        # second granularity): a drain already pending longer than the
-        # grace across incarnations proceeds immediately
-        return time.time() - started >= grace + 1.0  # noqa: TPL004
+        return barrier.barrier_passed(
+            self._resize_started_mono, job.key,
+            self.config.resize_drain_grace_s,
+            acked=ack == str(target_world),
+            published_wall=started,
+            now_mono=time.monotonic(), now_wall=time.time())
 
     def _delete_pod_no_strike(self, job: TPUJob, pod: Pod,
                               rtype: str) -> None:
@@ -1165,15 +1218,14 @@ class TPUJobController(JobController):
         ann = job.metadata.annotations or {}
         if ann.get(c.ANNOTATION_TARGET_WORLD_SIZE) == str(target_world):
             return
-        self._patch_job_annotations(job, {
-            c.ANNOTATION_TARGET_WORLD_SIZE: str(target_world),
-            # consume-at-publish (TPL200): a NEW target invalidates any ack
-            # standing from a previous drain in the same patch, so the
-            # barrier check can never read last epoch's ack as this one's.
-            # (The idempotence guard above means a mid-drain resync — same
-            # target, possibly a fresh valid ack — never repatches.)
-            c.ANNOTATION_CHECKPOINT_ACK: None,
-        })
+        # the shared builder nulls the ack in the same patch (TPL200
+        # consume-at-publish): a NEW target invalidates any ack standing
+        # from a previous drain, so the barrier can never read last
+        # epoch's ack as this one's.  (The idempotence guard above means a
+        # mid-drain resync — same target, possibly a fresh valid ack —
+        # never repatches.)
+        self._patch_job_annotations(
+            job, barrier.resize_target_patch(target_world))
 
     def _publish_world(self, job: TPUJob, world: int) -> None:
         """Republish the world size: the resize's commit point.  Survivors
@@ -1277,7 +1329,15 @@ class TPUJobController(JobController):
         preempted = (ann.get(c.ANNOTATION_SCHED_EVICTED) is not None
                      or bool(pods))
         migrated = ann.get(c.ANNOTATION_MIGRATED_FROM)
-        if preempted and migrated:
+        if preempted and migrated and migrated.startswith("defrag:"):
+            # a torus-defragmentation compaction move, not a capacity
+            # preemption or hardware repair: the queue history must say so
+            reason = st.REASON_JOB_MIGRATED
+            message = (f"TPUJob {job.metadata.name} is migrating off "
+                       f"fragmented host(s) {migrated[len('defrag:'):]} to "
+                       "compact free capacity; re-queued for contiguous "
+                       "re-admission.")
+        elif preempted and migrated:
             # a scheduled migration off a dead/cordoned host, not a
             # capacity preemption: the queue history must say which
             reason = st.REASON_JOB_MIGRATED

@@ -41,6 +41,7 @@ observed restore and requeue costs — instead of raw steps-past-checkpoint.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
 from typing import Any, Dict, List, Optional
@@ -191,9 +192,36 @@ class GoodputView:
         second, which preserves the raw-steps ordering."""
         if self.steps_at_risk is None:
             return float("inf")
-        redo = (self.steps_at_risk / self.step_rate
+        return self._redo_s + self.restore_cost_s + self.requeue_cost_s
+
+    @property
+    def _redo_s(self) -> float:
+        """Seconds to redo the at-risk steps at the job's own rate (one
+        step = one second without a measured rate)."""
+        if self.steps_at_risk is None:
+            return float("inf")
+        return (self.steps_at_risk / self.step_rate
                 if self.step_rate else self.steps_at_risk)
-        return redo + self.restore_cost_s + self.requeue_cost_s
+
+    @property
+    def flex_loss_s(self) -> float:
+        """Seconds a num_slices flex shrink costs: the re-rendezvous
+        restore ONLY.  The drain runs the checkpoint barrier (nothing to
+        redo) and the gang keeps running (nothing requeues), so flex is
+        finite even with zero telemetry — the planner's flex < migrate <
+        preempt ordering holds by construction."""
+        return self.restore_cost_s
+
+    @property
+    def migrate_loss_s(self) -> float:
+        """Seconds a checkpoint-barrier migration costs: redo the at-risk
+        steps plus one restore, but no requeue (migrations re-queue with
+        an aging head-start and re-admit as soon as capacity allows).
+        Unknown telemetry = infinite, the preemption stance — the
+        defragmenter only moves provably-cheap gangs."""
+        if self.steps_at_risk is None:
+            return float("inf")
+        return self._redo_s + self.restore_cost_s
 
 
 def heartbeat_view(step: float,
@@ -248,6 +276,11 @@ class GoodputLedger:
         self._agg_start_sum = 0.0
         self._agg_good_n = 0
         self._agg_good_start_sum = 0.0
+        # per-move cost records from the capacity planner (flex / defrag /
+        # migrate / preempt): the priced projected loss of every committed
+        # move, bounded (ring) so a long soak cannot grow it.  Guarded by
+        # self._lock.
+        self._moves: collections.deque = collections.deque(maxlen=256)
 
     # ------------------------------------------------------------------
     # accounting
@@ -415,6 +448,27 @@ class GoodputLedger:
                              else float(checkpoint_step)),
             steps_at_risk=at_risk, step_rate=step_rate,
             restore_cost_s=restore, requeue_cost_s=requeue)
+
+    # ------------------------------------------------------------------
+    # capacity-move cost records
+    # ------------------------------------------------------------------
+
+    def note_move(self, key: str, kind: str, cost_s: float) -> None:
+        """Record one committed capacity move (flex / defrag / migrate /
+        preempt) and the projected-loss price the planner chose it at —
+        the audit trail that lets the soak invariants (and a human at
+        /debug/fleet) verify every move was the cheapest one available."""
+        with self._lock:
+            self._moves.append({
+                "at": st.now_iso(), "job": key, "kind": kind,
+                "cost_s": (None if cost_s == float("inf")
+                           else round(cost_s, 3)),
+            })
+
+    def moves(self) -> List[Dict[str, Any]]:
+        """The bounded move-cost trail, oldest first."""
+        with self._lock:
+            return list(self._moves)
 
     # ------------------------------------------------------------------
     # refresh tick (jobs without heartbeats never arm the telemetry tick)

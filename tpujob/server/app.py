@@ -189,6 +189,9 @@ class OperatorApp:
                 preempt_grace_s=opt.scheduler_preempt_grace_s,
                 node_grace_s=opt.node_grace_s,
                 node_damp_s=opt.node_migration_damp_s,
+                enable_flex=opt.scheduler_flex,
+                enable_defrag=opt.scheduler_defrag,
+                defrag_threshold=opt.scheduler_defrag_threshold,
             )
             self.controller.set_scheduler(self.scheduler)
         self.monitoring: Optional[MonitoringServer] = None

@@ -636,6 +636,33 @@ sched_migrations = Counter(
     REGISTRY,
 )
 
+# Elastic capacity optimizer series: num_slices flex + torus defrag.  Moved
+# only by the scheduler duty, like the rest of the tpujob_scheduler_*
+# families.
+sched_flex = LabeledCounter(
+    "tpujob_scheduler_flex_total",
+    "num_slices flex moves committed by the capacity planner "
+    "(direction=shrink: a gang gave up slices through the staged drain "
+    "barrier instead of being evicted; direction=grow: the background "
+    "grower flexed a shrunk gang back into idle capacity)",
+    REGISTRY,
+    ("direction",),
+)
+sched_defrag_moves = Counter(
+    "tpujob_scheduler_defrag_moves_total",
+    "Torus defragmentation moves staged (each migrates one gang through "
+    "the checkpoint-barrier eviction so its freed fragments merge into a "
+    "larger contiguous host run)",
+    REGISTRY,
+)
+sched_fragmentation = Gauge(
+    "tpujob_scheduler_fragmentation_ratio",
+    "How shredded the free capacity is: 1 - largest free contiguous host "
+    "run / total free hosts (0 = all free capacity is one placeable run, "
+    "sampled once per scheduler tick)",
+    REGISTRY,
+)
+
 # Goodput accounting plane (the per-job phase ledger, tpujob/obs/goodput):
 # every second of a job's life attributed to one phase, on the controller's
 # monotonic clock.  Same one-exporter-per-job discipline as the other

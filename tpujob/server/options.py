@@ -108,6 +108,16 @@ class ServerOption:
     # how long the preemption checkpoint barrier waits for the workload's
     # ack before evicting anyway (<= 0 evicts immediately)
     scheduler_preempt_grace_s: float = 5.0
+    # elastic capacity: under pressure, shrink a lower-tier multislice gang
+    # by slices (staged drain, zero strikes) before resorting to eviction,
+    # and grow shrunk gangs back into idle capacity
+    scheduler_flex: bool = True
+    # torus defragmentation: compact fragmented free capacity by migrating
+    # small gangs (checkpoint-barriered) so large contiguous gangs place
+    scheduler_defrag: bool = True
+    # fragmentation ratio (1 - largest free run / total free hosts) above
+    # which the defragmenter starts planning compaction moves
+    scheduler_defrag_threshold: float = 0.5
     # node inventory: how long a node's heartbeat lease may go unchanged
     # (controller monotonic clock) before the scheduler duty flips its
     # durable phase NotReady, excludes it from placement and migrates its
@@ -314,6 +324,28 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="seconds the preemption checkpoint barrier "
                              "waits for the workload's ack before evicting "
                              "anyway (<=0 evicts immediately)")
+    parser.add_argument("--sched-flex", dest="scheduler_flex",
+                        action="store_true", default=True,
+                        help="shrink lower-tier multislice gangs by slices "
+                             "under pressure instead of evicting them, and "
+                             "grow them back into idle capacity (default on)")
+    parser.add_argument("--no-sched-flex", dest="scheduler_flex",
+                        action="store_false",
+                        help="disable num_slices flex (pressure falls back "
+                             "to preemption)")
+    parser.add_argument("--sched-defrag", dest="scheduler_defrag",
+                        action="store_true", default=True,
+                        help="compact fragmented free capacity by migrating "
+                             "small gangs behind a checkpoint barrier "
+                             "(default on)")
+    parser.add_argument("--no-sched-defrag", dest="scheduler_defrag",
+                        action="store_false",
+                        help="disable torus defragmentation")
+    parser.add_argument("--sched-defrag-threshold", type=float, default=0.5,
+                        dest="scheduler_defrag_threshold",
+                        help="fragmentation ratio (1 - largest free run / "
+                             "total free hosts) above which the "
+                             "defragmenter plans compaction moves")
     parser.add_argument("--node-grace", type=float, default=30.0,
                         dest="node_grace_s",
                         help="seconds a node's heartbeat lease may go "

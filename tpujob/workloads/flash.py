@@ -381,7 +381,9 @@ def _grid_params(last_arbitrary: int = 1):
         from jax.experimental.pallas import tpu as pltpu
 
         sem = ("parallel",) * (3 - last_arbitrary) + ("arbitrary",) * last_arbitrary
-        return {"compiler_params": pltpu.CompilerParams(
-            dimension_semantics=sem)}
+        # jax >= 0.5 renamed TPUCompilerParams -> CompilerParams
+        params_cls = getattr(pltpu, "CompilerParams", None) \
+            or pltpu.TPUCompilerParams
+        return {"compiler_params": params_cls(dimension_semantics=sem)}
     except ImportError:  # pragma: no cover
         return {}

@@ -510,8 +510,21 @@ def pipeline(
             f"per-device batch {b_local} does not divide into "
             f"{m} microbatches")
 
+    # jax < 0.5: the legacy shard_map partitioner mispartitions a stack
+    # built inside the surrounding jit against a P(axis) in_spec (stages
+    # read the wrong layer slices and the output conversion double-
+    # reduces over the batch axis) — feed the stack replicated instead
+    # and slice each stage's layers inside the manual region; the slice
+    # transpose psums the layer-grad contributions back together
+    legacy = not dist.shard_map_supports_partial_manual()
+
     def local(p_local, xb):
         idx = jax.lax.axis_index(axis)
+        if legacy:
+            per = n_layers // n
+            p_local = jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(
+                    a, idx * per, per, 0), p_local)
         mb = xb.shape[0] // m
         x_mb = xb.reshape((m, mb) + xb.shape[1:])
         out0 = jnp.zeros_like(x_mb)
@@ -559,7 +572,8 @@ def pipeline(
     # TP collectives inside each stage
     manual = {axis} | set(dist.batch_axes(mesh))
     return shard_map(
-        local, mesh=mesh, in_specs=(P(axis), xspec), out_specs=xspec,
+        local, mesh=mesh,
+        in_specs=(P() if legacy else P(axis), xspec), out_specs=xspec,
         check_vma=False, axis_names=frozenset(manual),
     )(stacked_params, x)
 

@@ -303,9 +303,19 @@ def pipeline_1f1b(
         "loss_cot_slot": tables.loss_cot_slot, "feed_mb": tables.feed_mb,
     }
     rows = {k: jnp.asarray(v) for k, v in rows.items()}
+    # jax < 0.5: the legacy shard_map partitioner mispartitions a stack
+    # built inside the surrounding jit against a P(axis) in_spec (see
+    # parallel.pipeline) — feed the stack replicated, slice each stage's
+    # layers inside the manual region, and reassemble dP the same way
+    legacy = not dist.shard_map_supports_partial_manual()
+    per = leaves[0].shape[0] // n
 
     def local(p_local, h_params, xb, extra_b):
         idx = jax.lax.axis_index(axis)
+        if legacy:
+            p_local = jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(
+                    a, idx * per, per, 0), p_local)
         mb = xb.shape[0] // m
         x_mb = xb.reshape((m, mb) + xb.shape[1:])
         extra_mb = jax.tree.map(
@@ -405,6 +415,16 @@ def pipeline_1f1b(
             dP = jax.tree.map(
                 lambda a: jax.lax.psum(a, batch_axis) / shards, dP)
             dxs = dxs / shards
+        if legacy:
+            # replicate the full layer-grad stack: each stage scatters its
+            # slice into zeros and the ring psum assembles all stages
+            dP = jax.tree.map(
+                lambda a: jax.lax.psum(
+                    jax.lax.dynamic_update_slice_in_dim(
+                        jnp.zeros((n * per,) + a.shape[1:], a.dtype),
+                        a, idx * per, 0),
+                    axis),
+                dP)
         return loss, dP, dH, dxs.reshape(xb.shape)
 
     xspec = P(batch_axis, *([None] * (x.ndim - 1)))
@@ -413,7 +433,7 @@ def pipeline_1f1b(
     manual = {axis} | set(dist.batch_axes(mesh))
     return shard_map(
         local, mesh=mesh,
-        in_specs=(P(axis), P(), xspec, exspec),
-        out_specs=(P(), P(axis), P(), xspec),
+        in_specs=(P() if legacy else P(axis), P(), xspec, exspec),
+        out_specs=(P(), P() if legacy else P(axis), P(), xspec),
         check_vma=False, axis_names=frozenset(manual),
     )(stacked_params, head_params, x, extra)
