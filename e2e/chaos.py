@@ -2025,7 +2025,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--mode",
                         choices=("api", "crash", "failover", "shard",
-                                 "resize", "sched", "nodes"),
+                                 "resize", "sched", "nodes", "observatory"),
                         default="api",
                         help="api = transport faults only; crash = + seeded "
                              "controller kills; failover = warm-standby "
@@ -2037,7 +2037,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "seeded preemption + faults + a controller "
                              "kill; nodes = seeded NodeStorm (host death, "
                              "heartbeat flap, cordon churn, slice outage) + "
-                             "gang migration + faults + a controller kill")
+                             "gang migration + faults + a controller kill; "
+                             "observatory = scrape-merged fleet view + SLO "
+                             "burn-rate alerting under a membership storm")
     parser.add_argument("--storm-kills", type=int, default=6)
     parser.add_argument("--timeout", type=float, default=60.0)
     parser.add_argument("--verbose", action="store_true")
@@ -2068,6 +2070,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         from e2e.nodes import run_node_soak
 
         report = run_node_soak(args.seed, timeout=args.timeout)
+    elif args.mode == "observatory":
+        # imported here: e2e.observatory imports this module at load time
+        from e2e.observatory import run_observatory_soak
+
+        report = run_observatory_soak(args.seed, timeout=args.timeout)
     else:
         report = run_soak(args.seed, storm_kills=args.storm_kills,
                           timeout=args.timeout)

@@ -1,0 +1,472 @@
+"""Observatory chaos tier: scrape-merged accounting under member death.
+
+``run_observatory_smoke`` is the fast acceptance gate (``make
+observatory-smoke``): a 2-member sharded fleet with real HTTP ``/metrics``
++ ``/debug/fleet`` endpoints, a training gang occupying the whole modeled
+fleet and a critical gang queued behind it with the movers disabled.  The
+observatory scrapes both members over HTTP, and the smoke asserts the
+three acceptance behaviors end to end:
+
+- **exactly-once merged accounting across a member kill** — the victim's
+  jobs reappear under the survivor within one lease term + slack, the
+  partition-violation ledger stays empty (the handoff grace absorbs the
+  legitimate double-export blind spot), and a stale scrape is never
+  replayed as live;
+- **one seeded SLO alert, fired and cleared** — the kill breaches the
+  scrape-liveness objective: exactly one burn-rate episode fires (both
+  windows must burn), holds without flapping, and clears through the
+  hysteresis gate once the membership catalog drops the dead target;
+- **``/debug/why`` on a queued job names its blocker and ladder price**
+  — before AND after the scheduler-duty handoff, the merged explainer
+  returns the fair-share verdict naming the occupant and pricing the
+  hypothetical flex/preempt ladder.
+
+``run_observatory_soak`` (``--mode observatory``) is the storm tier: a
+3-member fleet under a seeded membership storm (kills + graceful flaps +
+rejoins) with heartbeating gangs, asserting the observatory never reports
+a job zero or twice outside the handoff window and no SLO alert flaps —
+each objective fires at most one episode per membership event.
+
+Runnable:  python -m e2e.chaos --seed 7 --mode observatory
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from e2e.chaos import (
+    JobCase,
+    _job,
+    _lock_audit_report,
+    _soak_harness,
+    _start_app,
+    _tmpl,
+    _wait_for,
+)
+from e2e.kubelet import KubeletSim
+from e2e.scheduler import SCHED_CAPACITY, SchedWorkload
+from tpujob.analysis import lockgraph
+from tpujob.api import constants as c
+from tpujob.kube.chaos import ChaosConfig
+from tpujob.obs.observatory import (
+    Observatory,
+    ObservatoryServer,
+    default_slos,
+    http_fetch,
+)
+
+NO_FAULTS = ChaosConfig(
+    error_rate=0.0, timeout_rate=0.0, conflict_rate=0.0, latency_rate=0.0,
+    kill_watch_every=0, compact_every=0, duplicate_event_rate=0.0,
+)
+
+OBS_INTERVAL_S = 0.2
+
+# scheduler config for this tier: movers OFF so the critical gang stays
+# stably queued behind the low-tier occupant — the explainer must then
+# price the HYPOTHETICAL ladder, not an in-flight drain
+OBS_OPT_OVERRIDES = dict(
+    monitoring_port=-1,  # real HTTP listener on an ephemeral port
+    lease_duration_s=1.0,
+    scheduler_capacity=SCHED_CAPACITY,
+    scheduler_tick_s=0.05,
+    scheduler_aging_s=60.0,
+    scheduler_preemption=False,
+    scheduler_flex=False,
+    scheduler_defrag=False,
+    stall_timeout_s=30.0,
+    enable_observatory=True,  # each member also self-scrapes in-process
+    observatory_interval_s=OBS_INTERVAL_S,
+)
+
+
+def _gang(name: str, workers: int, num_slices: int, priority: str,
+          wl: SchedWorkload) -> JobCase:
+    spec: Dict[str, Any] = {
+        "runPolicy": {"backoffLimit": 10},
+        "tpuReplicaSpecs": {"Worker": {
+            "replicas": workers,
+            "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+            "tpu": {"accelerator": "v4-16", "numSlices": num_slices},
+            "template": _tmpl()}},
+    }
+    if priority:
+        spec["runPolicy"]["schedulingPolicy"] = {"priorityClass": priority}
+    return JobCase(job=_job(name, spec), scripts=wl.scripts(),
+                   expect_terminal="Succeeded")
+
+
+def _target(app) -> str:
+    return f"http://127.0.0.1:{app.monitoring.port}"
+
+
+def _full_coverage(live: List[Any], shard_count: int) -> bool:
+    owned: Dict[int, int] = {}
+    for a in live:
+        for s in a.coordinator.owned_shards():
+            owned[s] = owned.get(s, 0) + 1
+    return (len(owned) == shard_count
+            and all(n == 1 for n in owned.values()))
+
+
+def _merged_members_of(obs: Observatory, job_key: str) -> List[str]:
+    return [r["member"] for r in obs.merged_snapshot()["jobs"]
+            if r["job"] == job_key]
+
+
+# ---------------------------------------------------------------------------
+# smoke
+# ---------------------------------------------------------------------------
+
+
+def run_observatory_smoke(seed: int = 31, shard_count: int = 4,
+                          lease_duration: float = 1.0,
+                          absorb_slack: float = 1.0,
+                          timeout: float = 45.0) -> Dict[str, Any]:
+    """The fast observatory acceptance gate (``make observatory-smoke``).
+    Runs under the lock-order sentinel."""
+    with lockgraph.audit():
+        report = _run_observatory_smoke_inner(seed, shard_count,
+                                              lease_duration, absorb_slack,
+                                              timeout)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_observatory_smoke_inner(seed: int, shard_count: int,
+                                 lease_duration: float, absorb_slack: float,
+                                 timeout: float) -> Dict[str, Any]:
+    trainer_stop = threading.Event()
+    occ_gate = threading.Event()  # holds the occupant training
+    prefix, _, inner, chaos, admin, tracker, _ = _soak_harness(
+        seed, "o", NO_FAULTS, cases=[])
+    occ_name, vip_name = f"{prefix}-occ", f"{prefix}-vip"
+    occ_key = f"default/{occ_name}"
+    wl_occ = SchedWorkload(admin, occ_name, total_steps=40,
+                           stop_event=trainer_stop, finish_gate=occ_gate)
+    wl_vip = SchedWorkload(admin, vip_name, total_steps=5,
+                           stop_event=trainer_stop)
+    cases = [_gang(occ_name, 4, 2, "low", wl_occ),     # whole fleet
+             _gang(vip_name, 2, 1, "critical", wl_vip)]  # queued behind it
+    started = time.monotonic()
+    deadline = started + timeout
+
+    def _wait(pred, what: str) -> None:
+        if not _wait_for(pred, max(0.1, deadline - time.monotonic())):
+            raise AssertionError(
+                f"observatory smoke: timed out waiting for {what}")
+
+    scripts = [s for case in cases for s in case.scripts]
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts)
+    overrides = {**OBS_OPT_OVERRIDES, "lease_duration_s": lease_duration}
+    apps = [_start_app(chaos, overrides, shards=shard_count)
+            for _ in range(2)]
+    _wait(lambda: _full_coverage(apps, shard_count),
+          "the 2-member fleet to split the shard space")
+    kubelet.start()
+
+    obs_stop = threading.Event()
+    obs = Observatory(
+        targets=[_target(a) for a in apps],
+        interval_s=OBS_INTERVAL_S,
+        # tolerate exactly one lease-term handoff + one scrape of slack
+        handoff_grace_s=lease_duration + OBS_INTERVAL_S,
+        slos=default_slos(OBS_INTERVAL_S))
+    server = ObservatoryServer(obs, port=0).start()
+    obs.start(obs_stop)
+    fetch = http_fetch(timeout_s=2.0)
+    me = f"http://127.0.0.1:{server.port}"
+    try:
+        # 1. occupant fills the fleet and trains (heartbeats -> telemetry)
+        admin.tpujobs.create(cases[0].job)
+        _wait(lambda: wl_occ.ledger.snapshot()["progress"] > 2,
+              "the occupant gang to train")
+        _wait(lambda: len(_merged_members_of(obs, occ_key)) == 1,
+              "the occupant in the merged fleet view")
+        # 2. the critical gang queues (movers disabled: it CANNOT preempt)
+        admin.tpujobs.create(cases[1].job)
+
+        def _why() -> Optional[Dict[str, Any]]:
+            try:
+                return fetch(me, f"/debug/why/default/{vip_name}")
+            except Exception:  # noqa: TPL005 - polled until it answers
+                return None
+
+        def _why_names_blocker() -> bool:
+            out = _why()
+            verdict = (out or {}).get("answer", {}).get("verdict") or {}
+            return (verdict.get("reason") == "fair-share-position"
+                    and occ_key in verdict.get("blockers", ())
+                    and bool(verdict.get("ladder")))
+
+        _wait(_why_names_blocker,
+              "/debug/why to name the blocker and the ladder price")
+        why_before = _why()
+
+        # 3. healthy scrape history fills the long burn window so the
+        # seeded breach below needs SUSTAINED badness to fire
+        _wait(lambda: obs.polls >= 32, "a long window of healthy scrapes")
+        if obs.violations():
+            raise AssertionError(
+                f"observatory smoke: partition violations fired on a "
+                f"healthy fleet: {obs.violations()}")
+        if obs.alert_state("scrape-liveness")["fired_total"]:
+            raise AssertionError(
+                "observatory smoke: liveness alert fired before the kill")
+
+        # 4. kill the scheduler-duty member: shard handoff + duty handoff +
+        # scrape loss, all at once
+        victim = next(a for a in apps
+                      if 0 in a.coordinator.owned_shards())
+        survivor = apps[1 - apps.index(victim)]
+        kill_at = time.monotonic()
+        victim.hard_kill()
+        if not _wait_for(
+                lambda: len(survivor.coordinator.owned_shards())
+                == shard_count,
+                lease_duration + absorb_slack + 5):
+            raise AssertionError(
+                "observatory smoke: survivor never absorbed the shards")
+        absorb_s = time.monotonic() - kill_at
+
+        # 5. exactly-once accounting re-settles within lease + slack +
+        # the scrape staleness bound: the occupant appears under the
+        # SURVIVOR, once, and no partition violation ever fires
+        if not _wait_for(
+                lambda: _merged_members_of(obs, occ_key)
+                == [_target(survivor)],
+                lease_duration + absorb_slack + 2):
+            raise AssertionError(
+                "observatory smoke: merged view did not re-settle to "
+                f"exactly-once under the survivor "
+                f"(exporters: {_merged_members_of(obs, occ_key)})")
+
+        # 6. the seeded SLO breach fires exactly one alert episode
+        _wait(lambda: obs.alert_state("scrape-liveness")["active"],
+              "the scrape-liveness alert to fire")
+        live_state = obs.alert_state("scrape-liveness")
+        if live_state["fired_total"] != 1:
+            raise AssertionError(
+                f"observatory smoke: liveness fired "
+                f"{live_state['fired_total']} episodes, want exactly 1")
+
+        # 7. /debug/why answers across the duty handoff: the survivor's
+        # scheduler re-records the verdict after acquiring shard 0
+        _wait(_why_names_blocker,
+              "/debug/why to answer again after the duty handoff")
+
+        # 8. membership catalog drops the dead target: the alert clears
+        # through hysteresis and NEVER re-fires (no flap)
+        obs.set_targets([_target(survivor)])
+        _wait(lambda: not obs.alert_state("scrape-liveness")["active"],
+              "the liveness alert to clear on recovery")
+        time.sleep(OBS_INTERVAL_S * 5)
+        for row in obs.alerts_snapshot():
+            if row["fired_total"] > 1:
+                raise AssertionError(
+                    f"observatory smoke: SLO {row['slo']} flapped "
+                    f"({row['fired_total']} episodes)")
+        if obs.alert_state("scrape-liveness")["fired_total"] != 1:
+            raise AssertionError("observatory smoke: liveness alert "
+                                 "re-fired after clearing (flap)")
+        if obs.violations():
+            raise AssertionError(
+                "observatory smoke: partition violations fired across the "
+                f"handoff: {obs.violations()}")
+
+        # 9. the in-process --observatory wiring on the survivor has been
+        # self-scraping all along: alive, polling, violation-free
+        if survivor.observatory is None or survivor.observatory.polls == 0:
+            raise AssertionError(
+                "observatory smoke: --observatory member never polled")
+        if survivor.observatory.violations():
+            raise AssertionError(
+                "observatory smoke: self-scrape observatory reported "
+                f"violations: {survivor.observatory.violations()}")
+        # the HTTP surfaces answer
+        alerts = fetch(me, "/debug/alerts")
+        merged = fetch(me, "/debug/observatory")
+        return {
+            "mode": "observatory-smoke",
+            "seed": seed,
+            "absorb_s": round(absorb_s, 3),
+            "merged_jobs": merged["job_count"],
+            "alerts": {r["slo"]: r["fired_total"] for r in alerts},
+            "why": (why_before or {}).get("answer", {}).get("verdict", {})
+                   .get("reason"),
+            "violations": 0,
+            "duration_s": round(time.monotonic() - started, 3),
+            "invariants": "ok",
+        }
+    finally:
+        occ_gate.set()
+        trainer_stop.set()
+        obs_stop.set()
+        server.stop()
+        kubelet.stop()
+        for a in apps:
+            if not a._hard_killed:
+                a.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# soak: membership storm
+# ---------------------------------------------------------------------------
+
+
+def run_observatory_soak(seed: int, controllers: int = 3,
+                         shard_count: int = 4, member_events: int = 2,
+                         timeout: float = 60.0) -> Dict[str, Any]:
+    """Observatory under a seeded shard membership storm: kills, graceful
+    flaps and rejoins while heartbeating gangs train.  Invariants: the
+    merged view never reports a job zero or twice outside the handoff
+    window (empty violation ledger + post-settle equality against the
+    live members' own telemetry), and no SLO alert flaps — at most one
+    episode per membership event, all cleared once membership settles.
+
+    Runs under the lock-order sentinel."""
+    with lockgraph.audit():
+        report = _run_observatory_soak_inner(seed, controllers, shard_count,
+                                             member_events, timeout)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_observatory_soak_inner(seed: int, controllers: int,
+                                shard_count: int, member_events: int,
+                                timeout: float) -> Dict[str, Any]:
+    rng = random.Random(f"{seed}:observatory-storm")
+    trainer_stop = threading.Event()
+    gates = [threading.Event(), threading.Event()]
+    prefix, _, inner, chaos, admin, tracker, _ = _soak_harness(
+        seed, "y", NO_FAULTS, cases=[])
+    names = [f"{prefix}-g0", f"{prefix}-g1"]
+    wls = [SchedWorkload(admin, names[i], total_steps=400,
+                         stop_event=trainer_stop, finish_gate=gates[i])
+           for i in range(2)]
+    cases = [_gang(names[0], 2, 1, "", wls[0]),
+             _gang(names[1], 2, 1, "", wls[1])]
+    started = time.monotonic()
+    deadline = started + timeout
+
+    def _wait(pred, what: str) -> None:
+        if not _wait_for(pred, max(0.1, deadline - time.monotonic())):
+            raise AssertionError(
+                f"observatory soak: timed out waiting for {what}")
+
+    scripts = [s for case in cases for s in case.scripts]
+    kubelet = KubeletSim(admin, run_seconds=0.05, scripts=scripts)
+    apps = [_start_app(chaos, OBS_OPT_OVERRIDES, shards=shard_count)
+            for _ in range(controllers)]
+    live = list(apps)
+    _wait(lambda: _full_coverage(live, shard_count),
+          "full disjoint shard coverage")
+    kubelet.start()
+
+    obs_stop = threading.Event()
+    obs = Observatory(
+        targets=[_target(a) for a in live],
+        interval_s=OBS_INTERVAL_S,
+        handoff_grace_s=OBS_OPT_OVERRIDES["lease_duration_s"]
+        + OBS_INTERVAL_S,
+        slos=default_slos(OBS_INTERVAL_S))
+    obs.start(obs_stop)
+
+    def _merged_matches_truth() -> bool:
+        """Zero-or-twice check: the merged job set equals the union of
+        the LIVE members' own telemetry, each job exactly once."""
+        truth: Dict[str, int] = {}
+        for a in live:
+            for row in a.controller.telemetry.snapshot():
+                truth[row["job"]] = truth.get(row["job"], 0) + 1
+        if any(n != 1 for n in truth.values()):
+            return False  # members themselves mid-handoff; not settled
+        merged = obs.merged_snapshot()["jobs"]
+        counts: Dict[str, int] = {}
+        for row in merged:
+            counts[row["job"]] = counts.get(row["job"], 0) + 1
+        return counts == truth
+
+    membership_log: List[Dict[str, str]] = []
+    try:
+        for case in cases:
+            admin.tpujobs.create(case.job)
+        _wait(lambda: all(w.ledger.snapshot()["progress"] > 2 for w in wls),
+              "both gangs training")
+        _wait(_merged_matches_truth, "the merged view to match telemetry")
+
+        actions = ["kill"] + [rng.choice(("kill", "flap"))
+                              for _ in range(max(0, member_events - 1))]
+        for action in actions:
+            time.sleep(rng.uniform(0.3, 0.8))
+            pool = ([a for a in live if a.coordinator.owned_shards()]
+                    or live) if action == "kill" else live
+            victim = pool[rng.randrange(len(pool))]
+            if action == "kill":
+                victim.hard_kill()
+            else:
+                victim.shutdown()
+            live.remove(victim)
+            membership_log.append(
+                {"action": action, "member": victim.coordinator.identity})
+            _wait(lambda: _full_coverage(live, shard_count),
+                  f"survivors to re-cover the shards after the {action}")
+            # the membership catalog follows reality: drop the dead
+            # target, then admit a fresh replacement
+            obs.set_targets([_target(a) for a in live])
+            replacement = _start_app(chaos, OBS_OPT_OVERRIDES,
+                                     shards=shard_count)
+            live.append(replacement)
+            apps.append(replacement)
+            _wait(lambda: _full_coverage(live, shard_count),
+                  "the replacement to join the shard space")
+            obs.set_targets([_target(a) for a in live])
+            _wait(_merged_matches_truth,
+                  f"merged view to re-settle after the {action}")
+            if obs.violations():
+                raise AssertionError(
+                    f"observatory soak: partition violations outside the "
+                    f"handoff window: {obs.violations()}")
+
+        # storm over: release the gangs, let them finish, final checks
+        for g in gates:
+            g.set()
+        time.sleep(OBS_INTERVAL_S * 6)
+        problems: List[str] = []
+        if obs.violations():
+            problems.append(f"violations fired: {obs.violations()}")
+        for row in obs.alerts_snapshot():
+            if row["fired_total"] > len(actions):
+                problems.append(
+                    f"SLO {row['slo']} fired {row['fired_total']} episodes "
+                    f"over {len(actions)} membership events (flap)")
+        live_state = obs.alert_state("scrape-liveness")
+        if live_state["active"]:
+            problems.append("liveness alert still active after membership "
+                            "settled")
+        if problems:
+            raise AssertionError(
+                "observatory soak invariants violated:\n  "
+                + "\n  ".join(problems))
+        return {
+            "mode": "observatory-soak",
+            "seed": seed,
+            "membership_events": membership_log,
+            "polls": obs.polls,
+            "alerts": {r["slo"]: r["fired_total"]
+                       for r in obs.alerts_snapshot()},
+            "violations": 0,
+            "duration_s": round(time.monotonic() - started, 3),
+            "invariants": "ok",
+        }
+    finally:
+        for g in gates:
+            g.set()
+        trainer_stop.set()
+        obs_stop.set()
+        kubelet.stop()
+        for a in apps:
+            if not a._hard_killed and a in live:
+                a.shutdown()

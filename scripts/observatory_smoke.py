@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Fleet-observatory smoke: scrape-merge, SLO burn-rate, /debug/why.
+
+The fast observatory acceptance gate (``make observatory-smoke``, wired
+as a ``make test`` prerequisite; budget ~15 s):
+
+- a 2-member sharded fleet serves real HTTP /metrics + /debug/fleet;
+  the observatory merges both into one fleet view and verifies the
+  partition invariants continuously;
+- ``/debug/why`` on a critical gang queued behind a low-tier occupant
+  (movers disabled) names the blocker and prices the hypothetical
+  flex/preempt ladder — before AND after a scheduler-duty handoff;
+- one member is hard-killed: merged accounting re-settles to
+  exactly-once under the survivor within one lease term + slack, zero
+  partition violations fire (the handoff grace absorbs the blind spot),
+  and the seeded scrape-liveness breach fires exactly ONE burn-rate
+  alert episode that clears — without flapping — once the membership
+  catalog drops the dead target.
+
+No API-transport faults here — the membership storm variant runs in
+``python -m e2e.chaos --mode observatory``; this smoke isolates the
+merge/alert/explain protocol so a failure points straight at it.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from e2e.observatory import run_observatory_smoke
+
+
+def main() -> int:
+    logging.disable(logging.CRITICAL)
+    report = run_observatory_smoke(seed=31)
+    assert report["invariants"] == "ok"
+    assert report["alerts"]["scrape-liveness"] == 1
+    print(f"observatory-smoke: OK (merged {report['merged_jobs']} job(s) "
+          f"exactly-once, shards absorbed in {report['absorb_s']}s, "
+          f"1 liveness alert fired+cleared, /debug/why verdict "
+          f"'{report['why']}', 0 violations, in {report['duration_s']}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,410 @@
+"""Fleet observatory: merge-under-handoff matrix, partition-violation
+grace, SLO burn-rate fire/clear discipline, and the scheduler decision
+rings behind ``/debug/why``.
+
+The observatory half drives :class:`tpujob.obs.observatory.Observatory`
+with fake member transports and an explicit clock — member dies
+mid-scrape, stale scrapes, half-fleet failure, handoff double-exports —
+asserting the invariants the chaos tier later checks under a real
+membership storm: a job is never reported zero or twice outside the
+handoff window, data-driven SLO denominators freeze (never silently
+narrow) under partial coverage, and a single scrape race cannot fire or
+flap an alert.
+
+The scheduler half exercises the explainability plane directly: bounded
+per-job decision rings with monotonic seq + duty-epoch gap markers, and
+``explain()`` verdicts naming the blocker and the flex/migrate/preempt
+ladder price for a queued gang.
+"""
+from __future__ import annotations
+
+import pytest
+
+from jobtestutil import Harness
+from test_scheduler import harness_with_scheduler, sched_job, step
+from tpujob.obs.observatory import SLO, Observatory, default_slos
+from tpujob.server.scheduler import GangScheduler
+
+
+# ---------------------------------------------------------------------------
+# fake fleet transport
+# ---------------------------------------------------------------------------
+
+
+def member(identity, jobs=(), shards=None, shard_count=None,
+           goodput=None, scheduler=None):
+    """One member's /debug/fleet payload (the reconciler.fleet_snapshot
+    shape), jobs given as bare keys or full telemetry rows."""
+    rows = [j if isinstance(j, dict) else
+            {"job": j, "shard": 0, "stalled": False, "heartbeat_age_s": 1.0}
+            for j in jobs]
+    out = {"identity": identity, "shards": shards, "jobs": rows,
+           "goodput": goodput or {"wall_s": 10.0, "goodput_s": 9.0,
+                                  "goodput_ratio": 0.9}}
+    if shard_count is not None:
+        out["shard_count"] = shard_count
+    if scheduler is not None:
+        out["scheduler"] = scheduler
+    return out
+
+
+class FakeFleet:
+    """target -> payload (or Exception to fail the scrape); mutate
+    ``payloads`` between polls to script the scenario."""
+
+    def __init__(self, payloads):
+        self.payloads = dict(payloads)
+        self.why = {}  # (target, ns/name) -> payload
+
+    def fetch(self, target, path):
+        if path == "/debug/fleet":
+            payload = self.payloads[target]
+            if isinstance(payload, Exception):
+                raise payload
+            return payload
+        if path.startswith("/debug/why/"):
+            key = path[len("/debug/why/"):]
+            return self.why.get((target, key))
+        raise AssertionError(f"unexpected path {path}")
+
+
+def observatory(fleet, targets=("a", "b"), interval_s=1.0,
+                handoff_grace_s=3.0, slos=None, **kw):
+    return Observatory(targets=list(targets), interval_s=interval_s,
+                       handoff_grace_s=handoff_grace_s,
+                       fetch=fleet.fetch, slos=slos or [], **kw)
+
+
+# ---------------------------------------------------------------------------
+# merge + staleness
+# ---------------------------------------------------------------------------
+
+
+def test_merge_two_members_exactly_once():
+    fleet = FakeFleet({"a": member("m-a", ["default/j1", "default/j2"]),
+                       "b": member("m-b", ["default/j3"])})
+    obs = observatory(fleet)
+    view = obs.poll(now=100.0)
+    assert sorted(view["jobs"]) == ["default/j1", "default/j2", "default/j3"]
+    assert view["coverage"] == 1.0 and not view["degraded"]
+    assert all(len(m) == 1 for m in view["exporters"].values())
+    assert obs.violations() == []
+    # goodput rolls up across members
+    assert view["goodput"]["wall_s"] == 20.0
+    assert view["goodput"]["goodput_ratio"] == pytest.approx(0.9)
+    snap = obs.merged_snapshot()
+    assert snap["job_count"] == 3
+    assert [m["up"] for m in snap["members"]] == [True, True]
+
+
+def test_member_dies_mid_scrape_degrades_to_partial_view():
+    """A member that stops answering degrades the view — its last
+    snapshot is merged only while younger than the staleness bound, then
+    DROPPED; no partition violation fires at any point."""
+    fleet = FakeFleet({"a": member("m-a", ["default/j1"]),
+                       "b": member("m-b", ["default/j2"])})
+    obs = observatory(fleet)  # stale_after = 1.5 * interval
+    obs.poll(now=100.0)
+    fleet.payloads["b"] = OSError("connection refused")
+    # one missed scrape: b's snapshot is 1.0s old, still within the bound
+    view = obs.poll(now=101.0)
+    assert "default/j2" in view["jobs"] and view["coverage"] == 1.0
+    # two missed scrapes: 2.0s old > 1.5s -> dropped, view goes partial
+    view = obs.poll(now=102.0)
+    assert "default/j2" not in view["jobs"]
+    assert view["coverage"] == 0.5 and view["degraded"]
+    assert obs.violations() == []
+    rows = {m["target"]: m for m in obs.merged_snapshot()["members"]}
+    assert rows["b"]["up"] is False
+    assert "refused" in rows["b"]["error"]
+
+
+def test_orphan_check_suppressed_under_partial_coverage():
+    """With a member unscraped its shards merely LOOK unowned: the
+    orphan invariant needs full coverage to be falsifiable."""
+    fleet = FakeFleet({
+        "a": member("m-a", shards=[0, 1], shard_count=4),
+        "b": member("m-b", shards=[2, 3], shard_count=4)})
+    obs = observatory(fleet, handoff_grace_s=0.0)
+    obs.poll(now=100.0)
+    assert obs.violations() == []
+    fleet.payloads["b"] = OSError("down")
+    for t in (102.0, 103.0, 104.0):  # b stale from 101.6 on
+        obs.poll(now=t)
+    assert obs.violations() == []  # shards 2,3 are NOT orphans
+
+
+# ---------------------------------------------------------------------------
+# partition violations: handoff grace + fire-once episodes
+# ---------------------------------------------------------------------------
+
+
+def test_double_export_within_grace_never_fires():
+    """The legitimate handoff blind spot: old owner's last scrape and
+    new owner's first overlap for up to a lease term.  A double export
+    that heals inside the grace window is the protocol, not a bug."""
+    fleet = FakeFleet({"a": member("m-a", ["default/j1"]),
+                       "b": member("m-b", ["default/j1"])})
+    obs = observatory(fleet, handoff_grace_s=3.0)
+    obs.poll(now=100.0)
+    obs.poll(now=101.0)
+    assert obs.violations() == []  # pending, inside grace
+    pending = obs.merged_snapshot()["violations"]["pending"]
+    assert [p["kind"] for p in pending] == ["job-double-export"]
+    fleet.payloads["b"] = member("m-b", [])  # handoff completes
+    obs.poll(now=102.0)
+    obs.poll(now=110.0)
+    assert obs.violations() == []
+    assert obs.merged_snapshot()["violations"]["pending"] == []
+
+
+def test_persistent_double_export_fires_once_per_episode():
+    fleet = FakeFleet({"a": member("m-a", ["default/j1"]),
+                       "b": member("m-b", ["default/j1"])})
+    obs = observatory(fleet, handoff_grace_s=2.0)
+    for t in (100.0, 101.0, 102.5, 103.0, 110.0):
+        obs.poll(now=t)
+    fired = obs.violations()
+    assert len(fired) == 1  # one episode, one fire — however long it lasts
+    assert fired[0]["kind"] == "job-double-export"
+    assert fired[0]["subject"] == "default/j1"
+    assert fired[0]["members"] == ["a", "b"]  # offenders named
+    # heal, then regress: a NEW episode fires again
+    fleet.payloads["b"] = member("m-b", [])
+    obs.poll(now=111.0)
+    fleet.payloads["b"] = member("m-b", ["default/j1"])
+    for t in (112.0, 113.0, 115.0):
+        obs.poll(now=t)
+    assert len(obs.violations()) == 2
+
+
+def test_shard_double_owned_and_orphaned_fire_after_grace():
+    fleet = FakeFleet({
+        "a": member("m-a", shards=[0, 1], shard_count=4),
+        "b": member("m-b", shards=[1], shard_count=4)})  # 1 doubled, 2+3 orphaned
+    obs = observatory(fleet, handoff_grace_s=2.0)
+    for t in (100.0, 101.0, 102.5):
+        obs.poll(now=t)
+    fired = {(v["kind"], v["subject"]): v for v in obs.violations()}
+    assert ("shard-double-owned", "1") in fired
+    assert fired[("shard-double-owned", "1")]["members"] == ["a", "b"]
+    assert ("shard-orphaned", "2") in fired
+    assert ("shard-orphaned", "3") in fired
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine
+# ---------------------------------------------------------------------------
+
+
+def drive(obs, t0, n, dt=1.0):
+    t = t0
+    for _ in range(n):
+        obs.poll(now=t)
+        t += dt
+    return t
+
+
+def test_half_fleet_failure_liveness_alert_fires_once_and_clears():
+    """Half the fleet stops answering: the scrape-liveness objective
+    fires exactly ONE alert episode (both windows must burn), stays
+    active without flapping while the outage lasts, and clears through
+    the hysteresis gate on recovery.  Meanwhile the data-driven
+    objectives FREEZE instead of silently narrowing their denominators."""
+    fleet = FakeFleet({"a": member("m-a", ["default/j1"]),
+                       "b": member("m-b", ["default/j2"])})
+    obs = observatory(fleet, slos=default_slos(interval_s=1.0))
+    t = drive(obs, 100.0, 35)  # healthy history fills the long window
+    live = obs.alert_state("scrape-liveness")
+    assert live["fired_total"] == 0 and not live["active"]
+
+    fleet.payloads["b"] = OSError("down")
+    t = drive(obs, t, 20)
+    live = obs.alert_state("scrape-liveness")
+    assert live["active"] and live["fired_total"] == 1  # one episode, no flap
+    # partial coverage: data-driven objectives froze rather than report
+    # a half-fleet's goodput as the fleet's
+    assert obs.alert_state("fleet-goodput-ratio")["frozen"]
+    assert obs.alert_state("stalled-job-rate")["frozen"]
+    row = next(r for r in obs.alerts_snapshot() if r["slo"] == "scrape-liveness")
+    assert row["active"] and row["burn_short"] > 1.0
+
+    fleet.payloads["b"] = member("m-b", ["default/j2"])
+    drive(obs, t, 10)
+    live = obs.alert_state("scrape-liveness")
+    assert not live["active"] and live["fired_total"] == 1
+    assert not obs.alert_state("fleet-goodput-ratio")["frozen"]
+
+
+def test_single_scrape_race_cannot_fire_an_alert():
+    """One blown scrape spikes the short window but not the long one:
+    the multi-window AND gate holds, so no alert (and no flap)."""
+    fleet = FakeFleet({"a": member("m-a", ["default/j1"]),
+                       "b": member("m-b", ["default/j2"])})
+    obs = observatory(fleet, slos=default_slos(interval_s=1.0))
+    t = drive(obs, 100.0, 35)
+    fleet.payloads["b"] = OSError("blip")
+    t = drive(obs, t, 2)  # one stale poll (the second drops b)
+    fleet.payloads["b"] = member("m-b", ["default/j2"])
+    drive(obs, t, 35)
+    assert obs.alert_state("scrape-liveness")["fired_total"] == 0
+
+
+def test_frozen_slo_never_narrows_the_denominator():
+    """A custom objective records every denominator it was evaluated
+    over; under partial coverage it must see None-freezes, never a
+    half-fleet sample presented as the fleet."""
+    seen = []
+
+    def sample(view):
+        if view["degraded"]:
+            return None
+        seen.append(len(view["jobs"]))
+        return 0.0
+
+    slo = SLO("probe", "test", budget=0.5, sample=sample,
+              short_window_s=5.0, long_window_s=30.0)
+    fleet = FakeFleet({"a": member("m-a", ["default/j1"]),
+                       "b": member("m-b", ["default/j2"])})
+    obs = observatory(fleet, slos=[slo])
+    t = drive(obs, 100.0, 3)
+    fleet.payloads["b"] = OSError("down")
+    t = drive(obs, t, 5)
+    fleet.payloads["b"] = member("m-b", ["default/j2"])
+    drive(obs, t, 3)
+    assert set(seen) == {2}  # every accepted sample saw the WHOLE fleet
+
+
+def test_retarget_drops_departed_member():
+    fleet = FakeFleet({"a": member("m-a", ["default/j1"]),
+                       "b": member("m-b", ["default/j2"])})
+    obs = observatory(fleet)
+    obs.poll(now=100.0)
+    obs.set_targets(["a"])
+    view = obs.poll(now=101.0)
+    assert list(view["jobs"]) == ["default/j1"]
+    assert view["coverage"] == 1.0 and not view["degraded"]
+
+
+def test_why_prefers_the_member_with_a_verdict():
+    fleet = FakeFleet({"a": member("m-a"), "b": member("m-b")})
+    fleet.why[("b", "default/j1")] = {
+        "job": "default/j1", "state": "queued",
+        "verdict": {"reason": "fair-share-position"}, "ring": [{"seq": 1}]}
+    fleet.why[("a", "default/j1")] = {
+        "job": "default/j1", "state": "unscheduled", "verdict": None,
+        "ring": []}
+    obs = observatory(fleet)
+    out = obs.why("default", "j1")
+    assert out["answered_by"] == "b"
+    assert out["answer"]["verdict"]["reason"] == "fair-share-position"
+    assert sorted(out["members"]) == ["a", "b"]
+    assert obs.why("default", "missing") is None
+
+
+# ---------------------------------------------------------------------------
+# scheduler explainability: verdicts, rings, /debug/why
+# ---------------------------------------------------------------------------
+
+
+def test_explain_queued_names_blocker_and_ladder_price():
+    """A high-tier gang queued behind a low-tier occupant with the
+    movers disabled: the verdict is fair-share-position, the blocker is
+    named, and the hypothetical ladder prices what admission WOULD cost."""
+    h = Harness()
+    sched = GangScheduler(h.controller, "v4-16x2",
+                          enable_flex=False, enable_preemption=False)
+    h.controller.set_scheduler(sched)
+    h.submit(sched_job("occ", workers=4, num_slices=2, priority="low"))
+    step(h, sched)
+    h.submit(sched_job("vip", workers=4, num_slices=2, priority="critical"))
+    step(h, sched)
+    out = sched.explain("default", "vip")
+    assert out["state"] == "queued"
+    verdict = out["verdict"]
+    assert verdict["reason"] == "fair-share-position"
+    assert verdict["blockers"] == ["default/occ"]
+    assert verdict["ladder"] and verdict["ladder"][0]["job"] == "default/occ"
+    assert verdict["ladder"][0]["cost_s"] >= 0.0
+    assert "movers disabled" in verdict["detail"]
+    # the verdict rides the ring with seq/epoch for gap detection
+    assert out["ring"][-1]["kind"] == "queued"
+    assert out["last_seq"] == out["ring"][-1]["seq"]
+    # the occupant explains as admitted; an unknown job 404s
+    assert sched.explain("default", "occ")["state"] == "admitted"
+    assert sched.explain("default", "nope") is None
+
+
+def test_explain_queue_position_behind_head_of_line():
+    """Entries the blocked scan never reached get a pure queue-position
+    verdict naming the head-of-line job that holds the scan."""
+    h, sched = harness_with_scheduler("v4-16x1")
+    sched.enable_preemption = True
+    h.submit(sched_job("occ", priority="low"))
+    step(h, sched)
+    h.submit(sched_job("vip", priority="critical"))
+    h.submit(sched_job("tail", priority="high"))  # sorts behind vip
+    h.controller.factory.sync_all()
+    sched.tick()  # vip plans preemption -> blocks the scan; tail unexamined
+    vip = sched.explain("default", "vip")
+    assert vip["verdict"]["reason"] == "waiting-on-drain"
+    assert vip["verdict"]["blockers"] == ["default/occ"]
+    tail = sched.explain("default", "tail")
+    assert tail["verdict"]["reason"] == "queue-position"
+    assert tail["verdict"]["behind"] == "default/vip"
+
+
+def test_verdict_rides_ring_only_on_change():
+    """A stably queued job must keep its history: identical verdicts do
+    not append, so the ring cannot wash out with 'still queued' rows."""
+    h, sched = harness_with_scheduler("v4-16x1")
+    h.submit(sched_job("occ"))
+    step(h, sched)
+    h.submit(sched_job("wait"))
+    step(h, sched)
+    ring_len = len(sched.explain("default", "wait")["ring"])
+    for _ in range(10):
+        step(h, sched)
+    assert len(sched.explain("default", "wait")["ring"]) == ring_len
+
+
+def test_ring_seq_monotonic_and_bounded():
+    h = Harness()
+    sched = GangScheduler(h.controller, "v4-16x1")
+    with sched._lock:
+        for i in range(100):
+            sched._ring_append_locked("default/x", "test", f"d{i}")
+        ring = list(sched._rings["default/x"])
+    assert len(ring) == GangScheduler.RING_SIZE  # bounded
+    seqs = [e["seq"] for e in ring]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert seqs[-1] == 100  # monotonic across the evicted prefix
+
+
+def test_ring_rebuilt_marker_after_duty_handoff():
+    """A ring first created at duty epoch > 1 opens with an explicit
+    rebuild marker: gap detection after a shard handoff reads the
+    marker, not heuristics over missing seq numbers."""
+    h = Harness()
+    sched = GangScheduler(h.controller, "v4-16x1")
+    with sched._lock:
+        sched._ring_epoch = 2  # as after a second duty acquisition
+        sched._ring_append_locked("default/x", "queued", "post-handoff verdict")
+        ring = list(sched._rings["default/x"])
+    assert ring[0]["kind"] == "ring-rebuilt"
+    assert ring[0]["epoch"] == 2
+    assert [e["seq"] for e in ring] == [1, 2]
+
+
+def test_debug_snapshot_carries_rings_and_epoch():
+    h, sched = harness_with_scheduler("v4-16x1")
+    h.submit(sched_job("occ"))
+    step(h, sched)
+    h.submit(sched_job("wait"))
+    step(h, sched)
+    snap = sched.debug_snapshot()
+    assert snap["epoch"] >= 1
+    assert "default/wait" in snap["rings"]
+    assert "default/wait" in snap["verdicts"]
+    assert snap["verdicts"]["default/wait"]["reason"] in (
+        "fair-share-position", "infeasible-now")

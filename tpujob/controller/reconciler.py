@@ -1822,6 +1822,29 @@ class TPUJobController(JobController):
             # queue positions + admission decisions + capacity utilization:
             # the scrape-merge twin of the tpujob_scheduler_* series
             out["scheduler"] = self.scheduler.debug_snapshot()
+        if self.sharder is not None:
+            # the observatory's orphan check needs the DECLARED shard space,
+            # not just this member's slice of it
+            out["shard_count"] = getattr(self.sharder, "num_shards", None)
+        return out
+
+    def explain_job(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        """The ``/debug/why/<ns>/<name>`` payload: the scheduler's verdict
+        + decision ring for the job, joined with the live progress and
+        goodput rows this member holds.  None = no scheduler, or neither
+        the scheduler nor the telemetry plane has seen the job (404)."""
+        ns = namespace or "default"
+        key = f"{ns}/{name}"
+        out = (self.scheduler.explain(ns, name)
+               if self.scheduler is not None else None)
+        row = self.telemetry.row(key)
+        if out is None and row is None:
+            return None
+        if out is None:
+            out = {"job": key, "state": "unscheduled",
+                   "verdict": None, "ring": []}
+        out["progress"] = row
+        out["goodput"] = self.goodput.row(key)
         return out
 
     def debug_job_state(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:

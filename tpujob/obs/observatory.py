@@ -1,0 +1,745 @@
+"""Fleet observatory: scrape-merged fleet view, invariant verification,
+SLO burn-rate alerting, and the merged scheduler-explainability surface.
+
+Every observability plane before this one is per-member: a sharded fleet
+exposes N ``/metrics`` + ``/debug/fleet`` endpoints and leaves the merge to
+the reader.  The observatory IS that reader, productionized:
+
+- **Scrape + merge.**  On an interval it fetches every member's
+  ``/debug/fleet`` payload, drops scrapes older than the staleness bound
+  (a member that stopped answering degrades the view to PARTIAL — its last
+  snapshot is never silently replayed as live), and merges the survivors
+  into one fleet view: jobs, goodput rollup, shard ownership, and the
+  scheduler duty owner's queue/decision state.
+- **Continuous invariant verification.**  The partition invariants the
+  per-member docs only *document* become first-class signals: every job
+  must have exactly one exporter, and every declared shard exactly one
+  owner.  A violation must PERSIST past the declared handoff grace window
+  (one lease term + scrape slack — the legitimate ownership-transfer
+  blind spot) before it fires
+  ``tpujob_observatory_partition_violations_total{kind}`` with the
+  offending members named in ``/debug/observatory``.
+- **SLO engine.**  Declarative objectives (scrape liveness, fleet goodput
+  ratio, stalled-job rate, heartbeat freshness, admission-wait p99)
+  evaluated over the MERGED view with multi-window burn-rate alerting:
+  the short and the long window must both burn past the threshold to
+  fire (one ``tpujob_slo_alerts_total`` increment per episode), and the
+  clear is hysteresis-gated — a single scrape race can never flap an
+  alert.  When scrape coverage is incomplete, data-driven objectives
+  FREEZE (no sample enters their windows) instead of silently narrowing
+  their denominators; the scrape-liveness objective is what alerts.
+- **Merged explainability.**  ``/debug/why/<ns>/<name>`` fans the question
+  out to the members and returns the scheduler duty owner's verdict —
+  the "why is my job not running" answer in one request, regardless of
+  which member currently holds shard 0.
+
+Runnable standalone (``python -m tpujob.obs.observatory --targets ...``)
+or in-process next to a member (``--observatory``).  All merge/SLO logic
+is clock- and transport-injectable for the unit matrix.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import logging
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tpujob.analysis import lockgraph
+from tpujob.server import metrics
+from tpujob.server.metrics import REGISTRY
+
+log = logging.getLogger("tpujob.observatory")
+
+
+# ---------------------------------------------------------------------------
+# transport
+# ---------------------------------------------------------------------------
+
+
+def http_fetch(timeout_s: float = 2.0) -> Callable[[str, str], Any]:
+    """The default member transport: GET ``<target><path>`` and parse the
+    JSON body.  Raises on any failure — the observatory's scrape loop is
+    the one retry/degrade policy, not the transport."""
+
+    def fetch(target: str, path: str) -> Any:
+        url = target.rstrip("/") + path
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:  # noqa: S310 - operator-internal endpoint
+            if resp.status != 200:
+                raise OSError(f"{url}: HTTP {resp.status}")
+            return json.loads(resp.read().decode())
+
+    return fetch
+
+
+# ---------------------------------------------------------------------------
+# SLOs: declarative objectives + multi-window burn-rate state
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SLO:
+    """One declarative objective.  ``sample(view)`` returns the
+    instantaneous bad-ratio in [0, 1] — the fraction of the objective's
+    denominator currently out of spec — or None to FREEZE this cycle
+    (no data, or the merged view is too degraded to trust; a frozen
+    objective's windows simply do not advance, which is the opposite of
+    silently narrowing the denominator)."""
+
+    name: str
+    objective: str
+    budget: float  # allowed bad-ratio (the error budget)
+    sample: Callable[[Dict[str, Any]], Optional[float]]
+    short_window_s: float
+    long_window_s: float
+    burn_threshold: float = 1.0  # fire when BOTH windows burn past this
+    clear_factor: float = 0.5  # hysteresis: clear below threshold * this
+
+
+class _Window:
+    """Bounded ring of (t, bad_ratio) samples with windowed averages."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._samples: collections.deque = collections.deque(maxlen=maxlen)
+
+    def add(self, t: float, ratio: float) -> None:
+        self._samples.append((t, ratio))
+
+    def avg(self, now: float, window_s: float) -> Optional[float]:
+        vals = [r for t, r in self._samples if now - t <= window_s]
+        if not vals:
+            return None
+        return sum(vals) / len(vals)
+
+
+def _percentile(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, max(0, int(round(q * (len(ordered) - 1)))))
+    return ordered[idx]
+
+
+def default_slos(interval_s: float,
+                 heartbeat_fresh_s: float = 60.0,
+                 admission_wait_limit_s: float = 600.0) -> List[SLO]:
+    """The stock objective set, windows scaled to the scrape cadence:
+    the short window reacts within a few polls, the long window demands
+    the breach be sustained — the multi-window discipline that makes a
+    single scrape race incapable of firing (or flapping) an alert."""
+    short = max(interval_s * 5, interval_s + 1e-9)
+    long_ = max(interval_s * 30, short * 2)
+
+    def liveness(view: Dict[str, Any]) -> Optional[float]:
+        # ALWAYS samples — this is the objective that speaks when the
+        # data-driven ones freeze
+        return 1.0 - view["coverage"]
+
+    def goodput(view: Dict[str, Any]) -> Optional[float]:
+        if view["degraded"]:
+            return None
+        g = view["goodput"]
+        ratio = g.get("goodput_ratio")
+        if ratio is None:
+            return None
+        return max(0.0, min(1.0, 1.0 - float(ratio)))
+
+    def stalled(view: Dict[str, Any]) -> Optional[float]:
+        if view["degraded"] or not view["jobs"]:
+            return None
+        rows = view["jobs"].values()
+        return sum(1 for r in rows if r.get("stalled")) / len(view["jobs"])
+
+    def heartbeat(view: Dict[str, Any]) -> Optional[float]:
+        if view["degraded"] or not view["jobs"]:
+            return None
+        ages = [r.get("heartbeat_age_s") for r in view["jobs"].values()]
+        ages = [a for a in ages if a is not None]
+        if not ages:
+            return None
+        return sum(1 for a in ages if a > heartbeat_fresh_s) / len(ages)
+
+    def admission_wait(view: Dict[str, Any]) -> Optional[float]:
+        if view["degraded"]:
+            return None
+        sched = view.get("scheduler")
+        if not sched:
+            return None
+        waits = [row.get("wait_s", 0.0) for row in sched.get("queue") or []]
+        if not waits:
+            return 0.0  # empty queue: nobody is waiting at all
+        p99 = _percentile(waits, 0.99) or 0.0
+        return 1.0 if p99 > admission_wait_limit_s else 0.0
+
+    return [
+        SLO("scrape-liveness",
+            "every member answers its scrape within the staleness bound",
+            budget=0.05, sample=liveness,
+            short_window_s=short, long_window_s=long_),
+        SLO("fleet-goodput-ratio",
+            "the fleet spends most of its accounted wall clock productive",
+            budget=0.75, sample=goodput,
+            short_window_s=short * 4, long_window_s=long_ * 4),
+        SLO("stalled-job-rate",
+            "stalled jobs stay a small fraction of the fleet",
+            budget=0.25, sample=stalled,
+            short_window_s=short, long_window_s=long_),
+        SLO("heartbeat-freshness",
+            "job heartbeats keep arriving within the freshness bound",
+            budget=0.25, sample=heartbeat,
+            short_window_s=short, long_window_s=long_),
+        SLO("admission-wait-p99",
+            "queued gangs are admitted before the p99 wait bound",
+            budget=0.10, sample=admission_wait,
+            short_window_s=short * 2, long_window_s=long_ * 2),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the observatory
+# ---------------------------------------------------------------------------
+
+_VIOLATION_KINDS = ("job-double-export", "shard-double-owned",
+                    "shard-orphaned")
+
+
+class Observatory:
+    """Scrape N members, merge one fleet view, verify the partition
+    invariants, evaluate the SLOs.  ``fetch(target, path)`` is injectable
+    (unit tests drive fake fleets; production uses :func:`http_fetch`),
+    and ``poll(now=...)`` takes an explicit clock for the merge-under-
+    handoff matrix."""
+
+    def __init__(
+        self,
+        targets: List[str],
+        interval_s: float = 1.0,
+        handoff_grace_s: float = 2.0,
+        stale_after_s: Optional[float] = None,
+        fetch: Optional[Callable[[str, str], Any]] = None,
+        slos: Optional[List[SLO]] = None,
+        check_orphans: bool = True,
+    ):
+        self.interval_s = interval_s
+        self.handoff_grace_s = handoff_grace_s
+        # a scrape older than ~one interval is a ghost: merging it would
+        # report a dead member's jobs as live (and double-count them the
+        # moment the survivor absorbs its shards)
+        self.stale_after_s = (stale_after_s if stale_after_s is not None
+                              else interval_s * 1.5)
+        self._fetch = fetch if fetch is not None else http_fetch(
+            timeout_s=max(0.5, interval_s))
+        self.slos = slos if slos is not None else default_slos(interval_s)
+        # the orphan invariant is only falsifiable when ``targets`` is the
+        # WHOLE membership catalog; a knowingly-partial list (e.g. the
+        # --observatory self-scrape default) must not call the shards it
+        # cannot see orphaned
+        self.check_orphans = check_orphans
+        self._lock = lockgraph.new_lock("observatory")
+        self._targets: List[str] = list(targets)  # guarded by self._lock
+        # per-member scrape state (guarded by self._lock)
+        self._members: Dict[str, Dict[str, Any]] = {}
+        # pending (kind, subject) violations inside the grace window
+        self._pending: Dict[Tuple[str, str], Dict[str, Any]] = {}  # guarded by self._lock
+        # fired violations (bounded: the soak cannot grow this unbounded)
+        self._fired: collections.deque = collections.deque(maxlen=256)  # guarded by self._lock
+        self._alerts: Dict[str, Dict[str, Any]] = {
+            s.name: {"active": False, "since": None, "fired_total": 0,
+                     "burn_short": None, "burn_long": None,
+                     "last_sample": None, "frozen": False}
+            for s in self.slos}  # guarded by self._lock
+        self._windows: Dict[str, _Window] = {
+            s.name: _Window() for s in self.slos}  # guarded by self._lock
+        self._merged: Dict[str, Any] = {}  # guarded by self._lock
+        self.polls = 0  # guarded by self._lock
+        self._thread: Optional[threading.Thread] = None
+
+    # -- targets -------------------------------------------------------------
+
+    @property
+    def targets(self) -> List[str]:
+        with self._lock:
+            return list(self._targets)
+
+    def set_targets(self, targets: List[str]) -> None:
+        """Replace the scrape set (member joined/left).  A removed
+        member's gauges are dropped immediately — the one-exporter
+        discipline applies to the observatory's own families too."""
+        with self._lock:
+            gone = [t for t in self._targets if t not in targets]
+            self._targets = list(targets)
+            for t in gone:
+                self._members.pop(t, None)
+        for t in gone:
+            metrics.observatory_member_up.remove(member=t)
+            metrics.observatory_scrape_age.remove(member=t)
+
+    # -- the poll cycle ------------------------------------------------------
+
+    def poll(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One scrape/merge/verify/evaluate cycle; returns the merged
+        view (also retained for :meth:`merged_snapshot`)."""
+        now = time.monotonic() if now is None else now
+        targets = self.targets
+        scraped: Dict[str, Any] = {}
+        for target in targets:
+            t0 = time.monotonic()
+            try:
+                payload = self._fetch(target, "/debug/fleet")
+                if not isinstance(payload, dict):
+                    raise ValueError("non-object /debug/fleet payload")
+            except Exception as e:  # noqa: TPL005 - any member fault degrades, never kills the loop
+                metrics.observatory_scrapes.labels(
+                    member=target, result="error").inc()
+                with self._lock:
+                    m = self._members.setdefault(target, {"last_ok": None})
+                    m["failures"] = m.get("failures", 0) + 1
+                    m["error"] = str(e) or e.__class__.__name__
+                continue
+            metrics.observatory_scrapes.labels(
+                member=target, result="ok").inc()
+            scraped[target] = payload
+            with self._lock:
+                m = self._members.setdefault(target, {})
+                m.update({"last_ok": now, "payload": payload, "error": None,
+                          "latency_s": round(time.monotonic() - t0, 6)})
+                m["scrapes"] = m.get("scrapes", 0) + 1
+
+        view = self._merge(now, targets)
+        self._verify(now, view)
+        self._evaluate(now, view)
+        with self._lock:
+            self.polls += 1
+            self._merged = view
+        return view
+
+    def _fresh_members(self, now: float, targets: List[str]
+                       ) -> Dict[str, Dict[str, Any]]:
+        """Members whose last successful scrape is within the staleness
+        bound (caller must hold self._lock).  Everyone else's snapshot is
+        DROPPED from the merge — a partial view that says so beats a
+        complete-looking view built on ghosts."""
+        fresh = {}
+        for t in targets:
+            m = self._members.get(t)
+            if m and m.get("last_ok") is not None \
+                    and now - m["last_ok"] <= self.stale_after_s:
+                fresh[t] = m["payload"]
+        return fresh
+
+    def _merge(self, now: float, targets: List[str]) -> Dict[str, Any]:
+        with self._lock:
+            fresh = self._fresh_members(now, targets)
+            member_rows = []
+            for t in targets:
+                m = self._members.get(t) or {}
+                up = t in fresh
+                age = (None if m.get("last_ok") is None
+                       else round(now - m["last_ok"], 3))
+                member_rows.append({
+                    "target": t, "up": up, "scrape_age_s": age,
+                    "scrapes": m.get("scrapes", 0),
+                    "failures": m.get("failures", 0),
+                    "error": None if up else m.get("error"),
+                    "identity": (m.get("payload") or {}).get("identity")
+                    if m.get("payload") else None,
+                })
+                metrics.observatory_member_up.labels(member=t).set(
+                    1 if up else 0)
+                if age is not None:
+                    metrics.observatory_scrape_age.labels(member=t).set(age)
+
+        jobs: Dict[str, Dict[str, Any]] = {}
+        exporters: Dict[str, List[str]] = {}
+        shard_owners: Dict[int, List[str]] = {}
+        shard_count: Optional[int] = None
+        wall_s = 0.0
+        goodput_s = 0.0
+        sched_blocks: Dict[str, Dict[str, Any]] = {}
+        for target, payload in fresh.items():
+            for row in payload.get("jobs") or []:
+                key = row.get("job")
+                if not key:
+                    continue
+                exporters.setdefault(key, []).append(target)
+                jobs[key] = {**row, "member": target}
+            for shard in payload.get("shards") or []:
+                shard_owners.setdefault(int(shard), []).append(target)
+            sc = payload.get("shard_count")
+            if sc:
+                shard_count = max(shard_count or 0, int(sc))
+            g = payload.get("goodput") or {}
+            wall_s += float(g.get("wall_s") or 0.0)
+            goodput_s += float(g.get("goodput_s") or 0.0)
+            if payload.get("scheduler"):
+                sched_blocks[target] = payload["scheduler"]
+
+        # the scheduler duty owner's block: the one actually narrating
+        # (queue/rings/verdicts populated); non-owners export empty shells
+        scheduler = None
+        scheduler_member = None
+        best_score = -1
+        for target, block in sched_blocks.items():
+            score = (len(block.get("queue") or [])
+                     + len(block.get("rings") or {})
+                     + len(block.get("verdicts") or {}))
+            if score > best_score:
+                best_score, scheduler, scheduler_member = (
+                    score, block, target)
+
+        coverage = (len(fresh) / len(targets)) if targets else 0.0
+        degraded = len(fresh) < len(targets)
+        metrics.observatory_merged_jobs.set(len(jobs))
+        return {
+            "at": now,
+            "targets": list(targets),
+            "members": member_rows,
+            "fresh": sorted(fresh),
+            "coverage": coverage,
+            "degraded": degraded,
+            "jobs": jobs,
+            "exporters": exporters,
+            "shard_owners": shard_owners,
+            "shard_count": shard_count,
+            "goodput": {
+                "wall_s": round(wall_s, 3),
+                "goodput_s": round(goodput_s, 3),
+                "goodput_ratio": (round(goodput_s / wall_s, 6)
+                                  if wall_s > 0 else None),
+            },
+            "scheduler": scheduler,
+            "scheduler_member": scheduler_member,
+        }
+
+    # -- partition-invariant verification ------------------------------------
+
+    def _verify(self, now: float, view: Dict[str, Any]) -> None:
+        """Detect partition violations in the merged view and fire the
+        ones that outlive the handoff grace.  A double export observed
+        DURING a shard handoff is the protocol working (old owner's last
+        scrape + new owner's first overlap for up to one lease term);
+        only persistence past the grace window is a bug."""
+        current: Dict[Tuple[str, str], List[str]] = {}
+        for key, members in view["exporters"].items():
+            if len(members) > 1:
+                current[("job-double-export", key)] = sorted(members)
+        for shard, owners in view["shard_owners"].items():
+            if len(owners) > 1:
+                current[("shard-double-owned", str(shard))] = sorted(owners)
+        # orphan detection needs FULL coverage and a declared shard space:
+        # with a member unscraped, its shards merely look unowned
+        if not view["degraded"] and view["shard_count"] \
+                and self.check_orphans:
+            for shard in range(view["shard_count"]):
+                if shard not in view["shard_owners"]:
+                    current[("shard-orphaned", str(shard))] = []
+
+        with self._lock:
+            for vkey in [k for k in self._pending if k not in current]:
+                self._pending.pop(vkey)  # healed inside the grace window
+            for vkey, members in current.items():
+                entry = self._pending.get(vkey)
+                if entry is None:
+                    entry = self._pending[vkey] = {
+                        "first": now, "members": members, "fired": False}
+                entry["members"] = members
+                if (not entry["fired"]
+                        and now - entry["first"] >= self.handoff_grace_s):
+                    entry["fired"] = True
+                    kind, subject = vkey
+                    metrics.observatory_partition_violations.labels(
+                        kind=kind).inc()
+                    self._fired.append({
+                        "kind": kind, "subject": subject,
+                        "members": members,
+                        "persisted_s": round(now - entry["first"], 3),
+                        "at": time.time(),
+                    })
+                    log.warning(
+                        "partition violation: %s on %s (members: %s) "
+                        "persisted %.2fs past the handoff grace",
+                        kind, subject, members or "none",
+                        now - entry["first"])
+
+    # -- SLO evaluation ------------------------------------------------------
+
+    def _evaluate(self, now: float, view: Dict[str, Any]) -> None:
+        for slo in self.slos:
+            try:
+                sample = slo.sample(view)
+            except Exception:  # noqa: TPL005 - a broken objective must not kill the loop
+                log.exception("SLO %s sample failed; freezing this cycle",
+                              slo.name)
+                sample = None
+            with self._lock:
+                state = self._alerts[slo.name]
+                window = self._windows[slo.name]
+                state["frozen"] = sample is None
+                if sample is not None:
+                    state["last_sample"] = round(sample, 6)
+                    window.add(now, sample)
+                short_avg = window.avg(now, slo.short_window_s)
+                long_avg = window.avg(now, slo.long_window_s)
+                burn_short = (None if short_avg is None
+                              else short_avg / slo.budget)
+                burn_long = (None if long_avg is None
+                             else long_avg / slo.budget)
+                state["burn_short"] = burn_short
+                state["burn_long"] = burn_long
+                if burn_short is not None:
+                    metrics.slo_burn_rate.labels(
+                        slo=slo.name, window="short").set(burn_short)
+                if burn_long is not None:
+                    metrics.slo_burn_rate.labels(
+                        slo=slo.name, window="long").set(burn_long)
+                if (not state["active"] and burn_short is not None
+                        and burn_long is not None
+                        and burn_short >= slo.burn_threshold
+                        and burn_long >= slo.burn_threshold):
+                    # both windows burning: a sustained breach, not a
+                    # scrape race — one episode, one increment
+                    state["active"] = True
+                    state["since"] = now
+                    state["fired_total"] += 1
+                    metrics.slo_alerts.labels(slo=slo.name).inc()
+                    metrics.slo_alert_active.labels(slo=slo.name).set(1)
+                    log.warning("SLO alert FIRING: %s (burn short=%.2f "
+                                "long=%.2f, budget=%.3f)", slo.name,
+                                burn_short, burn_long, slo.budget)
+                elif (state["active"] and burn_short is not None
+                      and burn_short < slo.burn_threshold * slo.clear_factor):
+                    # hysteresis clear on the SHORT window: recovery is
+                    # visible fast, and the clear bar is well under the
+                    # fire bar so boundary noise cannot flap
+                    state["active"] = False
+                    state["since"] = None
+                    metrics.slo_alert_active.labels(slo=slo.name).set(0)
+                    log.info("SLO alert cleared: %s", slo.name)
+
+    # -- read surfaces -------------------------------------------------------
+
+    def merged_snapshot(self) -> Dict[str, Any]:
+        """The ``/debug/observatory`` payload: the last merged view plus
+        the violation ledger (pending = inside the grace window)."""
+        with self._lock:
+            view = dict(self._merged)
+            pending = [
+                {"kind": k, "subject": s, "members": e["members"],
+                 "age_s": None, "fired": e["fired"]}
+                for (k, s), e in self._pending.items()]
+            fired = list(self._fired)
+            polls = self.polls
+        view.pop("exporters", None)  # internal: violations carry the names
+        jobs = view.pop("jobs", {})
+        view["jobs"] = sorted(jobs.values(), key=lambda r: r.get("job", ""))
+        view["job_count"] = len(jobs)
+        view["polls"] = polls
+        view["interval_s"] = self.interval_s
+        view["handoff_grace_s"] = self.handoff_grace_s
+        view["stale_after_s"] = self.stale_after_s
+        view["violations"] = {"pending": pending, "fired": fired}
+        return view
+
+    def alerts_snapshot(self) -> List[Dict[str, Any]]:
+        """The ``/debug/alerts`` payload, one row per objective."""
+        out = []
+        with self._lock:
+            for slo in self.slos:
+                state = self._alerts[slo.name]
+                out.append({
+                    "slo": slo.name,
+                    "objective": slo.objective,
+                    "budget": slo.budget,
+                    "burn_threshold": slo.burn_threshold,
+                    "windows_s": {"short": slo.short_window_s,
+                                  "long": slo.long_window_s},
+                    "burn_short": state["burn_short"],
+                    "burn_long": state["burn_long"],
+                    "last_sample": state["last_sample"],
+                    "frozen": state["frozen"],
+                    "active": state["active"],
+                    "fired_total": state["fired_total"],
+                })
+        return out
+
+    def violations(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._fired)
+
+    def alert_state(self, name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            state = self._alerts.get(name)
+            return dict(state) if state is not None else None
+
+    def why(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        """The merged ``/debug/why``: ask every member on demand, return
+        the most informative answer (the scheduler duty owner's verdict
+        beats a non-owner's empty shell) with every member's view
+        attached.  None = no member knows the job (404)."""
+        answers: Dict[str, Any] = {}
+        for target in self.targets:
+            try:
+                payload = self._fetch(
+                    target, f"/debug/why/{namespace}/{name}")
+            except Exception:  # noqa: TPL005 - a dead member degrades the answer, never the request
+                continue
+            if payload is not None:
+                answers[target] = payload
+
+        def score(p: Dict[str, Any]) -> Tuple[int, int]:
+            return (1 if p.get("verdict") or p.get("admitted") else 0,
+                    len(p.get("ring") or ()))
+
+        if not answers:
+            return None
+        best = max(answers, key=lambda t: score(answers[t]))
+        return {
+            "job": f"{namespace}/{name}",
+            "answer": answers[best],
+            "answered_by": best,
+            "members": answers,
+        }
+
+    # -- run loop ------------------------------------------------------------
+
+    def start(self, stop_event: threading.Event) -> threading.Thread:
+        # start before publish: a shutdown racing construction must never
+        # join a created-but-unstarted Thread (TPL001)
+        thread = threading.Thread(target=self.run, args=(stop_event,),
+                                  daemon=True, name="tpujob-observatory")
+        thread.start()
+        self._thread = thread
+        return thread
+
+    def run(self, stop_event: threading.Event) -> None:
+        while not stop_event.wait(self.interval_s):
+            try:
+                self.poll()
+            except Exception:  # noqa: TPL005 - the scrape loop is the one retry policy
+                log.exception("observatory poll failed; retrying next "
+                              "interval")
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+
+class _ObsHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _payload(self, path: str):
+        obs: Observatory = self.server.observatory
+        parts = [p for p in path.split("/") if p]
+        if parts == ["debug", "observatory"]:
+            return obs.merged_snapshot()
+        if parts == ["debug", "alerts"]:
+            return obs.alerts_snapshot()
+        if len(parts) == 4 and parts[:2] == ["debug", "why"]:
+            return obs.why(parts[2], parts[3])
+        return None
+
+    def do_GET(self):
+        path = self.path.partition("?")[0]
+        if path.startswith("/metrics"):
+            body = REGISTRY.expose().encode()
+            ctype, code = "text/plain; version=0.0.4", 200
+        elif path.startswith("/healthz"):
+            body, ctype, code = b"ok", "text/plain", 200
+        elif path.startswith("/debug/"):
+            payload = self._payload(path)
+            if payload is None:
+                body, ctype, code = (b'{"error": "not found"}',
+                                     "application/json", 404)
+            else:
+                body = json.dumps(payload, indent=2).encode()
+                ctype, code = "application/json", 200
+        else:
+            body, ctype, code = b"not found", "text/plain", 404
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class ObservatoryServer:
+    """The observatory's own listener: /metrics, /healthz,
+    /debug/observatory, /debug/alerts, /debug/why/<ns>/<name>."""
+
+    def __init__(self, observatory: Observatory, host: str = "0.0.0.0",
+                 port: int = 0):
+        self.httpd = ThreadingHTTPServer((host, port), _ObsHandler)
+        self.httpd.daemon_threads = True
+        self.httpd.observatory = observatory
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "ObservatoryServer":
+        # start before publish (TPL001)
+        thread = threading.Thread(target=self.httpd.serve_forever,
+                                  daemon=True, name="tpujob-observatory-http")
+        thread.start()
+        self._thread = thread
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# standalone entrypoint
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpujob-observatory",
+        description="scrape-merge N operator members into one invariant-"
+                    "checked fleet view with SLO burn-rate alerting")
+    parser.add_argument("--targets", required=True,
+                        help="comma-separated member base URLs")
+    parser.add_argument("--interval", type=float, default=1.0,
+                        dest="interval_s")
+    parser.add_argument("--handoff-grace", type=float, default=20.0,
+                        dest="handoff_grace_s",
+                        help="seconds a partition violation must persist "
+                             "(size to lease duration + one interval)")
+    parser.add_argument("--port", type=int, default=9090,
+                        help="observatory HTTP port (0 = ephemeral)")
+    args = parser.parse_args(argv)
+
+    obs = Observatory(
+        targets=[t.strip() for t in args.targets.split(",") if t.strip()],
+        interval_s=args.interval_s,
+        handoff_grace_s=args.handoff_grace_s)
+    server = ObservatoryServer(obs, port=max(0, args.port)).start()
+    log.info("observatory on :%d (/debug/observatory, /debug/alerts)",
+             server.port)
+    stop = threading.Event()
+    obs.start(stop)
+    try:
+        while not stop.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        stop.set()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

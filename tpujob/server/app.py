@@ -195,6 +195,8 @@ class OperatorApp:
             )
             self.controller.set_scheduler(self.scheduler)
         self.monitoring: Optional[MonitoringServer] = None
+        self.observatory = None  # Observatory when --observatory is on
+        self.observatory_server = None  # its HTTP listener
         self.stop_event = threading.Event()
         self.controller_threads: list = []
         self._elector_thread: Optional[threading.Thread] = None
@@ -209,14 +211,19 @@ class OperatorApp:
         configure_root_logging(self.opt.json_log_format)
         setup_signal_handler(self.stop_event)
         if self.opt.monitoring_port:
+            # negative port = ephemeral bind (port 0); the negative value
+            # stays truthy so the gate above still opens
             self.monitoring = MonitoringServer(
-                port=self.opt.monitoring_port,
+                port=max(0, self.opt.monitoring_port),
                 flight=self.controller.flight,
                 fleet=self.controller.fleet_snapshot,
                 debug_state=self.controller.debug_job_state,
+                why=self.controller.explain_job,
             ).start()
             log.info("monitoring on :%d/metrics (+/debug/jobs, /debug/fleet)",
                      self.monitoring.port)
+        if self.opt.enable_observatory:
+            self._start_observatory()
 
         def start_controller():
             log.info("starting controller (threadiness=%d%s)",
@@ -306,6 +313,43 @@ class OperatorApp:
             finally:
                 self.shutdown()
 
+    def _start_observatory(self) -> None:
+        """In-process fleet observatory (--observatory): scrape the
+        member list in --observatory-targets (default: just this member's
+        own monitoring endpoint), merge, verify, alert.  The handoff
+        grace defaults to one lease term plus one scrape interval — the
+        window in which a double export is the protocol, not a bug."""
+        from tpujob.obs.observatory import Observatory, ObservatoryServer
+
+        targets = [t.strip()
+                   for t in self.opt.observatory_targets.split(",")
+                   if t.strip()]
+        # an explicit target list is the whole membership catalog, so the
+        # shard-orphan invariant is falsifiable; the self-scrape default
+        # is knowingly partial and must not run it
+        whole_fleet = bool(targets)
+        if not targets:
+            if self.monitoring is None:
+                log.warning("--observatory without targets or a monitoring "
+                            "port: nothing to scrape; skipping")
+                return
+            targets = [f"http://127.0.0.1:{self.monitoring.port}"]
+        grace = self.opt.observatory_handoff_grace_s
+        if grace <= 0:
+            grace = self.opt.lease_duration_s + self.opt.observatory_interval_s
+        self.observatory = Observatory(
+            targets=targets,
+            interval_s=self.opt.observatory_interval_s,
+            handoff_grace_s=grace,
+            check_orphans=whole_fleet,
+        )
+        self.observatory_server = ObservatoryServer(
+            self.observatory, port=max(0, self.opt.observatory_port)).start()
+        self.observatory.start(self.stop_event)
+        log.info("observatory on :%d scraping %d member(s) "
+                 "(handoff grace %.1fs)",
+                 self.observatory_server.port, len(targets), grace)
+
     def lease_namespace(self) -> str:
         """The namespace holding the leader-election Lease: the operator's
         OWN namespace, like the reference derives from KUBEFLOW_NAMESPACE
@@ -352,6 +396,11 @@ class OperatorApp:
         for t in self.controller_threads:
             threads.append(t)
             t.join(timeout=2)
+        if self.observatory is not None and self.observatory._thread is not None:
+            threads.append(self.observatory._thread)
+            self.observatory._thread.join(timeout=2)
+        if self.observatory_server is not None:
+            self.observatory_server.stop()
         if self.monitoring:
             self.monitoring.stop()
         return not any(t.is_alive() for t in threads)

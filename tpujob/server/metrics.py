@@ -703,6 +703,77 @@ fleet_goodput_ratio = Gauge(
     REGISTRY,
 )
 
+# Fleet observatory series (tpujob/obs/observatory): the scrape-merge
+# plane.  Moved ONLY by an observatory instance (never by a fleet member),
+# so running the observatory in-process next to a member keeps every family
+# single-writer.  The partition-violation counter is the first-class alarm
+# for the invariant every tpujob_job_* family documents: each job has
+# exactly one exporter, and shard_ownership sums to 1 per shard — a
+# violation that outlives the declared handoff grace names its kind here
+# (job-double-export / shard-double-owned / shard-orphaned).
+observatory_scrapes = LabeledCounter(
+    "tpujob_observatory_scrapes_total",
+    "Member scrape attempts by outcome (result=ok / error; one per member "
+    "per poll cycle)",
+    REGISTRY,
+    ("member", "result"),
+)
+observatory_partition_violations = LabeledCounter(
+    "tpujob_observatory_partition_violations_total",
+    "Partition-invariant violations that persisted past the handoff grace "
+    "window (kind=job-double-export / shard-double-owned / shard-orphaned; "
+    "one increment per violation episode, offending members named in "
+    "/debug/observatory)",
+    REGISTRY,
+    ("kind",),
+)
+observatory_member_up = LabeledGauge(
+    "tpujob_observatory_member_up",
+    "Whether the member's last scrape succeeded within the staleness bound "
+    "(1) or its view is stale/unreachable (0)",
+    REGISTRY,
+    ("member",),
+)
+observatory_scrape_age = LabeledGauge(
+    "tpujob_observatory_scrape_age_seconds",
+    "Seconds since the member's last successful scrape (observatory "
+    "monotonic clock)",
+    REGISTRY,
+    ("member",),
+)
+observatory_merged_jobs = Gauge(
+    "tpujob_observatory_merged_jobs",
+    "Distinct jobs in the merged fleet view as of the last poll cycle "
+    "(each counted once regardless of how many members exported it)",
+    REGISTRY,
+)
+
+# SLO engine series: declarative objectives evaluated over the MERGED view
+# with multi-window burn-rate alerting (short + long windows must both
+# burn past the threshold to fire — one alerts_total increment per
+# episode, hysteresis on clear, so scrape races cannot flap an alert).
+slo_burn_rate = LabeledGauge(
+    "tpujob_slo_burn_rate",
+    "Error-budget burn rate of the objective over the named window "
+    "(window=short / long; 1.0 = burning exactly the budget)",
+    REGISTRY,
+    ("slo", "window"),
+)
+slo_alert_active = LabeledGauge(
+    "tpujob_slo_alert_active",
+    "Whether the objective's burn-rate alert is currently firing (1) or "
+    "not (0)",
+    REGISTRY,
+    ("slo",),
+)
+slo_alerts = LabeledCounter(
+    "tpujob_slo_alerts_total",
+    "Burn-rate alert episodes fired per objective (an episode increments "
+    "once on fire; the clear is hysteresis-gated, not counted)",
+    REGISTRY,
+    ("slo",),
+)
+
 jobs_stalled = Counter(
     "tpujob_operator_stalled_jobs_total",
     "Stalled-condition flips by the progress watchdog (each is one detected "

@@ -42,6 +42,11 @@ class _Handler(BaseHTTPRequestHandler):
         if parts == ["debug", "fleet"]:
             fleet = getattr(self.server, "fleet", None)
             return fleet() if callable(fleet) else None
+        if len(parts) == 4 and parts[:2] == ["debug", "why"]:
+            # "why is my job not running": the scheduler's verdict +
+            # decision ring for one job (see docs/failure-handling)
+            why = getattr(self.server, "why", None)
+            return why(parts[2], parts[3]) if callable(why) else None
         flight = getattr(self.server, "flight", None)
         if flight is None:
             return None
@@ -89,7 +94,7 @@ class _Handler(BaseHTTPRequestHandler):
 
 class MonitoringServer:
     def __init__(self, host: str = "0.0.0.0", port: int = 8443, flight=None,
-                 fleet=None, debug_state=None):
+                 fleet=None, debug_state=None, why=None):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.daemon_threads = True
         # the flight recorder backing /debug/* (None = endpoints 404)
@@ -98,6 +103,8 @@ class MonitoringServer:
         self.httpd.fleet = fleet
         # callable(ns, name) merged into /debug/jobs/<ns>/<name> as "status"
         self.httpd.debug_state = debug_state
+        # callable(ns, name) behind /debug/why/<ns>/<name> (None = 404)
+        self.httpd.why = why
         self._thread: Optional[threading.Thread] = None
 
     @property

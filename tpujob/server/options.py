@@ -19,6 +19,9 @@ class ServerOption:
     json_log_format: bool = True
     enable_gang_scheduling: bool = False
     gang_scheduler_name: str = "volcano"
+    # /metrics + /debug listener: 0 disables, negative binds an ephemeral
+    # port (tests/smokes that need a real scrapable HTTP surface without
+    # fighting over fixed ports; the bound port is MonitoringServer.port)
     monitoring_port: int = 8443
     resync_period_s: float = 12 * 3600
     init_container_image: str = "alpine:3.10"
@@ -128,6 +131,23 @@ class ServerOption:
     # a flapping node can never drive a migration storm.  <= 0 derives two
     # grace periods.
     node_migration_damp_s: float = 0.0
+    # fleet observatory (--observatory): an in-process thread scraping N
+    # member /metrics + /debug/fleet endpoints on an interval, merging them
+    # into one invariant-checked fleet view with SLO burn-rate alerting
+    # (tpujob/obs/observatory; also runnable standalone via
+    # `python -m tpujob.obs.observatory --targets ...`)
+    enable_observatory: bool = False
+    # comma-separated member base URLs to scrape; "" = self-scrape this
+    # instance's own monitoring listener (single-member observatory)
+    observatory_targets: str = ""
+    observatory_interval_s: float = 1.0
+    # HTTP port for the observatory's merged /debug/observatory +
+    # /debug/alerts + /debug/why surface (0 disables, negative = ephemeral)
+    observatory_port: int = 0
+    # how long a partition-invariant violation (job double-exported /
+    # shard orphaned) must PERSIST before it counts: the legitimate shard-
+    # handoff window.  <= 0 derives lease_duration + one scrape interval.
+    observatory_handoff_grace_s: float = 0.0
 
 
 class _LazyVersionAction(argparse.Action):
@@ -156,7 +176,8 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--enable-gang-scheduling", action="store_true", default=False)
     parser.add_argument("--gang-scheduler-name", default="volcano")
     parser.add_argument("--monitoring-port", type=int, default=8443,
-                        help="port for /metrics and /healthz (0 disables)")
+                        help="port for /metrics and /healthz (0 disables, "
+                             "negative binds an ephemeral port)")
     parser.add_argument("--resync-period", type=float, default=12 * 3600, dest="resync_period_s")
     parser.add_argument("--init-container-image", default="alpine:3.10")
     parser.add_argument("--enable-leader-election", action="store_true", default=True)
@@ -358,6 +379,37 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                              "(a host triggers at most one migration "
                              "episode per window, doubling per episode; "
                              "<=0 derives two node-grace periods)")
+    parser.add_argument("--observatory", dest="enable_observatory",
+                        action="store_true", default=False,
+                        help="run the fleet observatory in-process: scrape "
+                             "the member /debug/fleet endpoints on an "
+                             "interval, merge them into one invariant-"
+                             "checked fleet view, and evaluate the SLO "
+                             "burn-rate alerts")
+    parser.add_argument("--no-observatory", dest="enable_observatory",
+                        action="store_false",
+                        help="disable the in-process fleet observatory")
+    parser.add_argument("--observatory-targets", default="",
+                        dest="observatory_targets",
+                        help="comma-separated member base URLs the "
+                             "observatory scrapes (e.g. "
+                             "'http://op-0:8443,http://op-1:8443'); empty "
+                             "= scrape this instance's own listener")
+    parser.add_argument("--observatory-interval", type=float, default=1.0,
+                        dest="observatory_interval_s",
+                        help="observatory scrape/merge cadence in seconds")
+    parser.add_argument("--observatory-port", type=int, default=0,
+                        dest="observatory_port",
+                        help="port for the observatory's merged "
+                             "/debug/observatory + /debug/alerts + "
+                             "/debug/why surface (0 disables, negative = "
+                             "ephemeral)")
+    parser.add_argument("--observatory-handoff-grace", type=float,
+                        default=0.0, dest="observatory_handoff_grace_s",
+                        help="seconds a partition-invariant violation must "
+                             "persist before it counts (the legitimate "
+                             "shard-handoff window; <=0 derives "
+                             "lease-duration + one scrape interval)")
 
 
 def parse_options(argv: Optional[List[str]] = None) -> ServerOption:

@@ -552,6 +552,20 @@ class GangScheduler:
         # queue positions of the last tick (debug + /debug/fleet)
         self._queue_view: List[Dict[str, Any]] = []  # guarded by self._lock
         self._decisions: collections.deque = collections.deque(maxlen=64)  # guarded by self._lock
+        # per-job decision rings (the /debug/why surface): every entry
+        # carries a monotonic per-job seq plus the duty epoch, so a merged
+        # reader detects gaps after a handoff (seq restarts, epoch rises)
+        # instead of silently splicing two members' histories.  Bounded per
+        # job (deque maxlen) and pruned with the other per-job maps.
+        self._rings: Dict[str, collections.deque] = {}  # guarded by self._lock
+        self._ring_seq: Dict[str, int] = {}  # guarded by self._lock
+        self._ring_epoch = 0  # guarded by self._lock; bumps per duty acquisition
+        self._duty_active = False  # guarded by self._lock
+        # last per-job queue verdict (why-not-running), recorded into the
+        # ring only on CHANGE so a stable wait does not wash the ring out
+        self._verdicts: Dict[str, Dict[str, Any]] = {}  # guarded by self._lock
+        # admitted-state view of the last tick (explain() reads it)
+        self._admitted_view: Dict[str, Dict[str, Any]] = {}  # guarded by self._lock
         self._tick_durations: collections.deque = collections.deque(maxlen=512)  # guarded by self._lock
         self.admissions = 0  # guarded by self._lock; lifetime admission count
         self.preemptions = 0  # guarded by self._lock; lifetime preemption count
@@ -993,7 +1007,23 @@ class GangScheduler:
                 self._queued_anchor.clear()
                 self._preempt_anchor.clear()
                 self._health_sent.clear()
+                # the decision rings drop with the duty: another member
+                # narrates the protocol now, and a reader merging both
+                # members' rings would see one job twice.  The next
+                # acquisition rebuilds them (fresh epoch) from durable
+                # annotations + re-observed ticks.
+                self._rings.clear()
+                self._ring_seq.clear()
+                self._verdicts.clear()
+                self._admitted_view.clear()
+                self._duty_active = False
             return {"active": False}
+        with self._lock:
+            if not self._duty_active:
+                # duty (re)acquired: a fresh epoch marks the ring rebuild
+                # boundary for merged readers (seq restarts at 1)
+                self._duty_active = True
+                self._ring_epoch += 1
         t0 = time.monotonic()
         now = t0 if now is None else now
         shard = (SCHEDULER_SHARD if self.controller.sharder is not None
@@ -1183,9 +1213,12 @@ class GangScheduler:
         blocked = False
         unplaced = False
         flexed = 0
-        for _, req, key, ns, name, since, eff in entries:
+        head_key: Optional[str] = None  # who blocked the scan (explainability)
+        examined: set = set()
+        for pos, (_, req, key, ns, name, since, eff) in enumerate(entries):
             if blocked:
                 break
+            examined.add(key)
             asg = cap.place(req, key)
             if asg is not None:
                 if self._patch(ns, name, {
@@ -1207,13 +1240,17 @@ class GangScheduler:
                     # model just booked is NOT durably held — stop the scan
                     # so no later gang is placed around a phantom booking
                     blocked = True
+                    head_key = key
                 continue
             # no room for this gang: the capacity planner prices every
             # legal move against strictly-lower-tier gangs — flex shrinks
             # (restore cost only) before migrations before preemptions
             # (full projected goodput loss) — and returns the cheapest set
             # that frees enough contiguous capacity
-            moves = self._plan_capacity(req, eff, admitted, cap)
+            moves, plan_why = self._plan_capacity(req, eff, admitted, cap)
+            self._record_verdict(key, self._queued_verdict(
+                req, eff, pos, max(0.0, now - since), admitted, cap,
+                moves, plan_why))
             if moves:
                 for kind, victim, target, cost in moves:
                     if kind == "flex":
@@ -1246,6 +1283,7 @@ class GangScheduler:
                 # head-of-line while its capacity frees: no backfill
                 # may steal the hosts the moves are vacating
                 blocked = True
+                head_key = key
                 continue
             unplaced = True
             if eff >= TIER_MAX:
@@ -1253,6 +1291,45 @@ class GangScheduler:
                 # hold the line — backfilling past it is exactly how a big
                 # gang starves behind an endless stream of small ones
                 blocked = True
+                head_key = key
+
+        # the entries the blocked scan never reached: their verdict is pure
+        # queue position — nothing about THEIR shape was judged this tick
+        for pos, (_, req, key, ns, name, since, eff) in enumerate(entries):
+            if key in examined:
+                continue
+            self._record_verdict(key, {
+                "reason": "queue-position",
+                "detail": (f"queue position {pos} behind {head_key} "
+                           "(head-of-line holds the scan while its "
+                           "capacity frees)"),
+                "behind": head_key,
+                "position": pos, "tier": req.tier, "effective_tier": eff,
+                "aging_credit": eff - req.tier,
+                "wait_s": round(max(0.0, now - since), 3),
+                "blockers": [head_key] if head_key else [],
+            })
+
+        # admitted/queued state views + verdict GC for jobs that left the
+        # queue (admitted, finished, deleted) — a stale why-not-running
+        # answer is worse than none
+        queued_keys = {key for _, _, key, _, _, _, _ in entries}
+        with self._lock:
+            self._admitted_view = {
+                a.key: {
+                    "tier": a.tier,
+                    "accelerator": a.assignment.accelerator,
+                    "slices": len(a.assignment.slices),
+                    "chips": a.assignment.chips,
+                    "evicting": a.evicting,
+                    "preempting": a.preempting,
+                    "flex": a.flex,
+                } for a in admitted}
+            for k in [k for k in self._verdicts if k not in queued_keys]:
+                self._verdicts.pop(k, None)
+            for d in (self._rings, self._ring_seq):
+                for k in [k for k in d if k not in seen]:
+                    d.pop(k, None)
 
         metrics.sched_fragmentation.set(fragmentation_ratio(cap))
         if not blocked and not unplaced:
@@ -1383,8 +1460,10 @@ class GangScheduler:
         return view.projected_loss_s
 
     def _plan_capacity(self, req: GangRequest, eff_tier: int,
-                       admitted: List[_Admitted], cap: CapacityModel
-                       ) -> List[Tuple[str, _Admitted, int, float]]:
+                       admitted: List[_Admitted], cap: CapacityModel,
+                       allow_flex: Optional[bool] = None,
+                       allow_preempt: Optional[bool] = None,
+                       ) -> Tuple[List[Tuple[str, _Admitted, int, float]], str]:
         """Choose the cheapest move set that makes ``req`` placeable:
         strictly-lower-tier gangs only, every legal move priced by the
         goodput ledger and the cheapest (tier, cost) picked each round —
@@ -1393,10 +1472,19 @@ class GangScheduler:
         projected loss: redo + restore + requeue).  In-flight evictions,
         preemptions and flex drains count as already freeing — a tick
         must not pick NEW victims for capacity that is already being
-        vacated.  Returns (kind, victim, flex_target, cost_s) tuples,
-        one per victim (multiple shrinks of one gang coalesce into its
-        final target — one publish, one drain); [] when no workable set
-        exists (or none is needed beyond what is already vacating)."""
+        vacated.  Returns ``(plan, why)``: (kind, victim, flex_target,
+        cost_s) tuples, one per victim (multiple shrinks of one gang
+        coalesce into its final target — one publish, one drain); an
+        empty plan carries why it is empty ('already-freeing' /
+        'movers-disabled' / 'no-victims') for the explainability verdict.
+
+        ``allow_flex``/``allow_preempt`` override the configured movers
+        (None = configured): the explainer prices the HYPOTHETICAL ladder
+        — what admitting this gang would cost if policy permitted — on a
+        throwaway clone, without mutating anything."""
+        allow_flex = self.enable_flex if allow_flex is None else allow_flex
+        allow_preempt = (self.enable_preemption if allow_preempt is None
+                         else allow_preempt)
         sim = cap.clone()
         for a in admitted:
             if a.evicting or a.preempting:
@@ -1407,9 +1495,10 @@ class GangScheduler:
                 sim.release(a.key)
                 sim.reserve(a.key, trimmed_assignment(a.assignment, a.flex))
         if sim.clone().place(req, "probe") is not None:
-            return []  # already freeing enough: wait, don't over-move
-        if not self.enable_flex and not self.enable_preemption:
-            return []
+            # already freeing enough: wait, don't over-move
+            return [], "already-freeing"
+        if not allow_flex and not allow_preempt:
+            return [], "movers-disabled"
         views: Dict[str, Optional[GoodputView]] = {}
 
         def view_of(key: str) -> Optional[GoodputView]:
@@ -1431,7 +1520,7 @@ class GangScheduler:
                     cur = (min(len(a.assignment.slices), a.flex)
                            if a.flex is not None
                            else len(a.assignment.slices))
-                if (self.enable_flex and a.req is not None
+                if (allow_flex and a.req is not None
                         and cur > self._flex_floor(a)):
                     # a shrink only costs the re-rendezvous restore: the
                     # drain runs the checkpoint barrier (no redo) and the
@@ -1442,7 +1531,7 @@ class GangScheduler:
                     cand = ((a.tier, cost, 0, a.key), "flex", a, cur, cost)
                     if best is None or cand[0] < best[0]:
                         best = cand
-                if self.enable_preemption and a.key not in shrunk:
+                if allow_preempt and a.key not in shrunk:
                     v = view_of(a.key)
                     cost = (float("inf") if v is None
                             else v.projected_loss_s)
@@ -1451,7 +1540,33 @@ class GangScheduler:
                     if best is None or cand[0] < best[0]:
                         best = cand
             if best is None:
-                return []  # no workable move set exists
+                # every shrink bottomed out at its floor: escalate the
+                # cheapest already-shrunk victim to a full preemption (the
+                # shrink never happened — one move per victim) before
+                # declaring the request infeasible
+                esc = None
+                if allow_preempt:
+                    for a in admitted:
+                        if (a.evicting or a.preempting or a.key in evicted
+                                or a.tier >= eff_tier
+                                or a.key not in shrunk):
+                            continue
+                        v = view_of(a.key)
+                        cost = (float("inf") if v is None
+                                else v.projected_loss_s)
+                        cand = ((a.tier, cost, a.key), a, cost)
+                        if esc is None or cand[0] < esc[0]:
+                            esc = cand
+                if esc is None:
+                    return [], "no-victims"  # no workable move set exists
+                _, victim, cost = esc
+                shrunk.pop(victim.key)
+                evicted.add(victim.key)
+                costs[victim.key] = cost
+                sim.release(victim.key)
+                if sim.clone().place(req, "probe") is not None:
+                    break
+                continue
             _, kind, victim, cur, cost = best
             costs[victim.key] = cost
             if kind == "flex":
@@ -1470,7 +1585,81 @@ class GangScheduler:
                 plan.append(("preempt", a, 0, costs[a.key]))
             elif a.key in shrunk:
                 plan.append(("flex", a, shrunk[a.key], costs[a.key]))
-        return plan
+        return plan, "planned"
+
+    def _queued_verdict(self, req: GangRequest, eff: int, position: int,
+                        wait_s: float, admitted: List[_Admitted],
+                        cap: CapacityModel,
+                        moves: List[Tuple[str, _Admitted, int, float]],
+                        plan_why: str) -> Dict[str, Any]:
+        """Why this queued gang is not running RIGHT NOW, with who blocks
+        it and what the flex/migrate/preempt ladder would charge to run it
+        anyway (the PR-13 projected-loss pricing).  Three reasons:
+
+        - ``waiting-on-drain``: capacity is being vacated for it (moves
+          planned this tick, or in-flight evictions/flex drains already
+          free enough) — admission lands when the pods are gone;
+        - ``fair-share-position``: policy protects the occupants (equal/
+          higher tier, or the movers are disabled) — the hypothetical
+          ladder below prices what admitting it WOULD cost;
+        - ``infeasible-now``: no move set frees a contiguous placement at
+          all (fragmentation or sheer shape) — only finishing jobs or new
+          capacity unblock it.
+        """
+        base = {
+            "position": position, "tier": req.tier, "effective_tier": eff,
+            "aging_credit": eff - req.tier, "wait_s": round(wait_s, 3),
+        }
+        if moves:
+            ladder = [{"kind": kind, "job": v.key, "tier": v.tier,
+                       "flex_target": target if kind == "flex" else None,
+                       "cost_s": round(cost, 3)}
+                      for kind, v, target, cost in moves]
+            return {**base, "reason": "waiting-on-drain",
+                    "detail": ("capacity planner is vacating "
+                               + ", ".join(f"{m['job']} ({m['kind']})"
+                                           for m in ladder)),
+                    "blockers": [m["job"] for m in ladder],
+                    "ladder": ladder}
+        if plan_why == "already-freeing":
+            vacating = [a.key for a in admitted
+                        if a.evicting or a.preempting
+                        or (a.flex is not None
+                            and a.flex < len(a.assignment.slices))]
+            return {**base, "reason": "waiting-on-drain",
+                    "detail": ("enough capacity is already vacating: "
+                               + (", ".join(vacating) or "(in flight)")),
+                    "blockers": vacating, "ladder": []}
+        # nothing planned: price the HYPOTHETICAL ladder — every mover
+        # enabled, every tier a candidate — on a throwaway clone.  A
+        # non-empty answer means policy (tier protection, fair share,
+        # disabled movers) is what stands between this gang and capacity;
+        # an empty one means no move set would help at all.
+        hyp, _ = self._plan_capacity(req, TIER_MAX + 1, admitted, cap,
+                                     allow_flex=True, allow_preempt=True)
+        if hyp:
+            ladder = [{"kind": kind, "job": v.key, "tier": v.tier,
+                       "flex_target": target if kind == "flex" else None,
+                       "cost_s": round(cost, 3)}
+                      for kind, v, target, cost in hyp]
+            cause = ("movers disabled"
+                     if not self.enable_flex and not self.enable_preemption
+                     else "occupants are equal or higher tier")
+            return {**base, "reason": "fair-share-position",
+                    "detail": (f"blocked by "
+                               + ", ".join(f"{m['job']} (tier {m['tier']})"
+                                           for m in ladder)
+                               + f" — {cause}; admitting it anyway would "
+                               f"cost {sum(m['cost_s'] for m in ladder):.3f}s "
+                               "projected goodput"),
+                    "blockers": [m["job"] for m in ladder],
+                    "ladder": ladder}
+        return {**base, "reason": "infeasible-now",
+                "detail": ("no move set frees a contiguous "
+                           f"{req.num_slices}x{req.hosts_per_slice}-host "
+                           "placement (fragmentation or shape); waiting "
+                           "on finishing jobs or new capacity"),
+                "blockers": [], "ladder": []}
 
     # -- elastic capacity: num_slices flex -----------------------------------
 
@@ -1883,13 +2072,63 @@ class GangScheduler:
                         "tick", namespace, name, what, e)
             return False
 
+    # how many decision entries each job's ring retains: deep enough to
+    # hold a whole admit -> flex -> preempt -> re-admit arc, shallow enough
+    # that a 10k-job fleet's rings stay bounded
+    RING_SIZE = 32
+
+    def _ring_append_locked(self, key: str, kind: str, detail: str,
+                     extra: Optional[Dict[str, Any]] = None) -> None:
+        """Append one entry to the job's bounded decision ring (caller must
+        hold self._lock).  seq is monotonic per job within one duty epoch;
+        a ring created after a handoff (epoch > 1) opens with an explicit
+        rebuild marker so gap detection never needs heuristics."""
+        ring = self._rings.get(key)
+        if ring is None:
+            ring = self._rings[key] = collections.deque(maxlen=self.RING_SIZE)
+            if self._ring_epoch > 1:
+                seq = self._ring_seq.get(key, 0) + 1
+                self._ring_seq[key] = seq
+                ring.append({
+                    "at": st.now_iso(), "seq": seq,
+                    "epoch": self._ring_epoch, "kind": "ring-rebuilt",
+                    "detail": ("decision ring rebuilt from durable "
+                               "annotations after duty handoff "
+                               f"(epoch {self._ring_epoch})")})
+        seq = self._ring_seq.get(key, 0) + 1
+        self._ring_seq[key] = seq
+        entry: Dict[str, Any] = {
+            "at": st.now_iso(), "seq": seq, "epoch": self._ring_epoch,
+            "kind": kind, "detail": detail}
+        if extra:
+            entry.update(extra)
+        ring.append(entry)
+
     def _note(self, kind: str, key: str, detail: str) -> None:
         with self._lock:
             self._decisions.append({
                 "at": st.now_iso(), "kind": kind, "job": key,
                 "detail": detail})
+            if "/" in key:  # per-job keys only (node/… events have no ring)
+                self._ring_append_locked(key, kind, detail)
         self.controller.flight.record(
             key, "sched", f"{kind}: {detail}", {"kind": kind})
+
+    def _record_verdict(self, key: str, verdict: Dict[str, Any]) -> None:
+        """Record one queued job's why-not-running verdict, appending to
+        its decision ring only when the verdict CHANGED (reason/blockers) —
+        a job waiting stably for minutes keeps its admission history
+        instead of a ring full of identical 'still queued' rows."""
+        with self._lock:
+            prev = self._verdicts.get(key)
+            changed = (prev is None
+                       or prev.get("reason") != verdict.get("reason")
+                       or prev.get("blockers") != verdict.get("blockers"))
+            self._verdicts[key] = verdict
+            if changed:
+                self._ring_append_locked(
+                    key, "queued", verdict.get("detail", ""),
+                    {"verdict": verdict})
 
     # -- observability -------------------------------------------------------
 
@@ -1897,12 +2136,59 @@ class GangScheduler:
         with self._lock:
             return sorted(self._tick_durations)
 
+    def explain(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        """The ``/debug/why/<ns>/<name>`` payload: one job's scheduling
+        state, its latest why-not-running verdict (blockers + ladder
+        price), and its bounded decision ring (seq + epoch for gap
+        detection across handoffs).  None = this member has never seen
+        the job (a merged reader falls through to the member that has)."""
+        key = f"{namespace or 'default'}/{name}"
+        with self._lock:
+            ring = [dict(e) for e in self._rings.get(key, ())]
+            verdict = self._verdicts.get(key)
+            verdict = dict(verdict) if verdict is not None else None
+            admitted = self._admitted_view.get(key)
+            admitted = dict(admitted) if admitted is not None else None
+            unsched = self._unschedulable.get(key)
+            queue_row = next((dict(r) for r in self._queue_view
+                              if r["job"] == key), None)
+            epoch = self._ring_epoch
+            seq = self._ring_seq.get(key, 0)
+        if (not ring and verdict is None and admitted is None
+                and unsched is None and queue_row is None):
+            return None
+        if admitted is not None:
+            state = ("evicting" if admitted.get("evicting")
+                     else "preempting" if admitted.get("preempting")
+                     else "admitted")
+        elif unsched is not None:
+            state = "unschedulable"
+        elif queue_row is not None or verdict is not None:
+            state = "queued"
+        else:
+            state = "unknown"
+        return {
+            "job": key,
+            "state": state,
+            "queue": queue_row,
+            "verdict": verdict,
+            "admitted": admitted,
+            "unschedulable": list(unsched[1]) if unsched is not None else None,
+            "epoch": epoch,
+            "last_seq": seq,
+            "ring": ring,
+        }
+
     def debug_snapshot(self) -> Dict[str, Any]:
         """The scheduler half of ``/debug/fleet``: capacity utilization,
         queue positions, and the recent decision log."""
         with self._lock:
             queue = list(self._queue_view)
             decisions = list(self._decisions)
+            rings = {k: [dict(e) for e in ring]
+                     for k, ring in self._rings.items()}
+            verdicts = {k: dict(v) for k, v in self._verdicts.items()}
+            epoch = self._ring_epoch
             unsched = {k: list(errs)
                        for k, (_, errs) in self._unschedulable.items()}
             admissions, preemptions = self.admissions, self.preemptions
@@ -1942,4 +2228,11 @@ class GangScheduler:
             # bounded (deque maxlen): the decision log can never grow past
             # its ring across a long node-churn soak
             "decisions": decisions,
+            # per-job bounded decision rings with monotonic seq + duty
+            # epoch: a merged reader detects a handoff gap (seq restarted,
+            # epoch rose) instead of splicing two members' histories —
+            # the /debug/why payloads, fleet-wide
+            "epoch": epoch,
+            "rings": rings,
+            "verdicts": verdicts,
         }
