@@ -11,7 +11,7 @@ SHELL := /bin/bash
 export JAX_PLATFORMS ?= cpu
 export XLA_FLAGS ?= --xla_force_host_platform_device_count=8
 
-.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke goodput-smoke flex-smoke observatory-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
+.PHONY: ci ci-fast native lint lint-baseline codegen-verify unit unit-fast test trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke goodput-smoke flex-smoke observatory-smoke federation-smoke e2e soak bench-smoke bench-controller bench-controller-objects dryrun images clean
 
 ci: native lint codegen-verify unit e2e dryrun
 	@echo "ci: ALL PASSED"
@@ -134,9 +134,19 @@ flex-smoke:
 observatory-smoke:
 	$(PY) scripts/observatory_smoke.py
 
+# multi-cluster federation: two whole in-process clusters under one
+# meta-controller — queue spillover through the two-phase transfer, a
+# whole-cluster hard kill failing over within one cluster-lease term +
+# grace (fresh status, restore at the barrier checkpoint), stale
+# federation tokens rejected server-side, exactly-one-cluster-owner at
+# every committed instant (docs/failure-handling, "Cluster failure,
+# spillover & federation semantics")
+federation-smoke:
+	$(PY) scripts/federation_smoke.py
+
 # the tier-1 command from ROADMAP.md, verbatim (modulo $$-escaping for
 # make), so local and CI invocations agree on what "the tests pass" means
-test: lint trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke goodput-smoke flex-smoke observatory-smoke
+test: lint trace-smoke failover-smoke shard-smoke resize-smoke write-path-smoke read-path-smoke telemetry-smoke sched-smoke node-smoke goodput-smoke flex-smoke observatory-smoke federation-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # the operator/controller/kube/api tests only — the model-path suites
@@ -196,6 +206,7 @@ bench-controller:
 	$(PY) bench_controller.py --jobs 10 --workers 8 --watchdog
 	$(PY) bench_controller.py --jobs 10 --workers 8 --goodput
 	$(PY) bench_controller.py --jobs 10 --workers 8 --observatory
+	$(PY) bench_controller.py --jobs 10 --workers 8 --clusters 3
 	$(PY) bench_controller.py --jobs 24 --workers 4 --controllers 4 --threadiness 2
 	$(PY) bench_controller.py --queue 100 --threadiness 4
 

@@ -2025,7 +2025,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--mode",
                         choices=("api", "crash", "failover", "shard",
-                                 "resize", "sched", "nodes", "observatory"),
+                                 "resize", "sched", "nodes", "observatory",
+                                 "federation"),
                         default="api",
                         help="api = transport faults only; crash = + seeded "
                              "controller kills; failover = warm-standby "
@@ -2039,7 +2040,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "heartbeat flap, cordon churn, slice outage) + "
                              "gang migration + faults + a controller kill; "
                              "observatory = scrape-merged fleet view + SLO "
-                             "burn-rate alerting under a membership storm")
+                             "burn-rate alerting under a membership storm; "
+                             "federation = multi-cluster job ownership "
+                             "under a whole-cluster kill, a federation "
+                             "replica departure and a cluster revival")
     parser.add_argument("--storm-kills", type=int, default=6)
     parser.add_argument("--timeout", type=float, default=60.0)
     parser.add_argument("--verbose", action="store_true")
@@ -2075,6 +2079,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         from e2e.observatory import run_observatory_soak
 
         report = run_observatory_soak(args.seed, timeout=args.timeout)
+    elif args.mode == "federation":
+        # imported here: e2e.federation imports this module at load time
+        from e2e.federation import run_federation_soak
+
+        report = run_federation_soak(args.seed, timeout=args.timeout)
     else:
         report = run_soak(args.seed, storm_kills=args.storm_kills,
                           timeout=args.timeout)

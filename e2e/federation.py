@@ -1,0 +1,722 @@
+"""Federation chaos tier: cluster-sharded ownership under cluster death.
+
+``run_federation_smoke`` is the fast acceptance gate (``make
+federation-smoke``): two whole in-process clusters — each its own
+fence-validating API server, two sharded operator members with real HTTP
+``/debug/fleet`` listeners, and a kubelet — under one federation
+meta-controller, asserting the three protocol behaviors end to end:
+
+- **placement + queue spillover** — a gang queued behind a full home
+  cluster beyond the bounded wait is re-targeted through the two-phase
+  transfer (owner annotation stamped on the source, copy created on the
+  target, source deleted only once the mirror settles) and trains to
+  completion on the target;
+- **dark-cluster failover, checkpoint-exact** — every member of a
+  cluster is hard-killed (its workload pods die with their hosts); the
+  federation confirms darkness with an uncached member-lease re-read,
+  durably marks the cluster ``NotReady``, and re-admits its jobs on the
+  survivor within one cluster-lease term + grace + slack, with fresh
+  status (zero counted restarts) and a restore landing exactly on the
+  last checkpoint barrier;
+- **exactly-one-cluster-owner at every committed instant** — post-commit
+  hooks on EVERY store replay the merged event stream: at no committed
+  instant do two live (non-``NotReady``) clusters both hold a local copy
+  claiming itself as the job's owner — and a deposed/dead writer's stale
+  fencing token is rejected server-side on the survivor.
+
+``run_federation_soak`` (``--mode federation``) is the storm tier: three
+clusters and two federation replicas; a seeded cluster kill, a federation
+replica departure (duties re-rendezvous), a cluster revival (the zombie
+sweep must land before the cluster is trusted again), and a post-revival
+placement — invariants: no job lost or duplicated, zero counted restarts
+from failover, ownership exactly-once throughout, all training ledgers
+violation-free.
+
+Runnable:  python -m e2e.chaos --seed 7 --mode federation
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from e2e.chaos import (
+    FaultInjectingAPIServer,
+    _fence_probe,
+    _job,
+    _lock_audit_report,
+    _start_app,
+    _tmpl,
+    _wait_for,
+)
+from e2e.kubelet import KubeletSim, PodScript
+from e2e.observatory import NO_FAULTS, _full_coverage
+from e2e.scheduler import SCHED_CAPACITY, SchedLedger, SchedWorkload
+from tpujob.analysis import lockgraph
+from tpujob.api import constants as c
+from tpujob.api.types import TPUJob
+from tpujob.api.validation import install_tpujob_admission
+from tpujob.kube.client import RESOURCE_TPUJOBS, ClientSet
+from tpujob.kube.errors import ApiError, NotFoundError
+from tpujob.kube.fencing import FencingToken, call_token
+from tpujob.kube.memserver import InMemoryAPIServer
+from tpujob.obs.scrape import http_fetch
+from tpujob.server.federation import (
+    RESOURCE_CLUSTER_STATES,
+    ClusterHandle,
+    FederationController,
+    FederationServer,
+    fed_duty_lease_name,
+)
+
+FED_INTERVAL_S = 0.2
+FED_LEASE_S = 1.0
+
+# member config: real HTTP /debug/fleet listeners (the federation's scrape
+# plane), the modeled scheduler capacity, movers off — cross-cluster moves
+# must come from the FEDERATION's protocol, never a local scheduler mover
+FED_OPT_OVERRIDES = dict(
+    monitoring_port=-1,
+    lease_duration_s=FED_LEASE_S,
+    scheduler_capacity=SCHED_CAPACITY,
+    scheduler_tick_s=0.05,
+    scheduler_aging_s=60.0,
+    scheduler_preemption=False,
+    scheduler_flex=False,
+    scheduler_defrag=False,
+    stall_timeout_s=30.0,
+)
+
+
+def _gang_job(name: str, workers: int, num_slices: int) -> TPUJob:
+    return _job(name, {
+        "runPolicy": {"backoffLimit": 10},
+        "tpuReplicaSpecs": {"Worker": {
+            "replicas": workers,
+            "restartPolicy": c.RESTART_POLICY_EXIT_CODE,
+            "tpu": {"accelerator": "v4-16", "numSlices": num_slices},
+            "template": _tmpl()}},
+    })
+
+
+# ---------------------------------------------------------------------------
+# one whole in-process cluster
+# ---------------------------------------------------------------------------
+
+
+class FedCluster:
+    """One member cluster: its own fence-validating API server, sharded
+    operator members with real monitoring listeners, a kubelet, and a
+    power switch the workload pods die with."""
+
+    def __init__(self, name: str, seed: int, members: int = 2,
+                 shard_count: int = 4):
+        self.name = name
+        self.seed = seed
+        self.members = members
+        self.shard_count = shard_count
+        self.inner = InMemoryAPIServer(bookmark_every=25)
+        install_tpujob_admission(self.inner)
+        self.inner.enable_fence_validation("default", "tpujob-operator")
+        self.chaos = FaultInjectingAPIServer(self.inner, seed=seed,
+                                             config=NO_FAULTS)
+        self.admin = ClientSet(self.inner)
+        # set = this cluster's hosts lost power: every scripted workload
+        # pod exits with them (a dark cluster takes its compute down too)
+        self.node_stop = threading.Event()
+        self.apps: List[Any] = []
+        self.kubelet: Optional[KubeletSim] = None
+        self.dead = False
+
+    def start(self, scripts: List[PodScript], timeout: float = 15.0) -> None:
+        overrides = {**FED_OPT_OVERRIDES, "cluster_name": self.name}
+        self.apps = [_start_app(self.chaos, overrides,
+                                shards=self.shard_count)
+                     for _ in range(self.members)]
+        if not _wait_for(
+                lambda: _full_coverage(self.apps, self.shard_count), timeout):
+            raise AssertionError(
+                f"cluster {self.name}: members never covered the shards")
+        self.kubelet = KubeletSim(self.admin, run_seconds=0.05,
+                                  scripts=scripts)
+        self.kubelet.start()
+        self.dead = False
+
+    def targets(self) -> List[str]:
+        return [f"http://127.0.0.1:{a.monitoring.port}" for a in self.apps]
+
+    def hard_kill(self) -> None:
+        """The whole cluster goes dark at once: power first (workload pods
+        die with their hosts), then every operator member SIGKILLed —
+        member leases go stale instead of being released."""
+        self.dead = True
+        self.node_stop.set()
+        for a in self.apps:
+            if not a._hard_killed:
+                a.hard_kill()
+        if self.kubelet is not None:
+            self.kubelet.stop()
+
+    def revive(self, scripts: List[PodScript], timeout: float = 15.0) -> None:
+        """Power restored: fresh operator members over the SAME surviving
+        store (stale job copies and all) and a fresh kubelet/power rail."""
+        self.node_stop = threading.Event()
+        self.start(scripts, timeout=timeout)
+
+    def shutdown(self) -> None:
+        self.node_stop.set()
+        if self.kubelet is not None:
+            self.kubelet.stop()
+        for a in self.apps:
+            if not a._hard_killed:
+                a.shutdown()
+        self.dead = True
+
+
+def _fleet_scripts(clusters: List[FedCluster], job_name: str, home: str,
+                   total_steps: int, checkpoint_every: int = 5,
+                   finish_gate: Optional[threading.Event] = None,
+                   ) -> Tuple[SchedLedger, Dict[str, List[PodScript]]]:
+    """One gang's workload on EVERY cluster, all sharing one training
+    ledger (the durable checkpoint store survives the cluster).  A landing
+    anywhere but the creation cluster is never the gang's first boot, so
+    its coordinator restores from the checkpoint (attempt shifted past 0
+    → ``SchedLedger.crash_restore``)."""
+    ledger = SchedLedger(job_name)
+    gate = finish_gate
+    out: Dict[str, List[PodScript]] = {}
+    for cl in clusters:
+        wl = SchedWorkload(cl.admin, job_name, total_steps=total_steps,
+                           checkpoint_every=checkpoint_every,
+                           stop_event=cl.node_stop, finish_gate=gate)
+        wl.ledger = ledger
+        scripts = wl.scripts()
+        if cl.name != home:
+            scripts = [PodScript(
+                match=s.match,
+                exec_fn=(lambda attempt, fn=s.exec_fn: fn(attempt + 1)))
+                for s in scripts]
+        out[cl.name] = scripts
+    return ledger, out
+
+
+# ---------------------------------------------------------------------------
+# the exactly-one-cluster-owner invariant (committed-stream hooks)
+# ---------------------------------------------------------------------------
+
+
+class OwnershipLedger:
+    """Replays the merged committed event stream of every cluster store
+    plus the meta store, enforcing at EVERY commit: at most one cluster
+    that is not durably ``NotReady`` holds a local copy of a job claiming
+    itself as the owner (its ``tpujob.dev/cluster`` annotation naming the
+    cluster the copy lives on).  A dark cluster's surviving stale copy is
+    exempt only AFTER its ``NotReady`` mark committed — the failover
+    ordering the protocol guarantees."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._claims: Dict[str, set] = {}  # guarded by self._lock
+        self._not_ready: set = set()  # guarded by self._lock
+        self.events = 0  # guarded by self._lock
+        self.violations: List[str] = []  # guarded by self._lock
+
+    def watch_cluster(self, cluster: FedCluster) -> None:
+        cluster.inner.hooks.append(self._cluster_hook(cluster.name))
+
+    def _cluster_hook(self, name: str) -> Callable[..., None]:
+        def hook(ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
+            if resource != RESOURCE_TPUJOBS:
+                return
+            md = obj.get("metadata") or {}
+            key = f"{md.get('namespace') or 'default'}/{md.get('name')}"
+            claims = (ev_type != "DELETED"
+                      and (md.get("annotations") or {})
+                      .get(c.ANNOTATION_CLUSTER) == name)
+            with self._lock:
+                self.events += 1
+                holders = self._claims.setdefault(key, set())
+                if claims:
+                    holders.add(name)
+                else:
+                    holders.discard(name)
+                live = holders - self._not_ready
+                if len(live) > 1:
+                    self.violations.append(
+                        f"{key}: owned by {sorted(live)} at one committed "
+                        f"instant")
+        return hook
+
+    def watch_meta(self, meta: InMemoryAPIServer) -> None:
+        def hook(ev_type: str, resource: str, obj: Dict[str, Any]) -> None:
+            if resource != RESOURCE_CLUSTER_STATES:
+                return
+            name = (obj.get("metadata") or {}).get("name")
+            with self._lock:
+                if (ev_type != "DELETED"
+                        and obj.get("phase") == c.CLUSTER_NOT_READY):
+                    self._not_ready.add(name)
+                else:
+                    self._not_ready.discard(name)
+        meta.hooks.append(hook)
+
+
+# ---------------------------------------------------------------------------
+# small probes
+# ---------------------------------------------------------------------------
+
+
+def _get_job(admin: ClientSet, name: str) -> Optional[TPUJob]:
+    try:
+        return admin.tpujobs.get("default", name)
+    except (NotFoundError, ApiError):
+        return None
+
+
+def _owner_of(admin: ClientSet, name: str) -> Optional[str]:
+    job = _get_job(admin, name)
+    if job is None:
+        return None
+    return (job.metadata.annotations or {}).get(c.ANNOTATION_CLUSTER)
+
+
+def _succeeded(admin: ClientSet, name: str) -> bool:
+    job = _get_job(admin, name)
+    return job is not None and any(
+        cond.type == c.JOB_SUCCEEDED and cond.status == "True"
+        for cond in job.status.conditions)
+
+
+def _restarts(admin: ClientSet, name: str) -> int:
+    job = _get_job(admin, name)
+    if job is None:
+        return 0
+    return sum(rs.restarts for rs in job.status.replica_statuses.values())
+
+
+def _cluster_phase(meta: InMemoryAPIServer, name: str) -> Optional[str]:
+    try:
+        return meta.get(RESOURCE_CLUSTER_STATES, "default", name).get("phase")
+    except NotFoundError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# smoke
+# ---------------------------------------------------------------------------
+
+
+def run_federation_smoke(seed: int = 41, slack: float = 4.0,
+                         timeout: float = 60.0) -> Dict[str, Any]:
+    """The fast federation acceptance gate (``make federation-smoke``).
+    Runs under the lock-order sentinel."""
+    with lockgraph.audit():
+        report = _run_federation_smoke_inner(seed, slack, timeout)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_federation_smoke_inner(seed: int, slack: float,
+                                timeout: float) -> Dict[str, Any]:
+    prefix = f"f{seed}"
+    started = time.monotonic()
+    deadline = started + timeout
+
+    def _wait(pred, what: str) -> None:
+        if not _wait_for(pred, max(0.1, deadline - time.monotonic())):
+            raise AssertionError(
+                f"federation smoke: timed out waiting for {what}")
+
+    meta = InMemoryAPIServer(bookmark_every=25)
+    alpha = FedCluster("alpha", seed)
+    beta = FedCluster("beta", seed + 1)
+    clusters = [alpha, beta]
+    owners = OwnershipLedger()
+    for cl in clusters:
+        owners.watch_cluster(cl)
+    owners.watch_meta(meta)
+
+    occ_name = f"{prefix}-occ"
+    spill_name = f"{prefix}-spill"
+    own_name = f"{prefix}-own"
+    occ_key = f"default/{occ_name}"
+    occ_gate = threading.Event()
+    occ_ledger, occ_scripts = _fleet_scripts(
+        clusters, occ_name, "alpha", total_steps=40, finish_gate=occ_gate)
+    spill_ledger, spill_scripts = _fleet_scripts(
+        clusters, spill_name, "alpha", total_steps=8)
+    own_ledger, own_scripts = _fleet_scripts(
+        clusters, own_name, "beta", total_steps=8)
+    alpha.start(occ_scripts["alpha"] + spill_scripts["alpha"]
+                + own_scripts["alpha"])
+    beta.start(occ_scripts["beta"] + spill_scripts["beta"]
+               + own_scripts["beta"])
+
+    fed_stop = threading.Event()
+    fed = FederationController(
+        identity="fed-0", meta=meta,
+        clusters=[ClusterHandle(cl.name, server=cl.inner,
+                                targets=cl.targets()) for cl in clusters],
+        interval_s=FED_INTERVAL_S, lease_duration_s=FED_LEASE_S,
+        spillover_wait_s=0.6)
+    server = FederationServer(fed, port=0).start()
+    fed.start(fed_stop)
+    fetch = http_fetch(timeout_s=2.0)
+    me = f"http://127.0.0.1:{server.port}"
+    try:
+        # 1. placement: the occupant fills alpha whole; beta's own gang
+        # trains at home — both stamped durably by the federation
+        alpha.admin.tpujobs.create(_gang_job(occ_name, workers=4,
+                                             num_slices=2))
+        beta.admin.tpujobs.create(_gang_job(own_name, workers=2,
+                                            num_slices=1))
+        _wait(lambda: _owner_of(alpha.admin, occ_name) == "alpha",
+              "the occupant's durable placement on alpha")
+        _wait(lambda: _owner_of(beta.admin, own_name) == "beta",
+              "beta's own gang's durable placement")
+        _wait(lambda: occ_ledger.snapshot()["progress"] > 2,
+              "the occupant gang to train on alpha")
+
+        # 2. spillover: a gang queued behind the occupant past the bounded
+        # wait moves to beta through the two-phase transfer and finishes
+        alpha.admin.tpujobs.create(_gang_job(spill_name, workers=2,
+                                             num_slices=1))
+        _wait(lambda: _owner_of(beta.admin, spill_name) == "beta",
+              "the starved gang to spill over to beta")
+        _wait(lambda: _get_job(alpha.admin, spill_name) is None,
+              "the transfer to commit (source copy deleted)")
+        _wait(lambda: _succeeded(beta.admin, spill_name),
+              "the spilled gang to finish on beta")
+        _wait(lambda: _succeeded(beta.admin, own_name),
+              "beta's own gang to finish")
+        if fed.spillovers < 1:
+            raise AssertionError("federation smoke: no spillover counted")
+
+        # 3. checkpoint barrier, then the lights go out on alpha: every
+        # member hard-killed, workload pods dead with their hosts
+        occ_ledger.barrier()
+        kill_at = time.monotonic()
+        alpha.hard_kill()
+        pre_kill = occ_ledger.snapshot()
+        ckpt, barrier_step = pre_kill["checkpoint"], pre_kill["barriers"][-1]
+
+        # 4. dark detection → durable NotReady → re-admission on beta
+        # within one cluster-lease term + the dark grace + slack
+        bound = FED_LEASE_S + fed.dark_grace_s + slack
+        if not _wait_for(lambda: _get_job(beta.admin, occ_name) is not None,
+                         bound):
+            raise AssertionError(
+                f"federation smoke: the dark cluster's gang was not "
+                f"re-admitted on the survivor within {bound:.1f}s")
+        failover_s = time.monotonic() - kill_at
+        if _cluster_phase(meta, "alpha") != c.CLUSTER_NOT_READY:
+            raise AssertionError(
+                "federation smoke: dark cluster never durably NotReady")
+        job = _get_job(beta.admin, occ_name)
+        if (job.metadata.annotations or {}).get(
+                c.ANNOTATION_FAILED_OVER_FROM) != "alpha":
+            raise AssertionError(
+                "federation smoke: rescued gang lacks failed-over-from "
+                "provenance")
+
+        # 5. the rescue restores exactly at the barrier checkpoint (zero
+        # checkpoint regression), then trains to completion — with a
+        # FRESH status: failover is not failure, zero counted restarts
+        _wait(lambda: occ_ledger.snapshot()["restores"],
+              "the rescued coordinator to restore from the checkpoint")
+        occ_gate.set()
+        _wait(lambda: _succeeded(beta.admin, occ_name),
+              "the rescued gang to finish on beta")
+        snap = occ_ledger.snapshot()
+        restored = snap["restores"][0][1]
+        if restored != ckpt or restored < barrier_step:
+            raise AssertionError(
+                f"federation smoke: restore landed at {restored}, want the "
+                f"barrier checkpoint {ckpt} (barrier step {barrier_step})")
+        problems: List[str] = []
+        for ledger in (occ_ledger, spill_ledger, own_ledger):
+            problems += ledger.snapshot()["violations"]
+        for name in (occ_name, spill_name, own_name):
+            n = _restarts(beta.admin, name)
+            if n:
+                problems.append(f"{name}: {n} counted restart(s), want 0")
+
+        # 6. fencing: stale federation tokens write NOTHING on the
+        # survivor — a deposed duty generation and a dead cluster's duty
+        # lease are both rejected server-side
+        gen = next(r["duty_generation"] for r in fed.snapshot()["clusters"]
+                   if r["name"] == "beta")
+        stale = FencingToken("fed-departed", max(0, (gen or 1) - 1),
+                             lease=fed_duty_lease_name("beta"))
+        dead = FencingToken(fed.identity, 1,
+                            lease=fed_duty_lease_name("alpha"))
+        for label, token in (("deposed-generation", stale),
+                             ("dead-cluster-lease", dead)):
+            def op(token=token):
+                with call_token(token):
+                    beta.inner.patch(RESOURCE_TPUJOBS, "default", occ_name, {
+                        "metadata": {"annotations": {
+                            c.ANNOTATION_CLUSTER: "alpha"}}})
+            verdict = _fence_probe(op)
+            if verdict != "rejected":
+                problems.append(
+                    f"stale token ({label}) verdict {verdict}, want "
+                    f"rejected")
+        if any(holder == "fed-departed"
+               for *_, holder, _g in beta.inner.fence_accepts):
+            problems.append("survivor accepted a write from the departed "
+                            "holder's token")
+
+        # 7. exactly-one-cluster-owner over the whole committed stream
+        problems += owners.violations
+        if problems:
+            raise AssertionError(
+                "federation smoke invariants violated:\n  "
+                + "\n  ".join(problems))
+
+        # 8. the HTTP surface narrates all of it
+        fsnap = fetch(me, "/debug/federation")
+        alpha_row = next(r for r in fsnap["clusters"]
+                         if r["name"] == "alpha")
+        if alpha_row["phase"] != c.CLUSTER_NOT_READY or alpha_row["up"]:
+            raise AssertionError(
+                "federation smoke: /debug/federation does not show the "
+                f"dark cluster NotReady+down: {alpha_row}")
+        if fsnap["jobs"][occ_key]["cluster"] != "beta":
+            raise AssertionError(
+                "federation smoke: /debug/federation mirror disagrees on "
+                "the rescued gang's owner")
+        return {
+            "mode": "federation-smoke",
+            "seed": seed,
+            "failover_s": round(failover_s, 3),
+            "failover_bound_s": round(bound, 3),
+            "restored_at": restored,
+            "barrier_checkpoint": ckpt,
+            "totals": fsnap["totals"],
+            "ownership_events": owners.events,
+            "violations": 0,
+            "duration_s": round(time.monotonic() - started, 3),
+            "invariants": "ok",
+        }
+    finally:
+        occ_gate.set()
+        fed_stop.set()
+        if fed._thread is not None:
+            fed._thread.join(timeout=5)
+        server.stop()
+        for cl in clusters:
+            cl.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# soak: cluster kill + federation replica departure + revival
+# ---------------------------------------------------------------------------
+
+
+def run_federation_soak(seed: int, clusters: int = 3,
+                        timeout: float = 90.0) -> Dict[str, Any]:
+    """Federation under a seeded cluster storm: N clusters, two federation
+    replicas; one cluster is hard-killed whole (failover), one federation
+    replica departs (duties re-rendezvous), the dead cluster revives (the
+    zombie sweep must land before it is trusted) and then receives a new
+    placement.  Invariants: no job lost or duplicated, ownership
+    exactly-once over the committed stream, zero counted restarts from
+    failover, every training ledger violation-free.
+
+    Runs under the lock-order sentinel."""
+    with lockgraph.audit():
+        report = _run_federation_soak_inner(seed, clusters, timeout)
+        report["locks"] = _lock_audit_report(seed)
+    return report
+
+
+def _run_federation_soak_inner(seed: int, n_clusters: int,
+                               timeout: float) -> Dict[str, Any]:
+    rng = random.Random(f"{seed}:federation-storm")
+    prefix = f"fs{seed}"
+    started = time.monotonic()
+    deadline = started + timeout
+
+    def _wait(pred, what: str) -> None:
+        if not _wait_for(pred, max(0.1, deadline - time.monotonic())):
+            raise AssertionError(
+                f"federation soak: timed out waiting for {what}")
+
+    meta = InMemoryAPIServer(bookmark_every=25)
+    fleet = [FedCluster(f"c{i}", seed + i, members=2, shard_count=2)
+             for i in range(n_clusters)]
+    owners = OwnershipLedger()
+    for cl in fleet:
+        owners.watch_cluster(cl)
+    owners.watch_meta(meta)
+
+    # one long-training gang per cluster, gated open only at the end
+    gates = {cl.name: threading.Event() for cl in fleet}
+    names = {cl.name: f"{prefix}-{cl.name}" for cl in fleet}
+    ledgers: Dict[str, SchedLedger] = {}
+    scripts: Dict[str, List[PodScript]] = {cl.name: [] for cl in fleet}
+    for cl in fleet:
+        ledger, per_cluster = _fleet_scripts(
+            fleet, names[cl.name], cl.name, total_steps=40,
+            checkpoint_every=3, finish_gate=gates[cl.name])
+        ledgers[cl.name] = ledger
+        for k, v in per_cluster.items():
+            scripts[k] += v
+    for cl in fleet:
+        cl.start(scripts[cl.name])
+
+    handles = [ClusterHandle(cl.name, server=cl.inner, targets=cl.targets())
+               for cl in fleet]
+    stops = [threading.Event(), threading.Event()]
+    feds = [FederationController(
+        identity=f"fed-{i}", meta=meta, clusters=handles,
+        interval_s=FED_INTERVAL_S, lease_duration_s=FED_LEASE_S,
+        spillover_wait_s=30.0)
+        for i in range(2)]
+    for fed, stop in zip(feds, stops):
+        fed.start(stop)
+    events: List[Dict[str, Any]] = []
+    try:
+        for cl in fleet:
+            cl.admin.tpujobs.create(_gang_job(names[cl.name], workers=2,
+                                              num_slices=1))
+        for cl in fleet:
+            _wait(lambda cl=cl: _owner_of(cl.admin, names[cl.name])
+                  == cl.name,
+                  f"{names[cl.name]}'s durable home placement")
+        _wait(lambda: all(led.snapshot()["progress"] > 2
+                          for led in ledgers.values()),
+              "every gang training at home")
+        _wait(lambda: all(f.ticks > 0 for f in feds)
+              and sorted(set(feds[0].owned_clusters())
+                         | set(feds[1].owned_clusters()))
+              == sorted(cl.name for cl in fleet),
+              "the two replicas to split the cluster duties")
+
+        # -- event 1: one whole cluster dies -----------------------------
+        victim = fleet[rng.randrange(len(fleet))]
+        vjob = names[victim.name]
+        ledgers[victim.name].barrier()
+        kill_at = time.monotonic()
+        victim.hard_kill()
+        events.append({"event": "cluster-kill", "cluster": victim.name})
+        survivors = [cl for cl in fleet if cl is not victim]
+
+        def _rescued() -> Optional[FedCluster]:
+            for cl in survivors:
+                if _get_job(cl.admin, vjob) is not None:
+                    return cl
+            return None
+
+        bound = FED_LEASE_S + feds[0].dark_grace_s + 6.0
+        if not _wait_for(lambda: _rescued() is not None, bound):
+            raise AssertionError(
+                f"federation soak: {vjob} not re-admitted on a survivor "
+                f"within {bound:.1f}s of the cluster kill")
+        failover_s = time.monotonic() - kill_at
+        rescue = _rescued()
+        _wait(lambda: _cluster_phase(meta, victim.name)
+              == c.CLUSTER_NOT_READY,
+              "the dead cluster's durable NotReady mark")
+        _wait(lambda: ledgers[victim.name].snapshot()["restores"],
+              "the rescued gang to restore from its checkpoint")
+
+        # -- event 2: a federation replica departs; duties re-rendezvous -
+        gone = rng.randrange(2)
+        stops[gone].set()
+        feds[gone]._thread.join(timeout=5)
+        events.append({"event": "fed-replica-departs",
+                       "replica": feds[gone].identity})
+        keeper = feds[1 - gone]
+        _wait(lambda: set(cl.name for cl in survivors)
+              <= set(keeper.owned_clusters()),
+              "the surviving replica to own every live cluster's duty")
+
+        # -- event 3: the dead cluster revives and is swept ---------------
+        victim.revive(scripts[victim.name])
+        # the scrape catalog follows reality: the revived members listen
+        # on fresh ports (in-place, so every replica sees the same handle)
+        next(h for h in handles
+             if h.name == victim.name).targets[:] = victim.targets()
+        events.append({"event": "cluster-revive", "cluster": victim.name})
+        _wait(lambda: _cluster_phase(meta, victim.name) == c.CLUSTER_READY,
+              "the revived cluster to be swept and marked Ready")
+        if _get_job(victim.admin, vjob) is not None:
+            raise AssertionError(
+                "federation soak: zombie copy survived the revival sweep "
+                "on a cluster already marked Ready")
+
+        # -- event 4: the revived cluster takes a new placement -----------
+        new_name = f"{prefix}-post"
+        new_gate = threading.Event()
+        new_ledger, new_scripts = _fleet_scripts(
+            fleet, new_name, victim.name, total_steps=8,
+            checkpoint_every=3, finish_gate=new_gate)
+        new_gate.set()
+        victim.kubelet.scripts += new_scripts[victim.name]
+        for cl in survivors:
+            cl.kubelet.scripts += new_scripts[cl.name]
+        victim.admin.tpujobs.create(_gang_job(new_name, workers=2,
+                                              num_slices=1))
+        _wait(lambda: _owner_of(victim.admin, new_name) == victim.name,
+              "a fresh placement on the revived cluster")
+        _wait(lambda: _succeeded(victim.admin, new_name),
+              "the post-revival gang to finish at home")
+
+        # -- settle: open the gates, every gang finishes where it lives --
+        for g in gates.values():
+            g.set()
+        homes = {vjob: rescue}
+        for cl in survivors:
+            homes[names[cl.name]] = cl
+        for job_name, home in homes.items():
+            _wait(lambda j=job_name, h=home: _succeeded(h.admin, j),
+                  f"{job_name} to finish on {home.name}")
+
+        problems: List[str] = []
+        for led in list(ledgers.values()) + [new_ledger]:
+            problems += led.snapshot()["violations"]
+        for job_name, home in homes.items():
+            n = _restarts(home.admin, job_name)
+            if n:
+                problems.append(
+                    f"{job_name}: {n} counted restart(s) on {home.name}, "
+                    f"want 0")
+        # no job lost or duplicated: each lives on exactly one cluster
+        for job_name in list(homes) + [new_name]:
+            where = [cl.name for cl in fleet
+                     if _get_job(cl.admin, job_name) is not None]
+            if len(where) != 1:
+                problems.append(
+                    f"{job_name}: present on {where or 'no cluster'}, "
+                    f"want exactly one")
+        problems += owners.violations
+        if problems:
+            raise AssertionError(
+                "federation soak invariants violated:\n  "
+                + "\n  ".join(problems))
+        return {
+            "mode": "federation-soak",
+            "seed": seed,
+            "jobs": len(homes) + 1,  # + the post-revival placement
+            "events": events,
+            "failover_s": round(failover_s, 3),
+            "rescue_cluster": rescue.name,
+            "ticks": sum(f.ticks for f in feds),
+            "ownership_events": owners.events,
+            "violations": 0,
+            "duration_s": round(time.monotonic() - started, 3),
+            "invariants": "ok",
+        }
+    finally:
+        for g in gates.values():
+            g.set()
+        for stop in stops:
+            stop.set()
+        for fed in feds:
+            if fed._thread is not None:
+                fed._thread.join(timeout=5)
+        for cl in fleet:
+            cl.shutdown()

@@ -25,11 +25,17 @@ every scheduled eviction checkpoint-safe), and the elastic-capacity tier
 same seed, elastic planner vs preempt-only; the flex run's cumulative
 fleet goodput ratio must strictly win, with zero counted restarts and no
 partial placement in either run)
+— and the multi-cluster federation tier (``run_federation_soak``: three
+whole in-process clusters + two federation replicas under a cluster kill,
+a replica departure and a cluster revival; no job lost or duplicated,
+exactly one cluster owner per job at every committed instant, failover
+with zero counted restarts)
 — the crash-only acceptance gate: all invariants hold across every kill,
 zero writes are accepted from a fenced leader or a deposed shard owner,
 and every job is synced by exactly one owner per shard-lease generation.
 ``--resize`` runs just the resize tier on top of the API tier;
-``--sched`` just the scheduler tier; ``--flex`` just the elastic tier.
+``--sched`` just the scheduler tier; ``--flex`` just the elastic tier;
+``--federation`` just the federation tier.
 
 Usage:
     python soak.py                      # default 5 seeds x 5 jobs = 25 jobs
@@ -55,6 +61,7 @@ from e2e.chaos import (
     run_shard_soak,
     run_soak,
 )
+from e2e.federation import run_federation_soak
 from e2e.flex import run_flex_soak
 from e2e.nodes import run_node_soak
 from e2e.scheduler import run_sched_soak
@@ -88,6 +95,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(num_slices flex + torus defrag vs a "
                              "preempt-only baseline on the same seed) for "
                              "every seed (included in --crash)")
+    parser.add_argument("--federation", action="store_true",
+                        help="also run the multi-cluster federation tier "
+                             "(whole-cluster kill + failover, federation "
+                             "replica departure, cluster revival sweep) "
+                             "for every seed (included in --crash)")
     parser.add_argument("--timeout", type=float, default=60.0,
                         help="per-seed convergence timeout (s)")
     parser.add_argument("--verbose", action="store_true",
@@ -147,6 +159,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         # deadline floor as the other heavy tiers — and it runs the
         # matrix twice, so the floor covers each run separately.
         runs.append(("flex", lambda seed: run_flex_soak(
+            seed, timeout=max(args.timeout, 120.0))))
+    if args.crash or args.federation:
+        # federation tier: three whole in-process clusters + two
+        # federation replicas; one cluster hard-killed whole (dark
+        # detection -> durable NotReady -> checkpoint-exact failover), one
+        # replica departs (duties re-rendezvous), the dead cluster revives
+        # (zombie sweep before Ready) and takes a fresh placement;
+        # invariants: no job lost or duplicated, exactly one cluster owner
+        # per job at every committed instant, zero counted restarts from
+        # failover, every training ledger violation-free.  Same deadline
+        # floor as the other heavy tiers (6 members + 2 replicas).
+        runs.append(("federation", lambda seed: run_federation_soak(
             seed, timeout=max(args.timeout, 120.0))))
 
     failures = 0

@@ -775,10 +775,12 @@ _TPL200_FILES = (
     "tpujob/api/nodes.py",
     "tpujob/controller/barrier.py",
     "tpujob/controller/reconciler.py",
+    "tpujob/server/federation.py",
     "tpujob/server/inventory.py",
     "tpujob/server/scheduler.py",
     "tpujob/workloads/distributed.py",
     "e2e/chaos.py",
+    "e2e/federation.py",
     "e2e/elastic.py",
     "e2e/flex.py",
     "e2e/nodes.py",
@@ -804,9 +806,9 @@ def test_tpl200_shipped_annotation_set_is_clean(tmp_path):
 
 def test_tpl200_deleting_the_preempt_ack_consumers_fails_lint(tmp_path):
     """The seeded regression the acceptance criteria name: remove every
-    reader of ANNOTATION_PREEMPT_ACK (the scheduler's barrier check and
-    the e2e workload's idempotence guard) and the key must flag as
-    published into the void."""
+    reader of ANNOTATION_PREEMPT_ACK (the scheduler's barrier check, the
+    e2e workload's idempotence guard, and the federation sanitizer's
+    strip list) and the key must flag as published into the void."""
     root = _copy_files(tmp_path, _TPL200_FILES)
     sched = root / "tpujob/server/scheduler.py"
     src = sched.read_text()
@@ -818,6 +820,10 @@ def test_tpl200_deleting_the_preempt_ack_consumers_fails_lint(tmp_path):
     assert "annotations.get(c.ANNOTATION_PREEMPT_ACK) is not None" in src
     e2e_sched.write_text(src.replace(
         "annotations.get(c.ANNOTATION_PREEMPT_ACK) is not None", "False"))
+    fed = root / "tpujob/server/federation.py"
+    src = fed.read_text()
+    assert "    c.ANNOTATION_PREEMPT_ACK,\n" in src
+    fed.write_text(src.replace("    c.ANNOTATION_PREEMPT_ACK,\n", ""))
     project = Project(root, [root / rel for rel in _TPL200_FILES])
     findings = _select(project, "TPL200")
     assert any("tpujob.dev/preempt-ack" in f.message

@@ -1,0 +1,386 @@
+"""Multi-cluster federation unit matrix: fail-closed membership parsing at
+cluster granularity, rendezvous placement stability, capacity/queue/phase
+placement scoring, the strictly-better spillover rule, dark-detection
+vetoes + the failover damper, two-phase transfer resume after a replica
+crash, and the zombie revival sweep.  Everything drives the real
+``FederationController`` through its injectable seams (``tick(now=...)``,
+``fetch=``); the whole-cluster chaos tiers live in ``e2e/federation.py``.
+"""
+from __future__ import annotations
+
+import copy
+import time
+
+from tpujob.api import constants as c
+from tpujob.kube.client import RESOURCE_TPUJOBS
+from tpujob.kube.memserver import InMemoryAPIServer
+from tpujob.server.federation import (
+    FED_MEMBER_LEASE_PREFIX,
+    RESOURCE_CLUSTER_STATES,
+    RESOURCE_JOB_MIRRORS,
+    ClusterHandle,
+    FederationController,
+    preferred_cluster,
+)
+from tpujob.server.leader_election import RESOURCE_LEASES, rfc3339micro
+from tpujob.server.sharding import (
+    MEMBER_LEASE_PREFIX,
+    heartbeat_member_lease,
+    live_lease_holders,
+    rendezvous_owner,
+)
+
+
+# ---------------------------------------------------------------------------
+# harness: stub clusters behind the injectable scrape/clock seams
+# ---------------------------------------------------------------------------
+
+
+def _job(name: str, workers: int = 2, annotations=None) -> dict:
+    """1 master + ``workers`` workers, unpinned: a 1-slice gang needing
+    ``workers + 1`` torus-adjacent hosts (3 by default — v4-16's 2-host
+    slices cannot host it, v4-32's 4-host slices can)."""
+    tmpl = {"spec": {"containers": [{"name": c.DEFAULT_CONTAINER_NAME,
+                                     "image": "test:latest"}]}}
+    md: dict = {"name": name, "namespace": "default"}
+    if annotations:
+        md["annotations"] = dict(annotations)
+    return {
+        "apiVersion": c.API_VERSION,
+        "kind": c.KIND,
+        "metadata": md,
+        "spec": {"tpuReplicaSpecs": {
+            c.REPLICA_TYPE_MASTER: {"replicas": 1, "template": tmpl},
+            c.REPLICA_TYPE_WORKER: {"replicas": workers, "template": tmpl},
+        }},
+    }
+
+
+def _payload(queue=(), goodput_ratio=1.0) -> dict:
+    return {
+        "jobs": [],
+        "goodput": {"goodput_ratio": goodput_ratio},
+        "scheduler": {"queue": list(queue), "rings": {}, "verdicts": {}},
+    }
+
+
+class _Fleet:
+    """N stub clusters: a real store each, a mutable payload map the
+    injected fetch serves (``None`` = the cluster's scrape plane is dark),
+    and one FederationController on an artificial clock."""
+
+    def __init__(self, specs, identity="fed-test", meta=None, **kw):
+        self.meta = meta if meta is not None else InMemoryAPIServer()
+        self.servers = {name: InMemoryAPIServer() for name, _ in specs}
+        self.payloads = {name: _payload() for name, _ in specs}
+        self.handles = [
+            ClusterHandle(name=name, server=self.servers[name],
+                          targets=[f"{name}/member-0"], capacity=capacity)
+            for name, capacity in specs
+        ]
+        kw.setdefault("interval_s", 0.5)
+        kw.setdefault("lease_duration_s", 5.0)
+        self.fed = FederationController(
+            identity=identity, meta=self.meta, clusters=self.handles,
+            fetch=self._fetch, **kw)
+        self.now = 1000.0
+
+    def _fetch(self, target: str, path: str):
+        payload = self.payloads[target.partition("/")[0]]
+        if payload is None:
+            raise ConnectionError("scrape plane dark")
+        return copy.deepcopy(payload)
+
+    def tick(self, advance: float = 0.5) -> None:
+        self.now += advance
+        self.fed.tick(now=self.now)
+
+    def owner_of(self, cluster: str, name: str):
+        try:
+            got = self.servers[cluster].get(RESOURCE_TPUJOBS, "default",
+                                            name)
+        except Exception:  # noqa: TPL005 - absent = no local copy
+            return None
+        ann = (got.get("metadata") or {}).get("annotations") or {}
+        return ann.get(c.ANNOTATION_CLUSTER)
+
+    def mirror(self, name: str):
+        try:
+            return self.meta.get(RESOURCE_JOB_MIRRORS, "default", name)
+        except Exception:  # noqa: TPL005 - absent mirror = None
+            return None
+
+    def phase(self, cluster: str):
+        try:
+            return self.meta.get(RESOURCE_CLUSTER_STATES, "default",
+                                 cluster).get("phase")
+        except Exception:  # noqa: TPL005 - no record yet
+            return None
+
+
+def _lease(server, identity: str, renew, duration=5,
+           prefix=FED_MEMBER_LEASE_PREFIX) -> None:
+    server.create(RESOURCE_LEASES, {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {"name": f"{prefix}-{identity or 'departed'}",
+                     "namespace": "default"},
+        "spec": {"holderIdentity": identity,
+                 "leaseDurationSeconds": duration,
+                 "renewTime": renew},
+    })
+
+
+# ---------------------------------------------------------------------------
+# membership: fail-closed lease parsing at cluster granularity
+# ---------------------------------------------------------------------------
+
+
+def test_federation_member_leases_fail_closed():
+    """Garbage or clock-skewed renewTimes must read as LIVE (evicting a
+    healthy federation replica on unparseable bytes would hand whole
+    clusters to a rival while it still writes them); an empty holder is a
+    graceful departure, and only a lease expired past its own declared
+    duration is dead."""
+    meta = InMemoryAPIServer()
+    now = time.time()
+    _lease(meta, "good", rfc3339micro(now))
+    _lease(meta, "garbled", "not-a-timestamp")
+    _lease(meta, "skewed", rfc3339micro(now + 3600))
+    _lease(meta, "", rfc3339micro(now))
+    _lease(meta, "dead", rfc3339micro(now - 100), duration=5)
+    assert live_lease_holders(
+        meta, "default", FED_MEMBER_LEASE_PREFIX, 5.0,
+    ) == ["garbled", "good", "skewed"]
+
+
+def test_garbled_rival_heartbeat_still_shards_the_cluster_set():
+    """The cluster-granularity stake: a rival replica whose heartbeat went
+    unparseable is still a live member, so this replica must NOT take over
+    the rival's rendezvous-assigned clusters — duties stay split exactly
+    as a healthy two-member rendezvous would split them."""
+    fleet = _Fleet([(f"c{i}", "v4-32x2") for i in range(6)])
+    _lease(fleet.meta, "rival", "certainly-not-rfc3339")
+    fleet.tick()
+    members = ["fed-test", "rival"]
+    want = sorted(
+        name for name in fleet.servers
+        if rendezvous_owner(f"cluster:{name}", members) == "fed-test")
+    assert want, "rendezvous over 6 clusters must give this replica some"
+    assert len(want) < len(fleet.servers), "and the live rival keeps some"
+    assert fleet.fed.owned_clusters() == want
+
+
+# ---------------------------------------------------------------------------
+# rendezvous placement stability
+# ---------------------------------------------------------------------------
+
+
+def test_preferred_cluster_stability_adding_a_cluster():
+    """Adding a cluster moves ≈1/N of job preferences, every moved job
+    moves TO the newcomer, and removing it restores the original map."""
+    keys = [f"default/job-{i:04d}" for i in range(400)]
+    before = {k: preferred_cluster(k, ["a", "b", "c"]) for k in keys}
+    after = {k: preferred_cluster(k, ["a", "b", "c", "d"]) for k in keys}
+    moved = {k for k in keys if before[k] != after[k]}
+    assert moved, "a new cluster must win some jobs"
+    assert all(after[k] == "d" for k in moved)
+    assert len(moved) <= 2 * len(keys) // 4  # ≈1/4, generous slack
+    assert before == {k: preferred_cluster(k, ["a", "b", "c"])
+                      for k in keys}
+    assert preferred_cluster("default/x", []) is None
+
+
+# ---------------------------------------------------------------------------
+# placement scoring
+# ---------------------------------------------------------------------------
+
+
+def test_place_excludes_infeasible_clusters():
+    # v4-16 slices host 2 pods; the 3-host gang can never fit there
+    fleet = _Fleet([("small", "v4-16x4"), ("big", "v4-32x1")])
+    fleet.tick()
+    assert fleet.fed._place(_job("j"), ["small", "big"],
+                            fleet.now) == "big"
+    # nowhere feasible: the job stays unplaced rather than mis-placed
+    assert fleet.fed._place(_job("j"), ["small"], fleet.now) is None
+
+
+def test_place_prefers_the_shallower_queue():
+    fleet = _Fleet([("busy", "v4-32x2"), ("idle", "v4-32x2")])
+    fleet.payloads["busy"] = _payload(
+        queue=[{"job": f"default/q{i}", "wait_s": 5.0} for i in range(3)])
+    fleet.payloads["idle"] = _payload(queue=[])
+    fleet.tick()
+    assert fleet.fed._place(_job("j"), ["busy", "idle"],
+                            fleet.now) == "idle"
+
+
+def test_place_excludes_not_ready_clusters():
+    fleet = _Fleet([("dim", "v4-32x2"), ("lit", "v4-16x1")])
+    fleet.meta.create(RESOURCE_CLUSTER_STATES, {
+        "metadata": {"name": "dim", "namespace": "default"},
+        "phase": c.CLUSTER_NOT_READY,
+    })
+    # keep dim's scrape plane dark too: a live scrape pass would sweep and
+    # revive it (that path is test_revival_sweeps_zombie_copies_before_ready)
+    fleet.payloads["dim"] = None
+    fleet.tick()
+    # "dim" would win on capacity, but a durably NotReady cluster is not
+    # a candidate no matter how free it looks — and "lit" is infeasible
+    assert fleet.fed._place(_job("j"), ["dim", "lit"], fleet.now) is None
+
+
+# ---------------------------------------------------------------------------
+# spillover: strictly better or stay put
+# ---------------------------------------------------------------------------
+
+
+def test_spillover_requires_a_strictly_better_queue():
+    fleet = _Fleet([("home", "v4-32x2"), ("other", "v4-32x2")],
+                   spillover_wait_s=10.0)
+    fleet.servers["home"].create(RESOURCE_TPUJOBS, _job(
+        "starved", annotations={c.ANNOTATION_CLUSTER: "home"}))
+    crowd = [{"job": f"default/q{i}", "wait_s": 5.0} for i in range(2)]
+    fleet.payloads["home"] = _payload(
+        queue=crowd + [{"job": "default/starved", "wait_s": 60.0}])
+    # equal queue depth on the other side: spilling would trade queues
+    fleet.payloads["other"] = _payload(
+        queue=[{"job": f"default/o{i}", "wait_s": 1.0} for i in range(3)])
+    for _ in range(3):
+        fleet.tick()
+    assert fleet.fed.spillovers == 0
+    assert fleet.owner_of("home", "starved") == "home"
+
+    # the other cluster drains: now strictly better -> two-phase transfer
+    fleet.payloads["other"] = _payload(queue=[])
+    for _ in range(4):
+        fleet.tick()
+    assert fleet.fed.spillovers == 1
+    assert fleet.owner_of("other", "starved") == "other"
+    assert fleet.owner_of("home", "starved") is None  # source deleted
+    mirror = fleet.mirror("starved")
+    assert mirror["cluster"] == "other"
+    assert not mirror.get("transfer_from")
+
+
+# ---------------------------------------------------------------------------
+# dark detection: the live-lease veto, the damper
+# ---------------------------------------------------------------------------
+
+
+def test_live_member_lease_vetoes_dark_scrapes():
+    """Every scrape stale but the cluster's API answers with a live member
+    lease: a monitoring failure, not a dead cluster — no failover, no
+    NotReady record, however long it lasts."""
+    fleet = _Fleet([("flaky", "v4-32x2"), ("spare", "v4-32x2")])
+    fleet.servers["flaky"].create(RESOURCE_TPUJOBS, _job(
+        "precious", annotations={c.ANNOTATION_CLUSTER: "flaky"}))
+    fleet.tick()  # up: the job gets mirrored
+    assert fleet.mirror("precious")["cluster"] == "flaky"
+
+    heartbeat_member_lease(fleet.servers["flaky"], "default", "member-0",
+                           3600, prefix=MEMBER_LEASE_PREFIX)
+    fleet.payloads["flaky"] = None  # scrape plane dark
+    for _ in range(5):
+        fleet.tick(advance=100.0)  # far past any grace window
+    assert fleet.fed.failovers == 0
+    assert fleet.phase("flaky") is None
+    assert fleet.owner_of("spare", "precious") is None
+
+    # the member lease expires too: NOW the cluster is dark for real
+    fleet.servers["flaky"].delete(
+        RESOURCE_LEASES, "default", f"{MEMBER_LEASE_PREFIX}-member-0")
+    fleet.tick()  # first confirmed-dark observation starts the clock
+    fleet.tick(advance=fleet.fed.dark_grace_s + 1.0)
+    fleet.tick()  # the survivor's pass materializes the rescue
+    assert fleet.fed.failovers == 1
+    assert fleet.phase("flaky") == c.CLUSTER_NOT_READY
+    got = fleet.servers["spare"].get(RESOURCE_TPUJOBS, "default",
+                                     "precious")
+    ann = got["metadata"]["annotations"]
+    assert ann[c.ANNOTATION_CLUSTER] == "spare"
+    assert ann[c.ANNOTATION_FAILED_OVER_FROM] == "flaky"
+    assert "status" not in got or not got.get("status")  # fresh start
+
+
+def test_failover_damper_doubles_per_episode():
+    fleet = _Fleet([("bouncy", "v4-32x2"), ("spare", "v4-32x2")])
+    cl = fleet.handles[0]
+    base = fleet.fed.damp_base_s
+    fleet.fed._fail_over(cl, now=100.0)
+    assert fleet.fed._damp_until["bouncy"] == 100.0 + base
+    fleet.fed._fail_over(cl, now=200.0)
+    assert fleet.fed._damp_until["bouncy"] == 200.0 + 2 * base
+    fleet.fed._fail_over(cl, now=300.0)
+    assert fleet.fed._damp_until["bouncy"] == 300.0 + 4 * base
+
+
+def test_damper_holds_back_a_confirmed_dark_failover():
+    fleet = _Fleet([("bouncy", "v4-32x2"), ("spare", "v4-32x2")])
+    cl = fleet.handles[0]
+    cl.server = None  # uncached re-read fails: darkness confirmed
+    fleet.fed._dark_since["bouncy"] = 0.0  # dark since forever
+    fleet.fed._damp_until["bouncy"] = 1000.0
+    fleet.fed._handle_dark_candidate(cl, now=999.0)
+    assert fleet.fed.failovers == 0 and fleet.phase("bouncy") is None
+    fleet.fed._handle_dark_candidate(cl, now=1001.0)
+    assert fleet.phase("bouncy") == c.CLUSTER_NOT_READY
+
+
+# ---------------------------------------------------------------------------
+# crash-resume of the two-phase transfer; zombie revival sweep
+# ---------------------------------------------------------------------------
+
+
+def test_transfer_resumes_after_replica_crash_mid_flight():
+    """Phase 1 committed (source stamped + mirror re-homed), then the
+    federation replica died.  A FRESH replica must finish the move from
+    the durable state alone: materialize on the target, clear the marker,
+    delete the source copy — exactly one owner at the end."""
+    fleet = _Fleet([("src", "v4-32x2"), ("dst", "v4-32x2")],
+                   identity="fed-reborn")
+    fleet.servers["src"].create(RESOURCE_TPUJOBS, _job(
+        "mid", annotations={c.ANNOTATION_CLUSTER: "dst",
+                            c.ANNOTATION_CLUSTER_TRANSFER: "dst"}))
+    fleet.meta.create(RESOURCE_JOB_MIRRORS, {
+        "metadata": {"name": "mid", "namespace": "default"},
+        "cluster": "dst",
+        "transfer_from": "src",
+        "object": _job("mid", annotations={c.ANNOTATION_CLUSTER: "dst"}),
+    })
+    for _ in range(3):
+        fleet.tick()
+    assert fleet.owner_of("dst", "mid") == "dst"
+    assert fleet.owner_of("src", "mid") is None
+    mirror = fleet.mirror("mid")
+    assert mirror["cluster"] == "dst" and not mirror.get("transfer_from")
+    # a transfer is not a failover: no rescue provenance on the copy
+    got = fleet.servers["dst"].get(RESOURCE_TPUJOBS, "default", "mid")
+    assert c.ANNOTATION_FAILED_OVER_FROM not in (
+        got["metadata"].get("annotations") or {})
+
+
+def test_revival_sweeps_zombie_copies_before_ready():
+    """A cluster comes back from NotReady still holding a copy of a job
+    that failed over while it was dark.  The sweep must align the zombie's
+    annotation to the mirror's committed owner, delete it, and only then
+    flip the cluster Ready."""
+    fleet = _Fleet([("lazarus", "v4-32x2"), ("keeper", "v4-32x2")])
+    fleet.meta.create(RESOURCE_CLUSTER_STATES, {
+        "metadata": {"name": "lazarus", "namespace": "default"},
+        "phase": c.CLUSTER_NOT_READY,
+    })
+    fleet.meta.create(RESOURCE_JOB_MIRRORS, {
+        "metadata": {"name": "zz", "namespace": "default"},
+        "cluster": "keeper",
+        "object": _job("zz", annotations={c.ANNOTATION_CLUSTER: "keeper"}),
+    })
+    fleet.servers["lazarus"].create(RESOURCE_TPUJOBS, _job(
+        "zz", annotations={c.ANNOTATION_CLUSTER: "lazarus"}))
+    fleet.servers["keeper"].create(RESOURCE_TPUJOBS, _job(
+        "zz", annotations={c.ANNOTATION_CLUSTER: "keeper"}))
+    fleet.tick()
+    assert fleet.owner_of("lazarus", "zz") is None  # zombie swept
+    assert fleet.owner_of("keeper", "zz") == "keeper"
+    assert fleet.phase("lazarus") == c.CLUSTER_READY

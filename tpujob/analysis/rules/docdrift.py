@@ -1,0 +1,128 @@
+"""TPL204: future-promise prose drift in the docs tree.
+
+The documented failure mode: a doc paragraph defers to work that has not
+happened ("... until fleet-wide ledger sharing lands") and nothing ever
+walks it back when the work DOES happen — the prose silently inverts from
+a roadmap note into a false claim about the current system (the
+`docs/failure-handling` sharded-victim-pricing paragraph survived two PRs
+past its own fix exactly this way).  Code drift has TPL200/TPL201 and the
+wire registry; prose promises have no registry to diff against, so the
+rule bans the *shape*: sentences in ``docs/`` that predicate current
+behavior on unlanded future work.
+
+What counts as a promise (case-insensitive; matched across hard line
+wraps, since markdown prose wraps mid-sentence — the original offender
+broke between "sharing" and "lands"; fenced code blocks are skipped):
+
+- deferral to a landing: "until/once/when <something> lands|ships|is
+  implemented|is wired up";
+- scheduled-future phrasing: "will be added/implemented/supported/fixed
+  later|soon|eventually", or a bare "in a future PR/release";
+- placeholder admissions: "not yet implemented/supported/wired", "coming
+  soon", a "TBD" token.
+
+A promise that must stay (it is tracked work, not drift) carries the
+inline waiver ``tpulint: allow-promise`` in an HTML comment on the line
+where the promise starts, pointing at where it is tracked — the same
+stance as ``# noqa`` with a why.  ROADMAP.md is exempt wholesale (and
+outside ``docs/`` anyway): it is the one file whose JOB is future
+promises.
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterable, List, Tuple
+
+from tpujob.analysis.engine import Finding, Project, Rule
+
+_WAIVER = "tpulint: allow-promise"
+
+# each pattern must key on a promise VERB, not on temporal words alone:
+# "until the lease expires" is runtime semantics, not a roadmap note.
+# [^.?!]{0,80} spans newlines on purpose — wrapped sentences still match
+_PROMISE_RES: Tuple[re.Pattern, ...] = (
+    re.compile(r"\b(?:until|once|when|after)\b[^.?!]{0,80}?"
+               r"\b(?:lands|ships|is\s+(?:implemented|wired(?:\s+up)?)"
+               r"|gets\s+(?:implemented|built|wired))\b", re.I),
+    re.compile(r"\b(?:will|to)\s+be\s+"
+               r"(?:added|implemented|wired|supported|fixed|built)\b"
+               r"[^.?!]{0,40}?\b(?:later|soon|eventually)\b", re.I),
+    re.compile(r"\bin\s+a\s+(?:future|later)\s+"
+               r"(?:PR|release|change|version)\b", re.I),
+    re.compile(r"\bnot\s+yet\s+(?:implemented|supported|wired|built)\b",
+               re.I),
+    re.compile(r"\bcoming\s+soon\b", re.I),
+    re.compile(r"\bTBD\b"),
+)
+
+
+def _prose(path) -> Tuple[str, List[int]]:
+    """The file's prose as ONE newline-joined string (fenced code blocks
+    replaced by empty lines so offsets stay line-aligned), plus the
+    0-based character offset where each line starts."""
+    kept: List[str] = []
+    in_fence = False
+    for line in path.read_text().splitlines():
+        if line.lstrip().startswith("```"):
+            in_fence = not in_fence
+            kept.append("")
+            continue
+        kept.append("" if in_fence else line)
+    text = "\n".join(kept)
+    starts, pos = [], 0
+    for line in kept:
+        starts.append(pos)
+        pos += len(line) + 1
+    return text, starts
+
+
+def _line_of(starts: List[int], offset: int) -> int:
+    lo, hi = 0, len(starts) - 1
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if starts[mid] <= offset:
+            lo = mid
+        else:
+            hi = mid - 1
+    return lo + 1  # 1-based
+
+
+class DocDriftRule(Rule):
+    id = "TPL204"
+    name = "future-promise-prose"
+    rationale = ("docs prose that predicates current behavior on unlanded "
+                 "future work goes stale silently when the work lands — "
+                 "the claim inverts and nothing diffs it; track promises "
+                 "in ROADMAP.md or waive with a pointer to where they are "
+                 "tracked")
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        root = project.root / "docs"
+        if not root.is_dir():
+            return findings
+        for path in sorted(root.rglob("*.md")):
+            rel = path.relative_to(project.root).as_posix()
+            text, starts = _prose(path)
+            lines = text.split("\n")
+            flagged = set()
+            for pattern in _PROMISE_RES:
+                for m in pattern.finditer(text):
+                    lineno = _line_of(starts, m.start())
+                    if lineno in flagged:
+                        continue
+                    if _WAIVER in lines[lineno - 1]:
+                        continue
+                    flagged.add(lineno)
+                    promise = re.sub(r"\s+", " ", m.group(0)).strip()
+                    findings.append(Finding(
+                        self.id, rel, lineno,
+                        f"future-promise prose ({promise!r}): docs must "
+                        f"describe the system as it is — move the promise "
+                        f"to ROADMAP.md, or waive with "
+                        f"`<!-- {_WAIVER}: <where tracked> -->`"))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+
+RULES: Tuple[Rule, ...] = (DocDriftRule(),)

@@ -172,3 +172,34 @@ ANNOTATION_MIGRATED_FROM = f"{GROUP_NAME}/migrated-from"
 LABEL_NODE_SYNTHESIZED = f"{GROUP_NAME}/synthesized"
 NODE_READY = "Ready"
 NODE_NOT_READY = "NotReady"
+
+# --- multi-cluster federation ------------------------------------------------
+# The federation meta-controller treats each member cluster's API server as
+# one more shard of the control plane: job ownership is CLUSTER-granular,
+# assigned once by the federation duty owner for that cluster and durable on
+# the job object itself (annotations survive every controller restart; the
+# meta store only mirrors them).  All federation writes into a cluster are
+# fenced on that cluster's own federation duty lease — a deposed duty
+# owner's stale token is rejected server-side, never merged.
+#
+# - CLUSTER: THE ownership record — the name of the exactly-one cluster
+#   that owns this job.  Written once at placement by the federation duty
+#   owner; rewritten only through the two-phase transfer (spillover) or a
+#   dark-cluster failover.  A member whose --cluster-name does not match
+#   holds the job dark: no pods, no failure strikes.
+# - CLUSTER_TRANSFER: the in-flight transfer marker (value = target
+#   cluster) — phase 1 of the two-phase spillover stamps it on the source
+#   copy so BOTH copies agree on the owner mid-transfer and an interrupted
+#   transfer resumes instead of forking.
+# - FAILED_OVER_FROM: durable provenance on a job re-placed off a dark
+#   cluster (value = the cluster that went dark) — the re-created object
+#   starts with fresh status (zero counted restarts; the workload restores
+#   from its last checkpoint barrier).
+ANNOTATION_CLUSTER = f"{GROUP_NAME}/cluster"
+ANNOTATION_CLUSTER_TRANSFER = f"{GROUP_NAME}/cluster-transfer"
+ANNOTATION_FAILED_OVER_FROM = f"{GROUP_NAME}/failed-over-from"
+# durable cluster phases recorded in the federation meta store (the
+# NodeHealth stance at cluster granularity: NotReady is a written verdict,
+# never an inference replayed from a stale cache)
+CLUSTER_READY = "Ready"
+CLUSTER_NOT_READY = "NotReady"

@@ -174,6 +174,15 @@ class ControllerConfig:
     # disables the whole plane (the bench_controller --goodput control);
     # the scheduler then falls back to raw steps-past-checkpoint.
     enable_goodput: bool = True
+    # --- multi-cluster federation (the meta-controller above clusters) ---
+    # which cluster THIS controller's member belongs to.  Non-empty
+    # activates the reconciler's federation gate: a job whose durable
+    # tpujob.dev/cluster annotation names ANOTHER cluster is held dark —
+    # pods evicted without failure strikes, telemetry exempt — because the
+    # named cluster is the exactly-one owner and running it here would
+    # duplicate the gang.  "" (default) = not federated; the gate is inert
+    # and single-cluster behavior is unchanged.
+    cluster_name: str = ""
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def stall_check_interval(self) -> float:

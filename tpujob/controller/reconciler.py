@@ -496,6 +496,16 @@ class TPUJobController(JobController):
             self._persist_status(job, old_status)
             return True
 
+        # federation gate: in a federated member (cluster_name set), a job
+        # whose durable cluster annotation names ANOTHER cluster is held
+        # dark before any local policy can touch it — running it here
+        # would duplicate the gang, and failing it by a local deadline
+        # would break the owner's accounting
+        if self.config.cluster_name:
+            gated = self._reconcile_federation(job, old_status, pods)
+            if gated is not None:
+                return gated
+
         # backoff limit (controller.go:391-453, 520-556)
         exceeded, reason = self._past_backoff_limit(job, pods)
         if exceeded:
@@ -1403,6 +1413,40 @@ class TPUJobController(JobController):
             interval = self.config.stall_check_interval()
             if self.goodput.arm_tick(key, interval):
                 self.queue.add_after(key, interval)
+        self._persist_status(job, old_status)
+        return True
+
+    def _reconcile_federation(self, job: TPUJob, old_status,
+                              pods: List[Pod]) -> Optional[bool]:
+        """The reconciler half of cluster-level job ownership.
+
+        Ownership is the durable ``tpujob.dev/cluster`` annotation written
+        once by the federation duty owner.  A job the annotation homes
+        HERE (or has not homed yet — placement is optimistic-local-start)
+        proceeds to the normal reconcile (returns None); one homed on
+        another cluster is held dark: every pod evicted WITHOUT a failure
+        strike (the admission gate's eviction mechanics — the named
+        cluster runs the gang, a copy here is a transfer source or a
+        revival zombie awaiting the federation sweep), telemetry exempt,
+        clocks suspended, sync done.  No status conditions are written:
+        the owning cluster's copy carries the job's visible history."""
+        ann = job.metadata.annotations or {}
+        owner = ann.get(c.ANNOTATION_CLUSTER)
+        if owner is None or owner == self.config.cluster_name:
+            return None
+        key = job.key
+        self.flight.record(
+            key, "federation",
+            f"held: cluster {owner} owns this job "
+            f"(we are {self.config.cluster_name})",
+            {"kind": "federation-hold", "owner": owner})
+        self._evict_pods(job, pods)
+        # a held job is not running: its activeDeadlineSeconds clock must
+        # not accrue, and the stall watchdog must never judge it (same
+        # suspension semantics as the admission gate)
+        if job.status.start_time is not None:
+            job.status.start_time = None
+        self.telemetry.exempt(key)
         self._persist_status(job, old_status)
         return True
 

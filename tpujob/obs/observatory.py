@@ -45,7 +45,6 @@ import json
 import logging
 import threading
 import time
-import urllib.request
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -58,23 +57,12 @@ log = logging.getLogger("tpujob.observatory")
 
 
 # ---------------------------------------------------------------------------
-# transport
+# transport: shared with the federation controller (tpujob/obs/scrape.py);
+# http_fetch is re-exported here because it IS the observatory's public
+# transport seam (e2e and standalone main() import it from this module)
 # ---------------------------------------------------------------------------
 
-
-def http_fetch(timeout_s: float = 2.0) -> Callable[[str, str], Any]:
-    """The default member transport: GET ``<target><path>`` and parse the
-    JSON body.  Raises on any failure — the observatory's scrape loop is
-    the one retry/degrade policy, not the transport."""
-
-    def fetch(target: str, path: str) -> Any:
-        url = target.rstrip("/") + path
-        with urllib.request.urlopen(url, timeout=timeout_s) as resp:  # noqa: S310 - operator-internal endpoint
-            if resp.status != 200:
-                raise OSError(f"{url}: HTTP {resp.status}")
-            return json.loads(resp.read().decode())
-
-    return fetch
+from tpujob.obs.scrape import ScrapeClient, http_fetch  # noqa: E402,F401
 
 
 # ---------------------------------------------------------------------------
@@ -234,6 +222,13 @@ class Observatory:
                               else interval_s * 1.5)
         self._fetch = fetch if fetch is not None else http_fetch(
             timeout_s=max(0.5, interval_s))
+        # the shared scrape client owns per-member state (last_ok, payload,
+        # failures, latency) under its own lock; the observatory reads one
+        # consistent snapshot per merge instead of holding its merge lock
+        # across I/O
+        self._scraper = ScrapeClient(
+            fetch=self._fetch, stale_after_s=self.stale_after_s,
+            lock_name="observatory-scrape")
         self.slos = slos if slos is not None else default_slos(interval_s)
         # the orphan invariant is only falsifiable when ``targets`` is the
         # WHOLE membership catalog; a knowingly-partial list (e.g. the
@@ -242,8 +237,6 @@ class Observatory:
         self.check_orphans = check_orphans
         self._lock = lockgraph.new_lock("observatory")
         self._targets: List[str] = list(targets)  # guarded by self._lock
-        # per-member scrape state (guarded by self._lock)
-        self._members: Dict[str, Dict[str, Any]] = {}
         # pending (kind, subject) violations inside the grace window
         self._pending: Dict[Tuple[str, str], Dict[str, Any]] = {}  # guarded by self._lock
         # fired violations (bounded: the soak cannot grow this unbounded)
@@ -273,9 +266,8 @@ class Observatory:
         with self._lock:
             gone = [t for t in self._targets if t not in targets]
             self._targets = list(targets)
-            for t in gone:
-                self._members.pop(t, None)
         for t in gone:
+            self._scraper.drop(t)
             metrics.observatory_member_up.remove(member=t)
             metrics.observatory_scrape_age.remove(member=t)
 
@@ -286,29 +278,11 @@ class Observatory:
         view (also retained for :meth:`merged_snapshot`)."""
         now = time.monotonic() if now is None else now
         targets = self.targets
-        scraped: Dict[str, Any] = {}
         for target in targets:
-            t0 = time.monotonic()
-            try:
-                payload = self._fetch(target, "/debug/fleet")
-                if not isinstance(payload, dict):
-                    raise ValueError("non-object /debug/fleet payload")
-            except Exception as e:  # noqa: TPL005 - any member fault degrades, never kills the loop
-                metrics.observatory_scrapes.labels(
-                    member=target, result="error").inc()
-                with self._lock:
-                    m = self._members.setdefault(target, {"last_ok": None})
-                    m["failures"] = m.get("failures", 0) + 1
-                    m["error"] = str(e) or e.__class__.__name__
-                continue
+            payload = self._scraper.scrape(target, "/debug/fleet", now=now)
             metrics.observatory_scrapes.labels(
-                member=target, result="ok").inc()
-            scraped[target] = payload
-            with self._lock:
-                m = self._members.setdefault(target, {})
-                m.update({"last_ok": now, "payload": payload, "error": None,
-                          "latency_s": round(time.monotonic() - t0, 6)})
-                m["scrapes"] = m.get("scrapes", 0) + 1
+                member=target,
+                result="ok" if payload is not None else "error").inc()
 
         view = self._merge(now, targets)
         self._verify(now, view)
@@ -318,41 +292,30 @@ class Observatory:
             self._merged = view
         return view
 
-    def _fresh_members(self, now: float, targets: List[str]
-                       ) -> Dict[str, Dict[str, Any]]:
-        """Members whose last successful scrape is within the staleness
-        bound (caller must hold self._lock).  Everyone else's snapshot is
-        DROPPED from the merge — a partial view that says so beats a
-        complete-looking view built on ghosts."""
-        fresh = {}
-        for t in targets:
-            m = self._members.get(t)
-            if m and m.get("last_ok") is not None \
-                    and now - m["last_ok"] <= self.stale_after_s:
-                fresh[t] = m["payload"]
-        return fresh
-
     def _merge(self, now: float, targets: List[str]) -> Dict[str, Any]:
-        with self._lock:
-            fresh = self._fresh_members(now, targets)
-            member_rows = []
-            for t in targets:
-                m = self._members.get(t) or {}
-                up = t in fresh
-                age = (None if m.get("last_ok") is None
-                       else round(now - m["last_ok"], 3))
-                member_rows.append({
-                    "target": t, "up": up, "scrape_age_s": age,
-                    "scrapes": m.get("scrapes", 0),
-                    "failures": m.get("failures", 0),
-                    "error": None if up else m.get("error"),
-                    "identity": (m.get("payload") or {}).get("identity")
-                    if m.get("payload") else None,
-                })
-                metrics.observatory_member_up.labels(member=t).set(
-                    1 if up else 0)
-                if age is not None:
-                    metrics.observatory_scrape_age.labels(member=t).set(age)
+        # one consistent snapshot from the shared scrape client; the
+        # staleness policy (drop ghosts, a partial view that says so) is
+        # the client's, applied identically for every consumer
+        fresh = self._scraper.fresh(now, targets)
+        states = self._scraper.states(targets)
+        member_rows = []
+        for t in targets:
+            m = states.get(t) or {}
+            up = t in fresh
+            age = (None if m.get("last_ok") is None
+                   else round(now - m["last_ok"], 3))
+            member_rows.append({
+                "target": t, "up": up, "scrape_age_s": age,
+                "scrapes": m.get("scrapes", 0),
+                "failures": m.get("failures", 0),
+                "error": None if up else m.get("error"),
+                "identity": (m.get("payload") or {}).get("identity")
+                if m.get("payload") else None,
+            })
+            metrics.observatory_member_up.labels(member=t).set(
+                1 if up else 0)
+            if age is not None:
+                metrics.observatory_scrape_age.labels(member=t).set(age)
 
         jobs: Dict[str, Dict[str, Any]] = {}
         exporters: Dict[str, List[str]] = {}

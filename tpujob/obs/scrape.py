@@ -1,0 +1,202 @@
+"""Shared HTTP scrape client: one transport + staleness policy for every
+plane that reads another process's debug surface.
+
+Two consumers exist today and they must not drift apart:
+
+- the **observatory** scrapes each member's ``/debug/fleet`` and merges
+  the survivors into one fleet view;
+- the **federation controller** scrapes each member *cluster's* members
+  the same way to score placement (capacity, queue depth, goodput) and
+  to detect a dark cluster.
+
+Both need the same three things, so they live here exactly once:
+
+- **transport** (:func:`http_fetch`): GET + JSON-parse with a timeout,
+  raising on any failure — the caller's poll loop is the one
+  retry/degrade policy, never the transport;
+- **staleness bound** (:class:`ScrapeClient`): a scrape older than the
+  bound is a ghost and must be dropped from any merge, because replaying
+  a dead member's last snapshot as live is how a fleet view lies;
+- **error taxonomy** (:class:`ScrapeError` kinds): ``unreachable`` (no
+  conversation with the target), ``http`` (a non-200 answer), and
+  ``malformed`` (an answer that did not parse as a JSON object) — a
+  dark-cluster detector treats only the first as evidence of darkness,
+  while a capacity scorer treats all three as "no usable sample".
+
+The client keeps per-target scrape state (last success time, payload,
+consecutive failures, latency) under its own lock so callers can read a
+consistent snapshot without holding their merge locks across I/O.
+Metrics stay with the callers: the observatory labels by ``member``, the
+federation by ``cluster``, and this module must not guess.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+from tpujob.analysis import lockgraph
+
+# error taxonomy kinds (the closed set callers may dispatch on)
+KIND_UNREACHABLE = "unreachable"
+KIND_HTTP = "http"
+KIND_MALFORMED = "malformed"
+
+
+class ScrapeError(Exception):
+    """A classified scrape failure.  ``kind`` is one of
+    :data:`KIND_UNREACHABLE` / :data:`KIND_HTTP` / :data:`KIND_MALFORMED`;
+    ``target`` names the endpoint that failed."""
+
+    def __init__(self, kind: str, target: str, detail: str):
+        super().__init__(f"{target}: {detail}")
+        self.kind = kind
+        self.target = target
+        self.detail = detail
+
+
+def http_fetch(timeout_s: float = 2.0) -> Callable[[str, str], Any]:
+    """The default transport: GET ``<target><path>`` and parse the JSON
+    body.  Raises on any failure — the scrape loop is the one
+    retry/degrade policy, not the transport."""
+
+    def fetch(target: str, path: str) -> Any:
+        url = target.rstrip("/") + path
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:  # noqa: S310 - operator-internal endpoint
+            if resp.status != 200:
+                raise OSError(f"{url}: HTTP {resp.status}")
+            return json.loads(resp.read().decode())
+
+    return fetch
+
+
+def classify(exc: BaseException) -> str:
+    """Map a transport exception onto the taxonomy.  HTTP status errors
+    mean the target process ANSWERED (it is alive, just unhappy);
+    connection-level failures mean nobody answered; everything else is a
+    payload that did not parse."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return KIND_HTTP
+    if isinstance(exc, (urllib.error.URLError, ConnectionError, TimeoutError,
+                        OSError)):
+        # OSError covers refused/reset/timeout; an HTTP-status OSError from
+        # http_fetch carries the literal marker
+        if "HTTP " in str(exc):
+            return KIND_HTTP
+        return KIND_UNREACHABLE
+    return KIND_MALFORMED
+
+
+class ScrapeClient:
+    """Per-target scrape state behind one lock: ``scrape()`` performs one
+    fetch and records the outcome; ``fresh()`` applies the staleness bound;
+    ``states()`` hands callers a consistent copy to build rows from.
+
+    The state dict per target (the shape the observatory's member rows
+    were always built from):
+
+    - ``last_ok``: monotonic time of the last successful scrape (None if
+      never succeeded)
+    - ``payload``: the last successfully parsed body
+    - ``error`` / ``error_kind``: the last failure's detail and taxonomy
+      kind (cleared on success)
+    - ``failures``: cumulative failed scrapes, ``consecutive_failures``:
+      failures since the last success (a dark-detector's streak input)
+    - ``scrapes``: cumulative successful scrapes
+    - ``latency_s``: duration of the last successful fetch
+    """
+
+    def __init__(
+        self,
+        fetch: Optional[Callable[[str, str], Any]] = None,
+        timeout_s: float = 2.0,
+        stale_after_s: float = 1.5,
+        lock_name: str = "scrape-client",
+    ):
+        self._fetch = fetch if fetch is not None else http_fetch(timeout_s)
+        self.stale_after_s = stale_after_s
+        self._lock = lockgraph.new_lock(lock_name)
+        self._state: Dict[str, Dict[str, Any]] = {}  # guarded by self._lock
+
+    # -- the one fetch -------------------------------------------------------
+
+    def scrape(self, target: str, path: str,
+               now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """One fetch of ``<target><path>``.  Returns the parsed payload on
+        success (and records it), None on failure (and records the
+        classified error).  Never raises — degrading is the caller's
+        policy, dying is nobody's."""
+        now = time.monotonic() if now is None else now
+        t0 = time.monotonic()
+        try:
+            payload = self._fetch(target, path)
+            if not isinstance(payload, dict):
+                raise ValueError(f"non-object {path} payload")
+        except Exception as e:  # noqa: TPL005 - any target fault degrades, never kills the loop
+            kind = classify(e)
+            with self._lock:
+                m = self._state.setdefault(target, {"last_ok": None})
+                m["failures"] = m.get("failures", 0) + 1
+                m["consecutive_failures"] = (
+                    m.get("consecutive_failures", 0) + 1)
+                m["error"] = str(e) or e.__class__.__name__
+                m["error_kind"] = kind
+            return None
+        with self._lock:
+            m = self._state.setdefault(target, {})
+            m.update({
+                "last_ok": now, "payload": payload,
+                "error": None, "error_kind": None,
+                "consecutive_failures": 0,
+                "latency_s": round(time.monotonic() - t0, 6),
+            })
+            m["scrapes"] = m.get("scrapes", 0) + 1
+            m.setdefault("failures", 0)
+        return payload
+
+    # -- reads ---------------------------------------------------------------
+
+    def state(self, target: str) -> Dict[str, Any]:
+        """Copy of one target's state ({} if never scraped)."""
+        with self._lock:
+            return dict(self._state.get(target) or {})
+
+    def states(self, targets: Optional[List[str]] = None
+               ) -> Dict[str, Dict[str, Any]]:
+        """Copies of every (or the named) targets' state, one consistent
+        snapshot — callers build their member/cluster rows from this
+        without holding their own merge locks across our lock."""
+        with self._lock:
+            names = list(self._state) if targets is None else targets
+            return {t: dict(self._state.get(t) or {}) for t in names}
+
+    def fresh(self, now: float, targets: List[str]
+              ) -> Dict[str, Dict[str, Any]]:
+        """Payloads of targets whose last success is within the staleness
+        bound.  Everyone else is DROPPED — a partial view that says so
+        beats a complete-looking view built on ghosts."""
+        with self._lock:
+            out = {}
+            for t in targets:
+                m = self._state.get(t)
+                if m and m.get("last_ok") is not None \
+                        and now - m["last_ok"] <= self.stale_after_s:
+                    out[t] = m["payload"]
+            return out
+
+    def is_stale(self, now: float, target: str) -> bool:
+        """Whether the target has NO successful scrape within the bound
+        (never-scraped counts as stale — absence of evidence of life is
+        not evidence of life)."""
+        with self._lock:
+            m = self._state.get(target)
+            return not (m and m.get("last_ok") is not None
+                        and now - m["last_ok"] <= self.stale_after_s)
+
+    def drop(self, target: str) -> None:
+        """Forget a departed target's state (the caller removes its own
+        labeled gauges — the one-exporter discipline)."""
+        with self._lock:
+            self._state.pop(target, None)

@@ -160,6 +160,7 @@ class OperatorApp:
                 stall_policy=opt.stall_policy,
                 stall_check_interval_s=opt.stall_check_interval_s,
                 enable_goodput=opt.enable_goodput,
+                cluster_name=opt.cluster_name,
             ),
         )
         if self.coordinator is not None:
@@ -197,6 +198,8 @@ class OperatorApp:
         self.monitoring: Optional[MonitoringServer] = None
         self.observatory = None  # Observatory when --observatory is on
         self.observatory_server = None  # its HTTP listener
+        self.federation = None  # FederationController when --federation is on
+        self.federation_server = None  # its /debug/federation listener
         self.stop_event = threading.Event()
         self.controller_threads: list = []
         self._elector_thread: Optional[threading.Thread] = None
@@ -224,6 +227,8 @@ class OperatorApp:
                      self.monitoring.port)
         if self.opt.enable_observatory:
             self._start_observatory()
+        if self.opt.enable_federation:
+            self._start_federation()
 
         def start_controller():
             log.info("starting controller (threadiness=%d%s)",
@@ -350,6 +355,73 @@ class OperatorApp:
                  "(handoff grace %.1fs)",
                  self.observatory_server.port, len(targets), grace)
 
+    def _start_federation(self) -> None:
+        """In-process federation replica (--federation): scrape the member
+        clusters in --federation-clusters, own a rendezvous-assigned
+        subset, place/spill/rescue their jobs.  The CLI can only express
+        clusters as scrape targets; the cluster matching --cluster-name
+        additionally gets this member's own API transport, so the
+        federation can do fenced writes into its home cluster.  (Chaos
+        harness and embedders construct ClusterHandles with real
+        transports for EVERY cluster; the meta store rides this member's
+        own API server — a real deployment points it at the federation
+        host cluster.)"""
+        import uuid
+
+        from tpujob.server.federation import (
+            ClusterHandle,
+            FederationController,
+            FederationServer,
+        )
+
+        clusters = []
+        for spec in self.opt.federation_clusters.split(";"):
+            spec = spec.strip()
+            if not spec:
+                continue
+            name, sep, urls = spec.partition("=")
+            if not sep or not name:
+                log.warning("--federation-clusters: skipping malformed "
+                            "spec %r (want name=url1|url2)", spec)
+                continue
+            targets = [u.strip() for u in urls.split("|") if u.strip()]
+            server = (self.transport
+                      if name.strip() == self.opt.cluster_name else None)
+            clusters.append(ClusterHandle(
+                name=name.strip(), server=server, targets=targets))
+        if not clusters:
+            log.warning("--federation without --federation-clusters: "
+                        "nothing to federate; skipping")
+            return
+        identity = (self.coordinator.identity if self.coordinator is not None
+                    else f"fed-{uuid.uuid4().hex[:8]}")
+        grace = self.opt.federation_dark_grace_s
+        if grace <= 0:
+            grace = (self.opt.lease_duration_s
+                     + 2 * self.opt.federation_interval_s)
+        damp = self.opt.federation_damp_s
+        if damp <= 0:
+            damp = 2 * self.opt.lease_duration_s
+        self.federation = FederationController(
+            identity=identity,
+            meta=self.transport,
+            clusters=clusters,
+            namespace=self.lease_namespace(),
+            interval_s=self.opt.federation_interval_s,
+            lease_duration_s=self.opt.lease_duration_s,
+            spillover_wait_s=self.opt.federation_spillover_wait_s,
+            dark_grace_s=grace,
+            damp_base_s=damp,
+        )
+        if self.opt.federation_port:
+            self.federation_server = FederationServer(
+                self.federation,
+                port=max(0, self.opt.federation_port)).start()
+        self.federation.start(self.stop_event)
+        log.info("federation replica %s over %d cluster(s) (dark grace "
+                 "%.1fs, damp base %.1fs)", identity, len(clusters),
+                 grace, damp)
+
     def lease_namespace(self) -> str:
         """The namespace holding the leader-election Lease: the operator's
         OWN namespace, like the reference derives from KUBEFLOW_NAMESPACE
@@ -401,6 +473,11 @@ class OperatorApp:
             self.observatory._thread.join(timeout=2)
         if self.observatory_server is not None:
             self.observatory_server.stop()
+        if self.federation is not None and self.federation._thread is not None:
+            threads.append(self.federation._thread)
+            self.federation._thread.join(timeout=2)
+        if self.federation_server is not None:
+            self.federation_server.stop()
         if self.monitoring:
             self.monitoring.stop()
         return not any(t.is_alive() for t in threads)

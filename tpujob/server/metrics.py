@@ -774,6 +774,68 @@ slo_alerts = LabeledCounter(
     ("slo",),
 )
 
+# Federation series: the meta-controller above the clusters.  Cluster
+# ownership is single-writer by rendezvous (one federation replica
+# processes each cluster), so each cluster-labeled series has exactly one
+# exporter — the same one-exporter discipline the observatory families
+# document, one level up.
+federation_scrapes = LabeledCounter(
+    "tpujob_federation_scrapes_total",
+    "Cluster scrape attempts by outcome (result=ok / error; one per member "
+    "target per federation tick, labeled by the cluster scraped)",
+    REGISTRY,
+    ("cluster", "result"),
+)
+federation_cluster_up = LabeledGauge(
+    "tpujob_federation_cluster_up",
+    "Whether the cluster answered its last scrape cycle (1) or every "
+    "member scrape is stale (0; a durable NotReady verdict additionally "
+    "requires the uncached member-lease re-read to confirm)",
+    REGISTRY,
+    ("cluster",),
+)
+federation_cluster_jobs = LabeledGauge(
+    "tpujob_federation_cluster_jobs",
+    "Jobs owned by the cluster per the federation job mirrors (the "
+    "durable tpujob.dev/cluster annotation, mirrored to the meta store)",
+    REGISTRY,
+    ("cluster",),
+)
+federation_placements = LabeledCounter(
+    "tpujob_federation_placements_total",
+    "Initial cluster-placement decisions, labeled by the cluster chosen "
+    "(the once-per-job durable annotation write)",
+    REGISTRY,
+    ("cluster",),
+)
+federation_spillovers = LabeledCounter(
+    "tpujob_federation_spillovers_total",
+    "Queue-starved jobs re-targeted through the two-phase transfer "
+    "(source = the overloaded home, target = the cluster that took it)",
+    REGISTRY,
+    ("source", "target"),
+)
+federation_failovers = LabeledCounter(
+    "tpujob_federation_failovers_total",
+    "Jobs re-admitted on a survivor after a dark-cluster failover "
+    "(source = the cluster marked NotReady, target = where the job "
+    "landed with fresh status and checkpoint restore)",
+    REGISTRY,
+    ("source", "target"),
+)
+federation_dark_clusters = Gauge(
+    "tpujob_federation_dark_clusters",
+    "Member clusters currently confirmed dark by this replica (stale "
+    "scrapes + no live member lease on the uncached re-read)",
+    REGISTRY,
+)
+federation_tick_seconds = Gauge(
+    "tpujob_federation_tick_seconds",
+    "Duration of the last federation tick (scrape + mirror + place + "
+    "rescue across every owned cluster)",
+    REGISTRY,
+)
+
 jobs_stalled = Counter(
     "tpujob_operator_stalled_jobs_total",
     "Stalled-condition flips by the progress watchdog (each is one detected "

@@ -148,6 +148,37 @@ class ServerOption:
     # shard orphaned) must PERSIST before it counts: the legitimate shard-
     # handoff window.  <= 0 derives lease_duration + one scrape interval.
     observatory_handoff_grace_s: float = 0.0
+    # multi-cluster federation: which cluster THIS member belongs to.
+    # Non-empty activates the reconciler's federation gate — a job whose
+    # durable tpujob.dev/cluster annotation names another cluster is held
+    # dark (no pods, no failure strikes).  "" = not federated (default;
+    # every existing single-cluster deployment is unchanged).
+    cluster_name: str = ""
+    # federation meta-controller (--federation): an in-process replica of
+    # the cluster-sharding meta-controller (tpujob/server/federation):
+    # scrape every member cluster, own a rendezvous-assigned subset,
+    # place/spill/rescue their jobs.  Requires cluster handles the CLI can
+    # only express as scrape targets (--federation-clusters); e2e and
+    # embedders construct ClusterHandles with real API transports.
+    enable_federation: bool = False
+    # semicolon-separated cluster specs "name=url1|url2", e.g.
+    # "us-east=http://a:9443|http://b:9443;eu-west=http://c:9443"
+    federation_clusters: str = ""
+    federation_interval_s: float = 1.0
+    # HTTP port for the merged /debug/federation surface (0 disables,
+    # negative = ephemeral)
+    federation_port: int = 0
+    # queue wait beyond which a job spills over to a less-loaded feasible
+    # cluster (two-phase transfer; <= 0 disables spillover)
+    federation_spillover_wait_s: float = 30.0
+    # how long a cluster must stay CONFIRMED dark (stale scrapes + no live
+    # member lease on an uncached re-read) before failover fires.
+    # <= 0 derives one lease term + two federation intervals.
+    federation_dark_grace_s: float = 0.0
+    # failover damper base: episode N of the same cluster waits
+    # base * 2^(N-1) before the next failover may fire.  <= 0 derives two
+    # lease terms.
+    federation_damp_s: float = 0.0
 
 
 class _LazyVersionAction(argparse.Action):
@@ -410,6 +441,48 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                              "persist before it counts (the legitimate "
                              "shard-handoff window; <=0 derives "
                              "lease-duration + one scrape interval)")
+    parser.add_argument("--cluster-name", default="", dest="cluster_name",
+                        help="name of the cluster this member belongs to; "
+                             "non-empty activates the federation gate (a "
+                             "job owned by another cluster per its durable "
+                             "tpujob.dev/cluster annotation is held dark: "
+                             "no pods, no failure strikes)")
+    parser.add_argument("--federation", dest="enable_federation",
+                        action="store_true", default=False,
+                        help="run a federation meta-controller replica "
+                             "in-process: scrape every member cluster, own "
+                             "a rendezvous-assigned subset, place/spill/"
+                             "rescue their jobs")
+    parser.add_argument("--no-federation", dest="enable_federation",
+                        action="store_false",
+                        help="disable the in-process federation replica")
+    parser.add_argument("--federation-clusters", default="",
+                        dest="federation_clusters",
+                        help="semicolon-separated cluster scrape specs "
+                             "'name=url1|url2', e.g. 'us-east=http://a:9443"
+                             "|http://b:9443;eu-west=http://c:9443'")
+    parser.add_argument("--federation-interval", type=float, default=1.0,
+                        dest="federation_interval_s",
+                        help="federation tick cadence in seconds")
+    parser.add_argument("--federation-port", type=int, default=0,
+                        dest="federation_port",
+                        help="port for the merged /debug/federation "
+                             "surface (0 disables, negative = ephemeral)")
+    parser.add_argument("--federation-spillover-wait", type=float,
+                        default=30.0, dest="federation_spillover_wait_s",
+                        help="queue wait in seconds beyond which a job "
+                             "spills over to a less-loaded feasible "
+                             "cluster (<=0 disables spillover)")
+    parser.add_argument("--federation-dark-grace", type=float, default=0.0,
+                        dest="federation_dark_grace_s",
+                        help="seconds a cluster must stay confirmed dark "
+                             "before failover fires (<=0 derives one "
+                             "lease term + two federation intervals)")
+    parser.add_argument("--federation-damp", type=float, default=0.0,
+                        dest="federation_damp_s",
+                        help="failover damper base in seconds: episode N "
+                             "of the same cluster waits base * 2^(N-1) "
+                             "(<=0 derives two lease terms)")
 
 
 def parse_options(argv: Optional[List[str]] = None) -> ServerOption:

@@ -84,13 +84,17 @@ def shard_of_uid(uid: str, num_shards: int) -> int:
     return stable_hash(f"uid:{uid}") % num_shards
 
 
-def rendezvous_owner(shard: int, members: Sequence[str]) -> Optional[str]:
+def rendezvous_owner(shard, members: Sequence[str]) -> Optional[str]:
     """Highest-random-weight owner of ``shard`` among ``members``.
 
     Deterministic in the (unordered) membership set.  Adding a member
     reassigns exactly the shards the newcomer wins — on average 1/N of
     them — and never shuffles a shard between two surviving members;
-    removing one reassigns only the shards it owned."""
+    removing one reassigns only the shards it owned.
+
+    ``shard`` is any stable key: shard INDICES here, cluster NAMES in the
+    federation meta-controller (the same 1/N stability argument holds at
+    cluster granularity — that reuse is why the key is not typed int)."""
     best: Optional[str] = None
     best_w = -1
     for m in members:
@@ -106,6 +110,81 @@ def shard_lease_name(shard: int) -> str:
 
 def member_lease_name(identity: str) -> str:
     return f"{MEMBER_LEASE_PREFIX}-{identity}"
+
+
+def heartbeat_member_lease(server, namespace: str, identity: str,
+                           lease_duration: float,
+                           prefix: str = MEMBER_LEASE_PREFIX) -> None:
+    """Write one membership heartbeat lease (create-or-renew).  The lease
+    name embeds the identity, so there is no contention — only our own
+    stale record — and generations are irrelevant: membership only needs
+    liveness, the per-duty leases carry the fencing generations.
+
+    Module-level because TWO membership planes heartbeat this way: shard
+    coordinators (``tpujob-member-*``) and federation replicas
+    (``prefix`` selects the plane)."""
+    now = time.time()
+    name = f"{prefix}-{identity}"
+    record = {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "holderIdentity": identity,
+            "leaseDurationSeconds": max(1, int(round(lease_duration))),
+            "acquireTime": rfc3339micro(now),
+            "renewTime": rfc3339micro(now),
+            "leaseTransitions": 0,
+        },
+    }
+    try:
+        current = server.get(RESOURCE_LEASES, namespace, name)
+    except NotFoundError:
+        try:
+            server.create(RESOURCE_LEASES, record)
+            return
+        except AlreadyExistsError:
+            current = server.get(RESOURCE_LEASES, namespace, name)
+    spec = current.get("spec") or {}
+    record["spec"]["acquireTime"] = spec.get("acquireTime") or rfc3339micro(now)
+    record["metadata"]["resourceVersion"] = (
+        (current.get("metadata") or {}).get("resourceVersion"))
+    try:
+        server.update(RESOURCE_LEASES, record)
+    except (ConflictError, NotFoundError):
+        pass  # raced (only ever with our own writes); next tick renews
+
+
+def live_lease_holders(server, namespace: str, prefix: str,
+                       default_duration: float,
+                       now: Optional[float] = None) -> List[str]:
+    """Identities of every member whose ``<prefix>-*`` heartbeat lease is
+    unexpired.
+
+    Fail closed on an unparseable renewTime (treat the member as live, the
+    elector's rule): evicting a healthy member on garbage would hand its
+    shards — or, in the federation plane, its clusters — to a rival while
+    it still syncs them, exactly the double-sync window this module exists
+    to close.  An empty holderIdentity is a graceful departure and is
+    excluded; a lease expired past its own declared duration (falling back
+    to ``default_duration`` when it declares none) is dead."""
+    now = time.time() if now is None else now
+    out: List[str] = []
+    for lease in server.list(RESOURCE_LEASES, namespace):
+        name = (lease.get("metadata") or {}).get("name") or ""
+        if not name.startswith(f"{prefix}-"):
+            continue
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        if not holder:
+            continue  # gracefully departed
+        renew = parse_lease_time(spec.get("renewTime"))
+        duration = float(spec.get("leaseDurationSeconds")
+                         or default_duration)
+        if renew is not None and now - renew > duration:
+            continue  # expired: the member is dead
+        out.append(holder)
+    return sorted(set(out))
 
 
 # The shard the in-flight sync (or informer-handler write) belongs to.  Set
@@ -236,65 +315,17 @@ class ShardCoordinator:
     # -- membership ----------------------------------------------------------
 
     def _heartbeat(self) -> None:
-        """Write our member lease (create-or-renew).  The lease name embeds
-        the identity, so there is no contention — only our own stale
-        record — and generations are irrelevant: membership only needs
-        liveness, the per-shard leases carry the fencing generations."""
-        now = time.time()
-        name = member_lease_name(self.identity)
-        record = {
-            "apiVersion": "coordination.k8s.io/v1",
-            "kind": "Lease",
-            "metadata": {"name": name, "namespace": self.namespace},
-            "spec": {
-                "holderIdentity": self.identity,
-                "leaseDurationSeconds": max(1, int(round(self.lease_duration))),
-                "acquireTime": rfc3339micro(now),
-                "renewTime": rfc3339micro(now),
-                "leaseTransitions": 0,
-            },
-        }
-        try:
-            current = self.server.get(RESOURCE_LEASES, self.namespace, name)
-        except NotFoundError:
-            try:
-                self.server.create(RESOURCE_LEASES, record)
-                return
-            except AlreadyExistsError:
-                current = self.server.get(RESOURCE_LEASES, self.namespace, name)
-        spec = current.get("spec") or {}
-        record["spec"]["acquireTime"] = spec.get("acquireTime") or rfc3339micro(now)
-        record["metadata"]["resourceVersion"] = (
-            (current.get("metadata") or {}).get("resourceVersion"))
-        try:
-            self.server.update(RESOURCE_LEASES, record)
-        except (ConflictError, NotFoundError):
-            pass  # raced (only ever with our own writes); next tick renews
+        """Write our member lease — the shared membership heartbeat
+        (:func:`heartbeat_member_lease`) on the shard plane's prefix."""
+        heartbeat_member_lease(self.server, self.namespace, self.identity,
+                               self.lease_duration)
 
     def _live_members(self) -> List[str]:
-        """Identities of every member whose heartbeat lease is unexpired.
-
-        Fail closed on an unparseable renewTime (treat the member as live,
-        the elector's rule): evicting a healthy member on garbage would
-        hand its shards to a rival while it still syncs them — exactly the
-        double-sync window this module exists to close."""
-        now = time.time()
-        out: List[str] = []
-        for lease in self.server.list(RESOURCE_LEASES, self.namespace):
-            name = (lease.get("metadata") or {}).get("name") or ""
-            if not name.startswith(f"{MEMBER_LEASE_PREFIX}-"):
-                continue
-            spec = lease.get("spec") or {}
-            holder = spec.get("holderIdentity")
-            if not holder:
-                continue  # gracefully departed
-            renew = parse_lease_time(spec.get("renewTime"))
-            duration = float(spec.get("leaseDurationSeconds")
-                             or self.lease_duration)
-            if renew is not None and now - renew > duration:
-                continue  # expired: the member is dead
-            out.append(holder)
-        return sorted(set(out))
+        """Identities of every member whose heartbeat lease is unexpired —
+        the shared fail-closed read (:func:`live_lease_holders`) on the
+        shard plane's prefix."""
+        return live_lease_holders(self.server, self.namespace,
+                                  MEMBER_LEASE_PREFIX, self.lease_duration)
 
     # -- shard map -----------------------------------------------------------
 
